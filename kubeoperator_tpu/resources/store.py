@@ -1,0 +1,156 @@
+"""Sqlite-backed document store for resource entities.
+
+One table per entity kind: ``(id TEXT PRIMARY KEY, name TEXT, project TEXT,
+data TEXT)`` where ``data`` is the JSON-serialized dataclass. This trades
+rich SQL for zero dependencies and a schema that never needs migrations —
+the control plane's query patterns (get by id/name, list by project/field)
+don't need more. WAL mode + a process-wide lock make it safe for the
+threaded task engine.
+
+Tenancy: queries are automatically filtered by ``scope.current_project()``
+when the entity carries a ``project`` field and a scope is active —
+the rebuilt equivalent of the reference's ``ProjectResourceManager``
+(``ansible_api/models/mixins.py:14-35``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from dataclasses import asdict, fields, is_dataclass
+from typing import Any, Iterator, Type, TypeVar
+
+from kubeoperator_tpu.resources import scope
+
+T = TypeVar("T")
+
+
+def _table(cls: type) -> str:
+    return getattr(cls, "KIND", cls.__name__.lower())
+
+
+class Store:
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._lock = threading.RLock()
+        self._tables: set[str] = set()
+
+    def _ensure(self, cls: type) -> str:
+        t = _table(cls)
+        if t not in self._tables:
+            with self._lock:
+                self._conn.execute(
+                    f"CREATE TABLE IF NOT EXISTS {t} ("
+                    "id TEXT PRIMARY KEY, name TEXT, project TEXT, data TEXT)"
+                )
+                self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_name ON {t}(name)")
+                self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_project ON {t}(project)")
+                self._conn.commit()
+                self._tables.add(t)
+        return t
+
+    # -- CRUD -------------------------------------------------------------
+    def save(self, entity: Any) -> Any:
+        assert is_dataclass(entity), f"{entity!r} is not a dataclass entity"
+        t = self._ensure(type(entity))
+        doc = asdict(entity)
+        with self._lock:
+            self._conn.execute(
+                f"INSERT INTO {t}(id, name, project, data) VALUES(?,?,?,?) "
+                "ON CONFLICT(id) DO UPDATE SET name=excluded.name, "
+                "project=excluded.project, data=excluded.data",
+                (doc["id"], doc.get("name"), doc.get("project"), json.dumps(doc)),
+            )
+            self._conn.commit()
+        return entity
+
+    def get(self, cls: Type[T], id: str, scoped: bool = True) -> T | None:
+        """Get by id. Honors tenancy scope: inside ``scope.project(p)`` a row
+        owned by a different project is invisible (returns None) unless
+        ``scoped=False`` — closing the cross-tenant id-lookup hole the
+        reference's manager-level filtering also guards against."""
+        t = self._ensure(cls)
+        with self._lock:
+            row = self._conn.execute(f"SELECT data FROM {t} WHERE id=?", (id,)).fetchone()
+        if not row:
+            return None
+        entity = self._load(cls, row[0])
+        proj = scope.current_project()
+        if (scoped and proj is not None
+                and "project" in {f.name for f in fields(cls)}
+                and getattr(entity, "project", None) not in (None, proj)):
+            return None
+        return entity
+
+    def get_by_name(self, cls: Type[T], name: str, scoped: bool = True) -> T | None:
+        for e in self.find(cls, scoped=scoped, name=name):
+            return e
+        return None
+
+    def find(self, cls: Type[T], scoped: bool = True, **filters: Any) -> list[T]:
+        return list(self.iter(cls, scoped=scoped, **filters))
+
+    def iter(self, cls: Type[T], scoped: bool = True, **filters: Any) -> Iterator[T]:
+        t = self._ensure(cls)
+        sql, args = f"SELECT data FROM {t}", []
+        clauses = []
+        proj = scope.current_project()
+        field_names = {f.name for f in fields(cls)}
+        if scoped and proj is not None and "project" in field_names:
+            clauses.append("project=?")
+            args.append(proj)
+        for key in ("name", "project"):
+            if key in filters:
+                clauses.append(f"{key}=?")
+                args.append(filters.pop(key))
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        with self._lock:
+            rows = self._conn.execute(sql, args).fetchall()
+        for (data,) in rows:
+            e = self._load(cls, data)
+            if all(getattr(e, k, None) == v for k, v in filters.items()):
+                yield e
+
+    def delete(self, cls: type, id: str) -> None:
+        t = self._ensure(cls)
+        with self._lock:
+            self._conn.execute(f"DELETE FROM {t} WHERE id=?", (id,))
+            self._conn.commit()
+
+    def count(self, cls: type, **filters: Any) -> int:
+        indexed = {"name", "project"}
+        if set(filters) <= indexed:
+            t = self._ensure(cls)
+            clauses, args = [], []
+            proj = scope.current_project()
+            if proj is not None and "project" not in filters and \
+                    "project" in {f.name for f in fields(cls)}:
+                clauses.append("project=?")
+                args.append(proj)
+            for k, v in filters.items():
+                clauses.append(f"{k}=?")
+                args.append(v)
+            sql = f"SELECT COUNT(*) FROM {t}"
+            if clauses:
+                sql += " WHERE " + " AND ".join(clauses)
+            with self._lock:
+                return self._conn.execute(sql, args).fetchone()[0]
+        return len(self.find(cls, **filters))
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _load(cls: Type[T], data: str) -> T:
+        doc = json.loads(data)
+        names = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in names})
+
+    def transaction(self):
+        """Reference uses ``select_for_update`` for config writes
+        (``cluster.py:279-286``); here the store lock serializes a block."""
+        return self._lock

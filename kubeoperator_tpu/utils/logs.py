@@ -1,0 +1,41 @@
+"""Logging helpers.
+
+Per-task log files mirror the reference's celery task log capture
+(``core/apps/celery_api/logger.py:82-160`` writes every record of a task to
+``data/celery/<task_id>.log``). Here the task engine attaches a
+``TaskLogHandler`` around each task run.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+_initialized = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _initialized
+    if not _initialized:
+        root = logging.getLogger("kubeoperator_tpu")
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(FORMAT))
+        root.addHandler(h)
+        level = os.environ.get("KO_LOG_LEVEL", "INFO").upper()
+        try:
+            root.setLevel(level)
+        except ValueError:
+            root.setLevel(logging.INFO)
+        _initialized = True
+    return logging.getLogger(name)
+
+
+class TaskLogHandler(logging.FileHandler):
+    """File handler scoped to one task id; the engine installs it on the
+    ``kubeoperator_tpu`` logger tree for the duration of a task."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        super().__init__(path, encoding="utf-8")
+        self.setFormatter(logging.Formatter(FORMAT))
