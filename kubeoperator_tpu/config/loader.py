@@ -105,7 +105,10 @@ def _coerce(value: str, like: Any) -> Any:
 def load_config(path: str | None = None, overrides: Mapping[str, Any] | None = None) -> Config:
     cfg = Config(DEFAULTS)
     path = path or os.environ.get("KO_CONFIG")
-    if path and os.path.exists(path):
+    if path:
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"config file {path} not found (from KO_CONFIG or argument)")
         with open(path) as f:
             user = yaml.safe_load(f) or {}
         if not isinstance(user, dict):

@@ -1,0 +1,81 @@
+"""Cluster storage (reference: ``cluster-storage`` role + storage option
+catalog ``config.yml:247-281``): deploy the chosen provisioner + a default
+StorageClass, then probe it with a test PVC (the reference applies
+``test-sc.yaml.j2``)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+TEMPLATES = {
+    "local-volume": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: local-volume
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: kubernetes.io/no-provisioner
+volumeBindingMode: WaitForFirstConsumer
+""",
+    "nfs": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: nfs
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: nfs.csi.k8s.io
+parameters: {{server: "{nfs_server}", share: "{nfs_path}"}}
+""",
+    "rook-ceph": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: rook-ceph-block
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: rook-ceph.rbd.csi.ceph.com
+""",
+    "external-ceph": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: external-ceph
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: rbd.csi.ceph.com
+parameters: {{monitors: "{ceph_monitors}"}}
+""",
+    "gcp-pd": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: gcp-pd
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: pd.csi.storage.gke.io
+parameters: {{type: pd-balanced}}
+""",
+}
+
+TEST_PVC = """apiVersion: v1
+kind: PersistentVolumeClaim
+metadata: {name: ko-storage-probe, namespace: default}
+spec:
+  accessModes: [ReadWriteOnce]
+  resources: {requests: {storage: 1Gi}}
+"""
+
+
+def run(ctx: StepContext):
+    provider = ctx.cluster.storage_provider
+    spec = ctx.catalog.storage(provider)
+    # deploy-type gating (reference gates storages by deploy_type+provider)
+    if ctx.cluster.deploy_type not in spec["deploy_types"]:
+        raise StepError(f"storage {provider!r} not allowed for {ctx.cluster.deploy_type}")
+    tmpl = TEMPLATES[provider]
+    cfg = {"nfs_server": "", "nfs_path": "/export", "ceph_monitors": ""}
+    cfg.update(ctx.cluster.storage_config)
+    manifest = tmpl.format(**cfg)
+
+    def per(th):
+        o = ctx.ops(th)
+        path = f"{k8s.MANIFESTS}/storage-{provider}.yaml"
+        o.ensure_file(path, manifest)
+        o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=120)
+        o.ensure_file(f"{k8s.MANIFESTS}/storage-probe.yaml", TEST_PVC)
+        o.sh(f"{k8s.KUBECTL} apply -f {k8s.MANIFESTS}/storage-probe.yaml", check=False)
+
+    ctx.fan_out(per)

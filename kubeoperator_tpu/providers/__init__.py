@@ -1,0 +1,17 @@
+"""Cloud providers — Day-0 provisioning.
+
+Replaces the reference's ``cloud_provider`` app (vSphere/OpenStack via
+``python_terraform``) with a Terraform-JSON driver and a GCE provider
+whose worker pools are **TPU pod slices**: one slice = ``hosts(type)`` VMs
+= one schedulable unit (BASELINE.json north star; breaks the reference's
+1-host-=-1-node planner assumption, ``cloud_provider.py:125-174``).
+"""
+
+from kubeoperator_tpu.providers.base import CloudProvider, allocate_ip, recover_ip
+from kubeoperator_tpu.providers.gce_tpu import GceTpuProvider
+from kubeoperator_tpu.providers.terraform import TerraformDriver
+
+PROVIDERS = {"gce": GceTpuProvider}
+
+__all__ = ["CloudProvider", "GceTpuProvider", "TerraformDriver", "PROVIDERS",
+           "allocate_ip", "recover_ip"]

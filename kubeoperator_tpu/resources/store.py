@@ -81,9 +81,11 @@ class Store:
             return None
         entity = self._load(cls, row[0])
         proj = scope.current_project()
+        # strict visibility, matching find(): inside a scope, only rows of
+        # that project are visible (including hiding unassigned rows)
         if (scoped and proj is not None
                 and "project" in {f.name for f in fields(cls)}
-                and getattr(entity, "project", None) not in (None, proj)):
+                and getattr(entity, "project", None) != proj):
             return None
         return entity
 
@@ -95,19 +97,32 @@ class Store:
     def find(self, cls: Type[T], scoped: bool = True, **filters: Any) -> list[T]:
         return list(self.iter(cls, scoped=scoped, **filters))
 
-    def iter(self, cls: Type[T], scoped: bool = True, **filters: Any) -> Iterator[T]:
-        t = self._ensure(cls)
-        sql, args = f"SELECT data FROM {t}", []
-        clauses = []
+    def _where(self, cls: type, scoped: bool, filters: dict) -> tuple[list[str], list]:
+        """Shared WHERE builder for iter()/count(). Ambient scope and an
+        explicit project filter are ANDed — crossing tenants always requires
+        ``scoped=False``. ``project=None`` selects unassigned rows."""
+        clauses: list[str] = []
+        args: list = []
         proj = scope.current_project()
-        field_names = {f.name for f in fields(cls)}
-        if scoped and proj is not None and "project" in field_names:
+        if scoped and proj is not None and "project" in {f.name for f in fields(cls)}:
             clauses.append("project=?")
             args.append(proj)
-        for key in ("name", "project"):
-            if key in filters:
-                clauses.append(f"{key}=?")
-                args.append(filters.pop(key))
+        if "project" in filters:
+            p = filters.pop("project")
+            if p is None:
+                clauses.append("project IS NULL")
+            else:
+                clauses.append("project=?")
+                args.append(p)
+        if "name" in filters:
+            clauses.append("name=?")
+            args.append(filters.pop("name"))
+        return clauses, args
+
+    def iter(self, cls: Type[T], scoped: bool = True, **filters: Any) -> Iterator[T]:
+        t = self._ensure(cls)
+        sql = f"SELECT data FROM {t}"
+        clauses, args = self._where(cls, scoped, filters)
         if clauses:
             sql += " WHERE " + " AND ".join(clauses)
         with self._lock:
@@ -123,25 +138,16 @@ class Store:
             self._conn.execute(f"DELETE FROM {t} WHERE id=?", (id,))
             self._conn.commit()
 
-    def count(self, cls: type, **filters: Any) -> int:
-        indexed = {"name", "project"}
-        if set(filters) <= indexed:
+    def count(self, cls: type, scoped: bool = True, **filters: Any) -> int:
+        if set(filters) <= {"name", "project"}:
             t = self._ensure(cls)
-            clauses, args = [], []
-            proj = scope.current_project()
-            if proj is not None and "project" not in filters and \
-                    "project" in {f.name for f in fields(cls)}:
-                clauses.append("project=?")
-                args.append(proj)
-            for k, v in filters.items():
-                clauses.append(f"{k}=?")
-                args.append(v)
+            clauses, args = self._where(cls, scoped, filters)
             sql = f"SELECT COUNT(*) FROM {t}"
             if clauses:
                 sql += " WHERE " + " AND ".join(clauses)
             with self._lock:
                 return self._conn.execute(sql, args).fetchone()[0]
-        return len(self.find(cls, **filters))
+        return len(self.find(cls, scoped=scoped, **filters))
 
     # -- helpers ----------------------------------------------------------
     @staticmethod

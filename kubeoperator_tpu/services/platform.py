@@ -1,0 +1,250 @@
+"""Platform — the service facade the API and CLI drive.
+
+Wires config + store + catalog + executor + task engine + providers, and
+implements the orchestration glue the reference spreads across
+``kubeops_api/api.py`` and the fat models: cluster creation with merged
+configs (``cluster.py:188-226``), node binding with accelerator var
+propagation (``node.py:40-50``), execution dispatch with preflight +
+stale-execution cleanup + idempotent task ids (``api.py:226-255``), host
+registration with fact gathering (``host.py:96-142``), and message fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeoperator_tpu.config.catalog import Catalog, load_catalog
+from kubeoperator_tpu.config.loader import Config, load_config
+from kubeoperator_tpu.engine import adhoc, operations
+from kubeoperator_tpu.engine.executor import (
+    Conn, Executor, FakeExecutor, SSHExecutor,
+)
+from kubeoperator_tpu.engine.tasks import TaskEngine, TaskRecord
+from kubeoperator_tpu.providers import PROVIDERS, TerraformDriver
+from kubeoperator_tpu.providers.base import ProviderError, count_ip_available
+from kubeoperator_tpu.resources import scope
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, Credential, DeployExecution, DeployType,
+    ExecutionState, Host, Item, ItemResource, Message, Node, Package, Plan,
+    Region, User, Zone,
+)
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.utils.logs import get_logger
+from kubeoperator_tpu.utils.secrets import default_box
+
+log = get_logger(__name__)
+
+
+class PlatformError(RuntimeError):
+    pass
+
+
+class Platform:
+    def __init__(self, config: Config | None = None, store: Store | None = None,
+                 executor: Executor | None = None, catalog: Catalog | None = None):
+        self.config = config or load_config()
+        self.store = store or Store(self.config.database)
+        self.catalog = catalog or load_catalog()
+        if executor is not None:
+            self.executor = executor
+        elif self.config.executor == "fake":
+            self.executor = FakeExecutor()
+        else:
+            self.executor = SSHExecutor(connect_timeout=self.config.ssh_connect_timeout)
+        self.tasks = TaskEngine(workers=self.config.task_workers,
+                                log_dir=self.config.task_logs)
+        self.terraform = TerraformDriver(self.config.terraform,
+                                         binary=self.config.terraform_bin)
+        self._providers = {name: cls(self.terraform) for name, cls in PROVIDERS.items()}
+
+    # -- credentials / hosts ----------------------------------------------
+    def create_credential(self, name: str, username: str = "root", password: str = "",
+                          private_key: str = "") -> Credential:
+        cred = Credential(
+            name=name, username=username,
+            password=default_box().encrypt(password) if password else "",
+            private_key=default_box().encrypt(private_key) if private_key else "",
+            type="key" if private_key else "password",
+        )
+        self.store.save(cred)
+        return cred
+
+    def register_host(self, name: str, ip: str, credential_id: str = "",
+                      port: int = 22, gather: bool = True) -> Host:
+        if self.store.get_by_name(Host, name, scoped=False):
+            raise PlatformError(f"host {name!r} already registered")
+        host = Host(name=name, ip=ip, port=port, credential_id=credential_id)
+        if gather:
+            cred = self.store.get(Credential, credential_id, scoped=False)
+            facts = adhoc.gather_facts(self.executor, Conn.from_host(host, cred))
+            adhoc.apply_facts(host, facts)
+        self.store.save(host)
+        return host
+
+    # -- clusters ----------------------------------------------------------
+    def create_cluster(self, name: str, template: str = "SINGLE",
+                       deploy_type: str = DeployType.MANUAL,
+                       network_plugin: str = "calico",
+                       network_config: dict | None = None,
+                       storage_provider: str = "local-volume",
+                       storage_config: dict | None = None,
+                       plan_id: str = "", package: str = "",
+                       item: str = "", configs: dict | None = None) -> Cluster:
+        if self.store.get_by_name(Cluster, name, scoped=False):
+            raise PlatformError(f"cluster {name!r} already exists")
+        self.catalog.template(template)
+        self.catalog.network(network_plugin)
+        self.catalog.storage(storage_provider)
+        merged: dict[str, Any] = {}
+        pkg = self.store.get_by_name(Package, package, scoped=False) if package else None
+        if pkg:
+            merged.update(pkg.meta.get("vars", {}))
+        merged.update(configs or {})
+        cluster = Cluster(
+            name=name, template=template, deploy_type=deploy_type,
+            network_plugin=network_plugin, network_config=network_config or {},
+            storage_provider=storage_provider, storage_config=storage_config or {},
+            plan_id=plan_id, package=package, item=item, configs=merged,
+        )
+        self.store.save(cluster)
+        if item:
+            self.store.save(ItemResource(item_id=item, resource_type="cluster",
+                                         resource_id=cluster.id, name=name))
+        return cluster
+
+    def add_node(self, cluster: Cluster, host: Host, roles: list[str]) -> Node:
+        for role in roles:
+            if role not in self.catalog.roles:
+                raise PlatformError(f"unknown role {role!r}")
+        if host.project not in (None, cluster.name):
+            raise PlatformError(f"host {host.name} already belongs to {host.project}")
+        host.project = cluster.name
+        self.store.save(host)
+        node = Node(name=host.name, host_id=host.id, project=cluster.name, roles=roles)
+        self.store.save(node)
+        return node
+
+    def delete_cluster(self, name: str, force: bool = False) -> None:
+        """Guarded delete (reference ``api.py:49-119``: refuse while an
+        operation is running unless forced)."""
+        cluster = self.store.get_by_name(Cluster, name, scoped=False)
+        if cluster is None:
+            return
+        busy = cluster.status in (ClusterStatus.INSTALLING, ClusterStatus.UPGRADING,
+                                  ClusterStatus.DELETING, ClusterStatus.RESTORING)
+        if busy and not force:
+            raise PlatformError(f"cluster {name} is {cluster.status}; use force=True")
+        with scope.project(name):
+            for node in self.store.find(Node):
+                self.store.delete(Node, node.id)
+            for h in self.store.find(Host, scoped=False, project=name):
+                if h.auto_created:
+                    self.store.delete(Host, h.id)
+                else:
+                    h.project = None
+                    self.store.save(h)
+        self.store.delete(Cluster, cluster.id)
+
+    def provider_for(self, cluster: Cluster):
+        if cluster.deploy_type != DeployType.AUTOMATIC or not cluster.plan_id:
+            return None
+        plan = self.store.get(Plan, cluster.plan_id, scoped=False)
+        if plan is None:
+            return None
+        region = self.store.get(Region, plan.region_id, scoped=False)
+        name = region.provider if region else "gce"
+        provider = self._providers.get(name)
+        if provider is None:
+            raise PlatformError(f"no provider registered for {name!r}")
+        return provider
+
+    # -- executions --------------------------------------------------------
+    def create_execution(self, cluster_name: str, operation: str,
+                         params: dict | None = None) -> DeployExecution:
+        cluster = self.store.get_by_name(Cluster, cluster_name, scoped=False)
+        if cluster is None:
+            raise PlatformError(f"no cluster {cluster_name!r}")
+        self.catalog.operation_steps(operation)   # validate early
+
+        # preflight: IP availability for growing AUTOMATIC clusters
+        # (reference api.py:234-241)
+        if (operation in ("install", "scale")
+                and cluster.deploy_type == DeployType.AUTOMATIC and cluster.plan_id):
+            plan = self.store.get(Plan, cluster.plan_id, scoped=False)
+            if plan:
+                existing = self.store.count(Host, project=cluster_name)
+                needed = self._plan_host_count(plan, params) - existing
+                available = count_ip_available(self.store, plan.zone_ids)
+                if needed > available:
+                    raise PlatformError(
+                        f"insufficient IPs: need {needed}, zone pools have {available}")
+
+        # mark stale STARTED executions failed (reference api.py:244-248)
+        with scope.project(cluster_name):
+            for old in self.store.find(DeployExecution):
+                if old.state == ExecutionState.STARTED:
+                    rec = self.tasks.tasks.get(old.id)
+                    if rec is None or rec.state not in ("PENDING", "STARTED"):
+                        old.state = ExecutionState.FAILURE
+                        old.result["error"] = "stale execution superseded"
+                        self.store.save(old)
+
+        execution = DeployExecution(operation=operation, project=cluster_name,
+                                    params=params or {},
+                                    name=f"{cluster_name}-{operation}")
+        self.store.save(execution)
+        return execution
+
+    def start_execution(self, execution: DeployExecution, wait: bool = False) -> TaskRecord:
+        """Async dispatch, idempotent on execution id (reference
+        ``apply_async(task_id=execution.id)``, ``api.py:250-255``)."""
+        rec = self.tasks.submit(execution.id, f"{execution.project}:{execution.operation}",
+                                operations.run_execution, self, execution.id)
+        if wait:
+            self.tasks.wait(execution.id)
+        return rec
+
+    def run_operation(self, cluster_name: str, operation: str,
+                      params: dict | None = None) -> DeployExecution:
+        """Synchronous convenience: create + run + reload."""
+        execution = self.create_execution(cluster_name, operation, params)
+        self.start_execution(execution, wait=True)
+        return self.store.get(DeployExecution, execution.id, scoped=False)
+
+    def _plan_host_count(self, plan: Plan, params: dict | None) -> int:
+        params = params or {}
+        masters = self.catalog.template(plan.template)["masters"]
+        workers = int(params.get("worker_size", plan.worker_size))
+        tpu = 0
+        pools = params.get("tpu_pools")
+        from kubeoperator_tpu.resources.entities import TpuPool
+        pool_objs = [TpuPool(**p) for p in pools] if pools is not None else plan.pools()
+        for pool in pool_objs:
+            tpu += pool.count * self.catalog.slice(pool.slice_type).hosts
+        return masters + workers + tpu
+
+    # -- messages ----------------------------------------------------------
+    def notify(self, title: str, level: str = "INFO", project: str | None = None,
+               content: dict | None = None) -> Message:
+        msg = Message(title=title, level=level, project=project,
+                      content=content or {}, name=title[:64])
+        self.store.save(msg)
+        return msg
+
+    # -- users / tenancy ---------------------------------------------------
+    def create_user(self, name: str, password: str, email: str = "",
+                    is_admin: bool = False) -> User:
+        if self.store.get_by_name(User, name, scoped=False):
+            raise PlatformError(f"user {name!r} exists")
+        user = User(name=name, email=email, is_admin=is_admin)
+        user.set_password(password)
+        self.store.save(user)
+        return user
+
+    def create_item(self, name: str, description: str = "") -> Item:
+        item = Item(name=name, description=description)
+        self.store.save(item)
+        return item
+
+    def shutdown(self) -> None:
+        self.tasks.shutdown()

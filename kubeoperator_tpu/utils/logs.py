@@ -10,24 +10,28 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
 _initialized = False
+_init_lock = threading.Lock()
 
 
 def get_logger(name: str) -> logging.Logger:
     global _initialized
     if not _initialized:
-        root = logging.getLogger("kubeoperator_tpu")
-        h = logging.StreamHandler()
-        h.setFormatter(logging.Formatter(FORMAT))
-        root.addHandler(h)
-        level = os.environ.get("KO_LOG_LEVEL", "INFO").upper()
-        try:
-            root.setLevel(level)
-        except ValueError:
-            root.setLevel(logging.INFO)
-        _initialized = True
+        with _init_lock:
+            if not _initialized:
+                root = logging.getLogger("kubeoperator_tpu")
+                h = logging.StreamHandler()
+                h.setFormatter(logging.Formatter(FORMAT))
+                root.addHandler(h)
+                level = os.environ.get("KO_LOG_LEVEL", "INFO").upper()
+                try:
+                    root.setLevel(level)
+                except ValueError:
+                    root.setLevel(logging.INFO)
+                _initialized = True
     return logging.getLogger(name)
 
 

@@ -65,4 +65,16 @@ class SecretBox:
         return bytes(a ^ b for a, b in zip(ct, self._stream(nonce, len(ct)))).decode()
 
 
-DEFAULT_BOX = SecretBox()
+_default_box: SecretBox | None = None
+_default_key_env: str | None = None
+
+
+def default_box() -> SecretBox:
+    """Process-wide box, built lazily so KO_SECRET_KEY set during startup
+    (e.g. loaded from a KMS) is honored; rebuilt if the env value changes."""
+    global _default_box, _default_key_env
+    env = os.environ.get("KO_SECRET_KEY")
+    if _default_box is None or env != _default_key_env:
+        _default_box = SecretBox()
+        _default_key_env = env
+    return _default_box

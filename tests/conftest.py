@@ -1,0 +1,68 @@
+"""Test harness.
+
+Control-plane tests run entirely on fakes (FakeExecutor + fake terraform)
+— the CI-runnable install/scale/backup flows SURVEY §4 calls for.
+Workload tests force an 8-device virtual CPU mesh; the env vars must be
+set before jax is first imported, hence at module import here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest
+
+from kubeoperator_tpu.config.catalog import load_catalog
+from kubeoperator_tpu.config.loader import load_config
+from kubeoperator_tpu.engine.executor import FakeExecutor
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.services.platform import Platform
+
+
+@pytest.fixture
+def fake_executor():
+    return FakeExecutor()
+
+
+@pytest.fixture
+def platform(tmp_path, fake_executor):
+    cfg = load_config(overrides={
+        "data_dir": str(tmp_path / "data"),
+        "executor": "fake",
+        "terraform_bin": "",      # fake-apply
+        "task_workers": 2,
+        "node_forks": 8,
+    })
+    p = Platform(config=cfg, store=Store(), executor=fake_executor)
+    yield p
+    p.shutdown()
+
+
+CPU_FACTS = {"cpu_core": 8, "memory_mb": 32768, "os": "Ubuntu", "os_version": "22.04",
+             "disk_gb": 200}
+
+
+def make_tpu_facts(tpu_type: str, worker_id: int, node_name: str) -> dict:
+    return {**CPU_FACTS, "tpu_type": tpu_type, "tpu_worker_id": worker_id,
+            "tpu_env": f"NODE_NAME: '{node_name}'"}
+
+
+@pytest.fixture
+def manual_cluster(platform, fake_executor):
+    """1 master + 1 cpu worker + 1 single-host TPU worker (v4-8), MANUAL."""
+    cred = platform.create_credential("root-key", private_key="FAKE KEY")
+    fake_executor.host("10.0.0.1").facts.update(CPU_FACTS)
+    fake_executor.host("10.0.0.2").facts.update(CPU_FACTS)
+    fake_executor.host("10.0.0.3").facts.update(make_tpu_facts("v4-8", 0, "tpu-a"))
+    m = platform.register_host("demo-master-1", "10.0.0.1", cred.id)
+    w = platform.register_host("demo-worker-1", "10.0.0.2", cred.id)
+    t = platform.register_host("demo-tpu-1", "10.0.0.3", cred.id)
+    cluster = platform.create_cluster("demo", template="SINGLE",
+                                      network_plugin="calico",
+                                      storage_provider="local-volume",
+                                      configs={"registry": "reg.local:8082"})
+    platform.add_node(cluster, m, ["master"])
+    platform.add_node(cluster, w, ["worker"])
+    platform.add_node(cluster, t, ["tpu-worker"])
+    return cluster

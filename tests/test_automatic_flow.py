@@ -1,0 +1,102 @@
+"""AUTOMATIC clusters: GCE/TPU provider, zone IP pools, terraform-JSON
+rendering, scale up/down, uninstall (BASELINE configs 3-4 shape)."""
+
+import json
+import os
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    Cluster, DeployType, ExecutionState, Host, Node, Plan, Region, Zone,
+)
+from kubeoperator_tpu.services.platform import PlatformError
+
+
+@pytest.fixture
+def plan(platform):
+    region = Region(name="us-central2", provider="gce",
+                    vars={"project": "test-proj", "gce_region": "us-central2"})
+    platform.store.save(region)
+    zone = Zone(name="us-central2-b", region_id=region.id,
+                vars={"gce_zone": "us-central2-b"},
+                ip_pool=[f"10.1.0.{i}" for i in range(10, 40)])
+    platform.store.save(zone)
+    plan = Plan(name="tpu-plan", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=1,
+                tpu_pools=[{"slice_type": "v5e-8", "count": 1, "zone": zone.name}])
+    platform.store.save(plan)
+    return plan
+
+
+@pytest.fixture
+def auto_cluster(platform, plan):
+    return platform.create_cluster("auto", template="SINGLE",
+                                   deploy_type=DeployType.AUTOMATIC,
+                                   plan_id=plan.id,
+                                   configs={"registry": "reg.local:8082"})
+
+
+def test_automatic_install_provisions_slice(platform, fake_executor, auto_cluster, plan):
+    execution = platform.run_operation("auto", "install")
+    assert execution.state == ExecutionState.SUCCESS, execution.result
+
+    hosts = platform.store.find(Host, scoped=False, project="auto")
+    # 1 master + 1 worker + v5e-8 slice (2 hosts)
+    assert len(hosts) == 4
+    tpu_hosts = sorted((h for h in hosts if h.has_tpu), key=lambda h: h.tpu_worker_id)
+    assert len(tpu_hosts) == 2
+    assert {h.tpu_worker_id for h in tpu_hosts} == {0, 1}
+    assert all(h.tpu_slice_id == "auto-v5e-8-1" for h in tpu_hosts)
+    assert all(h.ip.startswith("10.1.0.") for h in hosts)
+
+    # terraform-JSON: one TPU VM resource per slice, instances for cpu hosts
+    tf_path = os.path.join(platform.config.terraform, "auto", "main.tf.json")
+    with open(tf_path) as f:
+        tf = json.load(f)
+    assert "google_tpu_v2_vm" in tf["resource"]
+    assert len(tf["resource"]["google_tpu_v2_vm"]) == 1
+    slice_res = next(iter(tf["resource"]["google_tpu_v2_vm"].values()))
+    assert slice_res["accelerator_type"] == "v5e-8"
+    assert len(tf["resource"]["google_compute_instance"]) == 2
+
+    # slice peers in tpu.env on both slice hosts
+    for h in tpu_hosts:
+        env = fake_executor.host(h.ip).files["/etc/kubeoperator/tpu.env"].decode()
+        assert f"TPU_WORKER_ID={h.tpu_worker_id}" in env
+        peers = env.split("TPU_WORKER_HOSTNAMES=")[1].splitlines()[0]
+        assert set(peers.split(",")) == {t.ip for t in tpu_hosts}
+
+
+def test_scale_workers_up_and_down(platform, fake_executor, auto_cluster):
+    assert platform.run_operation("auto", "install").state == ExecutionState.SUCCESS
+    ex = platform.run_operation("auto", "scale", {"worker_size": 3})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    workers = [h for h in platform.store.find(Host, scoped=False, project="auto")
+               if "-worker-" in h.name]
+    assert len(workers) == 3
+
+    ex = platform.run_operation("auto", "scale", {"worker_size": 1})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    workers = [h for h in platform.store.find(Host, scoped=False, project="auto")
+               if "-worker-" in h.name]
+    assert len(workers) == 1
+    # shrink drained via the master
+    assert fake_executor.ran("10.1.0.10", r"kubectl .*drain auto-worker-")
+
+
+def test_ip_preflight_rejects_oversized_plan(platform, plan, auto_cluster):
+    with pytest.raises(PlatformError, match="insufficient IPs"):
+        platform.create_execution("auto", "scale", {"worker_size": 100})
+
+
+def test_uninstall_recovers_ips(platform, auto_cluster, plan):
+    platform.run_operation("auto", "install")
+    zone_id = plan.zone_ids[0]
+    zone = platform.store.get(Zone, zone_id, scoped=False)
+    assert len(zone.ip_used) == 4
+    ex = platform.run_operation("auto", "uninstall")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    zone = platform.store.get(Zone, zone_id, scoped=False)
+    assert zone.ip_used == []
+    assert platform.store.find(Host, scoped=False, project="auto") == []
+    assert platform.store.find(Node, scoped=False, project="auto") == []

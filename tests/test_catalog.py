@@ -1,0 +1,63 @@
+import pytest
+
+from kubeoperator_tpu.config.catalog import load_catalog
+
+cat = load_catalog()
+
+
+def test_nine_operations_parity():
+    # reference config.yml:31-104 has 9 operations (bigip-config -> lb-config)
+    assert set(cat.operations) == {
+        "install", "uninstall", "upgrade", "scale", "add-worker",
+        "remove-worker", "backup", "restore", "lb-config",
+    }
+
+
+def test_operations_reference_defined_steps():
+    for op in cat.operations:
+        steps = cat.operation_steps(op)
+        assert steps, op
+        for s in steps:
+            assert s.module and s.targets
+
+
+def test_install_step_order():
+    names = [s.name for s in cat.operation_steps("install")]
+    assert names.index("etcd") < names.index("control-plane") < names.index("worker")
+    assert names.index("accelerator-stack") < names.index("accelerator-plugin")
+    assert names[-1] == "post-check"
+
+
+def test_step_modules_importable():
+    from kubeoperator_tpu.engine.steps import load_step
+    for step in cat.steps.values():
+        fn = load_step(step)
+        assert callable(fn), step.name
+
+
+def test_tpu_slice_topology():
+    s = cat.slice("v5e-16")
+    assert s.hosts == 4 and s.chips == 16 and s.chips_per_host == 4
+    assert cat.slice("v5p-64").hosts == 8
+    with pytest.raises(KeyError):
+        cat.slice("v99")
+
+
+def test_networks_and_storages():
+    assert {n["name"] for n in cat.networks} == {"flannel", "calico"}
+    names = {s["name"] for s in cat.storages}
+    assert {"nfs", "rook-ceph", "external-ceph", "local-volume", "gcp-pd"} <= names
+
+
+def test_accelerator_triples():
+    # GPU triple parity + TPU mirror (north star)
+    assert cat.accelerators["gpu"]["plugin"]["name"] == "nvidia-device-plugin"
+    assert cat.accelerators["tpu"]["plugin"]["name"] == "tpu-device-plugin"
+    assert cat.accelerators["tpu"]["node_var"] == "has_tpu"
+
+
+def test_host_grading():
+    assert cat.grade_host("SINGLE", "master", 4, 16) == "recommended"
+    assert cat.grade_host("SINGLE", "master", 2, 4) == "minimal"
+    assert cat.grade_host("SINGLE", "worker", 1, 2) == "unfit"
+    assert cat.grade_host("SINGLE", "worker", 8, 32, disk_gb=10) == "unfit"

@@ -1,0 +1,92 @@
+"""Day-2 operations on fakes: add/remove worker (incl. TPU slice-unit
+semantics), backup + retention, restore, upgrade."""
+
+import os
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    BackupStrategy, ClusterBackup, ExecutionState, Host, Node,
+)
+from tests.conftest import CPU_FACTS, make_tpu_facts
+
+
+@pytest.fixture
+def installed(platform, fake_executor, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    return manual_cluster
+
+
+def test_add_worker(platform, fake_executor, installed):
+    fake_executor.host("10.0.0.4").facts.update(CPU_FACTS)
+    h = platform.register_host("demo-worker-2", "10.0.0.4")
+    platform.add_node(installed, h, ["new_node"])
+    ex = platform.run_operation("demo", "add-worker")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert fake_executor.host("10.0.0.4").services.get("kubelet") == "started"
+
+
+def test_remove_worker(platform, fake_executor, installed):
+    ex = platform.run_operation("demo", "remove-worker",
+                                {"nodes": ["demo-worker-1"]})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert fake_executor.ran("10.0.0.1", r"drain demo-worker-1")
+    assert fake_executor.ran("10.0.0.1", r"delete node demo-worker-1")
+    # kubelet stopped on the removed host; host freed back to the pool
+    assert fake_executor.host("10.0.0.2").services.get("kubelet") == "stopped"
+    host = platform.store.get_by_name(Host, "demo-worker-1", scoped=False)
+    assert host.project is None
+    assert platform.store.get_by_name(Node, "demo-worker-1", scoped=False) is None
+
+
+def test_remove_tpu_worker_takes_whole_slice(platform, fake_executor, manual_cluster):
+    """A pod slice is one schedulable unit: removing one member must drain
+    every host of the slice (SURVEY §7 hard part (e))."""
+    fake_executor.host("10.0.0.5").facts.update(make_tpu_facts("v5e-8", 1, "tpu-b"))
+    fake_executor.host("10.0.0.6").facts.update(make_tpu_facts("v5e-8", 0, "tpu-b"))
+    h1 = platform.register_host("demo-tpu-b0", "10.0.0.6")
+    h2 = platform.register_host("demo-tpu-b1", "10.0.0.5")
+    platform.add_node(manual_cluster, h1, ["tpu-worker"])
+    platform.add_node(manual_cluster, h2, ["tpu-worker"])
+    assert platform.run_operation("demo", "install").state == ExecutionState.SUCCESS
+
+    ex = platform.run_operation("demo", "remove-worker", {"nodes": ["demo-tpu-b0"]})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    removed = ex.result["remove-node"]["removed"]
+    assert set(removed) == {"demo-tpu-b0", "demo-tpu-b1"}
+    # the unrelated v4-8 slice host is untouched
+    assert fake_executor.host("10.0.0.3").services.get("kubelet") == "started"
+
+
+def test_backup_restore_roundtrip(platform, fake_executor, installed):
+    ex = platform.run_operation("demo", "backup")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    backups = platform.store.find(ClusterBackup, scoped=False, project="demo")
+    assert len(backups) == 1
+    local = os.path.join(platform.config.backups,
+                         backups[0].folder.replace("/", os.sep))
+    assert os.path.exists(local)
+    assert fake_executor.ran("10.0.0.1", r"etcdctl .*snapshot save")
+
+    ex = platform.run_operation("demo", "restore")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert fake_executor.ran("10.0.0.1", r"etcdctl snapshot restore")
+    assert fake_executor.host("10.0.0.1").services.get("etcd") == "started"
+
+
+def test_backup_retention(platform, installed):
+    platform.store.save(BackupStrategy(project="demo", save_num=2, enabled=True))
+    for _ in range(4):
+        assert platform.run_operation("demo", "backup").state == ExecutionState.SUCCESS
+    backups = platform.store.find(ClusterBackup, scoped=False, project="demo")
+    assert len(backups) <= 2
+
+
+def test_upgrade(platform, fake_executor, installed):
+    ex = platform.run_operation("demo", "upgrade")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert fake_executor.ran("10.0.0.1", r"curl .*-o /opt/kube/bin/kube-apiserver")
+    assert fake_executor.ran("10.0.0.2", r"curl .*-o /opt/kube/bin/kubelet")
+    assert fake_executor.ran("10.0.0.1", r"cordon demo-worker-1")
+    assert fake_executor.ran("10.0.0.1", r"uncordon demo-worker-1")

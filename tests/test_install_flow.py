@@ -1,0 +1,75 @@
+"""End-to-end MANUAL install on fakes (BASELINE config 1+2 shape:
+master + cpu worker + single-host TPU worker)."""
+
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, ExecutionState, Host, StepState,
+)
+
+
+def test_install_succeeds(platform, fake_executor, manual_cluster):
+    execution = platform.run_operation("demo", "install")
+    assert execution.state == ExecutionState.SUCCESS, execution.result
+    assert all(s["status"] == StepState.SUCCESS for s in execution.steps)
+    assert execution.progress == 1.0
+
+    cluster = platform.store.get_by_name(Cluster, "demo", scoped=False)
+    assert cluster.status == ClusterStatus.RUNNING
+
+    # control plane converged on the master
+    master = fake_executor.host("10.0.0.1")
+    for unit in ("etcd", "kube-apiserver", "kube-controller-manager",
+                 "kube-scheduler"):
+        assert master.services.get(unit) == "started", unit
+    # kubelet on both workers
+    for ip in ("10.0.0.2", "10.0.0.3"):
+        assert fake_executor.host(ip).services.get("kubelet") == "started", ip
+    # network + storage + addons applied
+    assert fake_executor.ran("10.0.0.1", r"kubectl .*apply -f .*network-calico")
+    assert fake_executor.ran("10.0.0.1", r"kubectl .*apply -f .*storage-local-volume")
+    assert fake_executor.ran("10.0.0.1", r"kubectl .*apply -f .*app-coredns")
+
+
+def test_tpu_triple_applied(platform, fake_executor, manual_cluster):
+    platform.run_operation("demo", "install")
+    tpu = fake_executor.host("10.0.0.3")
+    # part 1: libtpu converged
+    assert "/lib/libtpu.so" in tpu.files
+    # part 2: slice-discovery env
+    env = tpu.files["/etc/kubeoperator/tpu.env"].decode()
+    assert "TPU_ACCELERATOR_TYPE=v4-8" in env
+    assert "TPU_WORKER_ID=0" in env
+    assert "TPU_WORKER_HOSTNAMES=10.0.0.3" in env
+    # part 3: device plugin DS + labels + slice taint from the master
+    assert fake_executor.ran("10.0.0.1", r"apply -f .*tpu-device-plugin")
+    assert fake_executor.ran("10.0.0.1", r"label node demo-tpu-1 .*ko.tpu/type=v4-8")
+    assert fake_executor.ran("10.0.0.1", r"taint node demo-tpu-1 google.com/tpu")
+    # cpu worker got no TPU stack
+    assert "/lib/libtpu.so" not in fake_executor.host("10.0.0.2").files
+
+
+def test_install_failure_marks_cluster_error(platform, fake_executor, manual_cluster):
+    fake_executor.fail_on("10.0.0.2", r"systemctl restart kubelet")
+    execution = platform.run_operation("demo", "install")
+    assert execution.state == ExecutionState.FAILURE
+    assert "worker" in execution.result["error"]
+    cluster = platform.store.get_by_name(Cluster, "demo", scoped=False)
+    assert cluster.status == ClusterStatus.ERROR
+    statuses = {s["name"]: s["status"] for s in execution.steps}
+    assert statuses["worker"] == StepState.ERROR
+    assert statuses["network"] == StepState.PENDING   # stopped at failure
+
+
+def test_install_is_idempotent(platform, fake_executor, manual_cluster):
+    first = platform.run_operation("demo", "install")
+    assert first.state == ExecutionState.SUCCESS
+    second = platform.run_operation("demo", "install")
+    assert second.state == ExecutionState.SUCCESS
+
+
+def test_facts_gathered_on_register(platform, manual_cluster):
+    host = platform.store.get_by_name(Host, "demo-tpu-1", scoped=False)
+    assert host.cpu_core == 8 and host.memory_gb == 32
+    assert host.has_tpu and host.tpu_type == "v4-8"
+    assert host.tpu_slice_id == "tpu-a"
+    cpu = platform.store.get_by_name(Host, "demo-worker-1", scoped=False)
+    assert not cpu.has_tpu and not cpu.has_gpu
