@@ -48,7 +48,8 @@ def gather_facts(executor: Executor, conn: Conn) -> dict:
     r = executor.run(conn, "lspci 2>/dev/null | grep -i nvidia | wc -l")
     gpu_num = int(r.stdout.strip() or 0) if r.ok else 0
     # TPU probe (GCE metadata; empty/unreachable on non-TPU machines)
-    r = executor.run(conn, f"curl -s --max-time 3 {MD_HDR} "
+    # -f: a 404 body from the metadata server must not read as a TPU type
+    r = executor.run(conn, f"curl -sf --max-time 3 {MD_HDR} "
                            f"{METADATA}/attributes/accelerator-type || true")
     tpu_type = r.stdout.strip() if r.ok else ""
     if tpu_type:

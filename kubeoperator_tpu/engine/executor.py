@@ -16,6 +16,7 @@ build on:
 
 from __future__ import annotations
 
+import atexit
 import os
 import re
 import shlex
@@ -115,6 +116,9 @@ class SSHExecutor(Executor):
         self.connect_timeout = connect_timeout
         self._keyfiles: dict[str, str] = {}
         self._lock = threading.Lock()
+        # decrypted keys must not outlive the process: without this, the
+        # SecretBox at-rest encryption is defeated by plaintext in /tmp
+        atexit.register(self.cleanup_keys)
 
     def _key_path(self, conn: Conn) -> str | None:
         if not conn.private_key:
@@ -128,6 +132,15 @@ class SSHExecutor(Executor):
                 os.chmod(path, 0o600)
                 self._keyfiles[digest] = path
             return self._keyfiles[digest]
+
+    def cleanup_keys(self) -> None:
+        with self._lock:
+            for path in self._keyfiles.values():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self._keyfiles.clear()
 
     def _base(self, conn: Conn) -> list[str]:
         args = [

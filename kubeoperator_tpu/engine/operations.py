@@ -101,7 +101,7 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
             execution.steps[i]["status"] = StepState.SUCCESS
             if isinstance(result, dict):
                 execution.result[step_def.name] = result
-        except (StepError, Exception) as e:  # noqa: BLE001 — step boundary
+        except Exception as e:  # noqa: BLE001 — step boundary
             error = f"{step_def.name}: {e}"
             execution.steps[i]["status"] = StepState.ERROR
             execution.steps[i]["message"] = str(e)
@@ -123,8 +123,6 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
     else:
         execution.state = ExecutionState.SUCCESS
         cluster.status = DONE_STATUS.get(execution.operation, ClusterStatus.RUNNING)
-        if execution.operation == "uninstall":
-            cluster.status = ClusterStatus.READY
         if execution.operation in ("scale", "add-worker"):
             _exit_new_node(store, cluster)
     store.save(execution)

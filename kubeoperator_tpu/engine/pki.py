@@ -71,8 +71,10 @@ class ClusterPKI:
             alt = ",".join(
                 (f"IP:{s}" if s.replace(".", "").isdigit() else f"DNS:{s}") for s in sans
             )
-            ext_file = self.path(f"{name}.ext")
-            with open(ext_file, "w") as f:
+            # bare filename: openssl runs with cwd=self.dir, and self.dir may
+            # itself be relative — a self.path() here would resolve doubled
+            ext_file = f"{name}.ext"
+            with open(self.path(ext_file), "w") as f:
                 f.write(f"subjectAltName={alt}\n")
         _run(req, self.dir)
         sign = ["openssl", "x509", "-req", "-in", f"{name}.csr", "-CA", "ca.crt",

@@ -15,6 +15,7 @@ unreachable.
 
 from __future__ import annotations
 
+import contextvars
 import importlib
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -77,7 +78,10 @@ class StepContext:
         failures: dict[str, str] = {}
         workers = max(1, min(int(self.config.get("node_forks", 10)), len(targets)))
         with ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-fanout") as pool:
-            futs = {pool.submit(fn, th): th for th in targets}
+            # copy_context per host: worker threads inherit CURRENT_TASK so
+            # their log records reach the owning task's log file
+            futs = {pool.submit(contextvars.copy_context().run, fn, th): th
+                    for th in targets}
             for fut, th in futs.items():
                 try:
                     results[th.name] = fut.result()

@@ -51,7 +51,11 @@ def run(ctx: StepContext):
         old_path = os.path.join(ctx.config.backups, old.folder.replace("/", os.sep))
         if os.path.exists(old_path):
             os.remove(old_path)
-        if storage and old.backup_storage_id:
-            storage_client(storage, ctx.config).delete(old.folder)
+        if old.backup_storage_id:
+            # each backup's object lives in ITS storage, not the current run's
+            old_storage = ctx.store.get(BackupStorage, old.backup_storage_id,
+                                        scoped=False)
+            if old_storage:
+                storage_client(old_storage, ctx.config).delete(old.folder)
         ctx.store.delete(ClusterBackup, old.id)
     return {"backup": backup.name, "size": len(data)}

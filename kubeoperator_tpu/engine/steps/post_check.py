@@ -16,7 +16,9 @@ def run(ctx: StepContext):
         r = o.sh(f"{k8s.KUBECTL} get nodes --no-headers", timeout=60)
         lines = [ln.split() for ln in r.stdout.strip().splitlines() if ln.strip()]
         seen = {parts[0] for parts in lines}
-        not_ready = [parts[0] for parts in lines if len(parts) > 1 and "Ready" not in parts[1]]
+        # exact status-token match: "NotReady" contains "Ready" as a substring
+        not_ready = [parts[0] for parts in lines
+                     if len(parts) > 1 and "Ready" not in parts[1].split(",")]
         missing = expected - seen
         if missing:
             raise StepError(f"nodes never registered: {sorted(missing)}")

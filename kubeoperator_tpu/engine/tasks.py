@@ -22,7 +22,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from kubeoperator_tpu.utils.logs import TaskLogHandler, get_logger
+from kubeoperator_tpu.utils.logs import CURRENT_TASK, TaskLogHandler, get_logger
 from kubeoperator_tpu.utils.timeutil import iso
 
 log = get_logger(__name__)
@@ -63,7 +63,8 @@ class TaskEngine:
     def _run(self, rec: TaskRecord, fn: Callable, args: tuple, kwargs: dict) -> Any:
         rec.state = "STARTED"
         rec.started_at = iso()
-        handler = TaskLogHandler(self.task_log_path(rec.id))
+        token = CURRENT_TASK.set(rec.id)
+        handler = TaskLogHandler(self.task_log_path(rec.id), task_id=rec.id)
         root = logging.getLogger("kubeoperator_tpu")
         root.addHandler(handler)
         try:
@@ -77,6 +78,7 @@ class TaskEngine:
             return None
         finally:
             rec.finished_at = iso()
+            CURRENT_TASK.reset(token)
             root.removeHandler(handler)
             handler.close()
 
@@ -118,7 +120,10 @@ class TaskEngine:
                 return
             t = threading.Timer(interval_s, tick)
             t.daemon = True
-            self._periodic.append(t)
+            with self._lock:
+                # prune fired timers so the list doesn't grow one entry per tick
+                self._periodic = [p for p in self._periodic if p.is_alive()]
+                self._periodic.append(t)
             t.start()
 
         schedule()

@@ -116,6 +116,13 @@ class GceTpuProvider(CloudProvider):
         return {**state, "removed": sorted(h.name for h in hosts)}
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _effective_pools(ctx, plan: Plan) -> list[TpuPool]:
+        """Operation params may override the plan's pools (e.g. scale adds a
+        pool type the plan never had); every consumer must agree on the set."""
+        pools = ctx.params.get("tpu_pools")
+        return [TpuPool(**p) for p in pools] if pools is not None else plan.pools()
+
     def _desired(self, ctx, plan: Plan) -> list[dict]:
         """Expand plan (+operation params) into named host specs."""
         cluster = ctx.cluster
@@ -127,8 +134,7 @@ class GceTpuProvider(CloudProvider):
         worker_size = int(ctx.params.get("worker_size", plan.worker_size))
         for i in range(worker_size):
             out.append({"name": f"{cluster.name}-worker-{i + 1}", "role": "worker"})
-        pools = ctx.params.get("tpu_pools")
-        pools = [TpuPool(**p) for p in pools] if pools is not None else plan.pools()
+        pools = self._effective_pools(ctx, plan)
         for pool in pools:
             topo = cat.slice(pool.slice_type)
             for s in range(pool.count):
@@ -187,7 +193,8 @@ class GceTpuProvider(CloudProvider):
                 if h.tpu_slice_id in seen_slices:
                     continue
                 seen_slices.add(h.tpu_slice_id)
-                pool = next((p for p in plan.pools() if p.slice_type == h.tpu_type), None)
+                pool = next((p for p in self._effective_pools(ctx, plan)
+                             if p.slice_type == h.tpu_type), None)
                 tpu_vms[h.tpu_slice_id.replace(".", "-")] = {
                     "name": h.tpu_slice_id,
                     "zone": zone_name,

@@ -1,0 +1,170 @@
+"""Decoder-only transformer LM — the long-context workload.
+
+TPU-first design:
+
+* every weight carries flax *logical* axis names; the single rules table in
+  ``sharding.logical_axis_rules`` maps them onto the mesh (fsdp for ZeRO-3,
+  tp for megatron splits, sp for ring attention) — model code never mentions
+  a physical axis.
+* layers are stacked with ``nn.scan`` + ``nn.remat``: one compiled block
+  body regardless of depth (fast compiles, constant HBM for activations) —
+  the TPU-idiomatic replacement for pipeline-parallel stages.
+* attention runs as ring attention over the ``sp`` axis when the sequence is
+  sharded (see ring_attention.py), plain fused attention otherwise.
+* RMSNorm + SwiGLU + RoPE, bf16 activations, f32 params/softmax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeoperator_tpu.workloads import ring_attention as ra
+
+with_parts = nn.with_logical_partitioning
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1376            # ~8/3 · d_model, multiple of 32
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    ring: bool = False          # use ring attention (sequence sharded on 'sp')
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, base: float = 10_000.0) -> jnp.ndarray:
+    """Rotary embeddings. x: [B, T, H, D], positions: [T] global indices."""
+    d = x.shape[-1]
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]   # [T, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos[None, :, None] - x2 * sin[None, :, None],
+                         x1 * sin[None, :, None] + x2 * cos[None, :, None]], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", with_parts(nn.initializers.ones_init(), ("embed",)),
+                           (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None            # required when cfg.ring (shard_map needs it)
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype)
+        q = dense(features=(cfg.n_heads, cfg.head_dim),
+                  kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                         ("embed", "heads", "kv")), name="q")(x)
+        k = dense(features=(cfg.n_heads, cfg.head_dim),
+                  kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                         ("embed", "heads", "kv")), name="k")(x)
+        v = dense(features=(cfg.n_heads, cfg.head_dim),
+                  kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                         ("embed", "heads", "kv")), name="v")(x)
+        q, k = rope(q, positions), rope(k, positions)
+        if cfg.ring and self.mesh is not None and "sp" in self.mesh.axis_names:
+            # GSPMD outside, manual collectives inside: shard_map hands each
+            # device its [B, T/sp, H/tp, D] block; K/V ride the ring.
+            out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
+        else:
+            out = ra.reference_attention(q, k, v, causal=True)
+        return dense(features=x.shape[-1], axis=(-2, -1),
+                     kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                            ("heads", "kv", "embed")), name="o")(out)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype)
+        gate = dense(cfg.d_ff, kernel_init=with_parts(
+            nn.initializers.lecun_normal(), ("embed", "mlp")), name="gate")(x)
+        up = dense(cfg.d_ff, kernel_init=with_parts(
+            nn.initializers.lecun_normal(), ("embed", "mlp")), name="up")(x)
+        return dense(cfg.d_model, kernel_init=with_parts(
+            nn.initializers.lecun_normal(), ("mlp", "embed")), name="down")(
+            nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    """One decoder layer; returns a (carry, out) pair so it can be the body
+    of ``nn.scan`` directly."""
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x), positions)
+        x = x + Mlp(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        return x, None
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, positions: jnp.ndarray | None = None):
+        """tokens: [B, T_local] int32; positions: [T_local] global indices
+        (supplied by the trainer when the sequence is sp-sharded)."""
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        emb = self.param("embedding", with_parts(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model))
+        x = emb[tokens].astype(cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+        # nn.scan stacks layer params on a leading 'layers' axis: one traced
+        # body for all depths — compile time and HBM stay flat as n_layers grows
+        stacked = nn.scan(
+            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            in_axes=nn.broadcast, length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, self.mesh, name="layers")
+        x, _ = stacked(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                            emb.astype(jnp.float32))
+        return logits
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Forward FLOPs/token: 6·N_params-ish matmul term + attention term."""
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    per_layer = 2 * (4 * d * d + 3 * d * f)           # qkvo + swiglu matmuls
+    attn = 2 * 2 * seq_len * d                        # qk^T + pv, per token
+    embed = 2 * d * cfg.vocab_size                    # logits matmul
+    return l * (per_layer + attn) + embed

@@ -8,8 +8,16 @@ set before jax is first imported, hence at module import here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# The image's sitecustomize imports jax at interpreter start and pins
+# JAX_PLATFORMS=axon (the single real TPU chip), so env vars set here are
+# too late — override through jax.config before any backend initialises.
+# Tests want the virtual 8-device CPU mesh regardless of real hardware.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

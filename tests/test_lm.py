@@ -1,0 +1,100 @@
+"""Transformer / ring-attention tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.workloads import ring_attention as ra
+from kubeoperator_tpu.workloads.lm import LMTrainer
+from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig, flops_per_token, rope,
+)
+
+TINY = TransformerConfig(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_seq_len=128, dtype=jnp.float32,
+                         remat=False)
+
+
+def test_rope_rotates():
+    x = jnp.ones((1, 8, 2, 16))
+    out = rope(x, jnp.arange(8))
+    assert out.shape == x.shape
+    # position 0 is identity
+    np.testing.assert_allclose(out[:, 0], x[:, 0], atol=1e-6)
+    assert not np.allclose(out[:, 5], x[:, 5])
+
+
+def test_ring_attention_matches_reference():
+    """Ring attention over sp=4 == plain causal attention, to float tolerance."""
+    b, t, h, d = 2, 32, 4, 16
+    rng = jax.random.key(0)
+    q, k, v = (jax.random.normal(r, (b, t, h, d), jnp.float32)
+               for r in jax.random.split(rng, 3))
+    expected = ra.reference_attention(q, k, v, causal=True)
+
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    got = ra.sharded_ring_attention(mesh, q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_noncausal():
+    b, t, h, d = 1, 16, 2, 8
+    q, k, v = (jax.random.normal(r, (b, t, h, d), jnp.float32)
+               for r in jax.random.split(jax.random.key(1), 3))
+    mesh = build_mesh(MeshSpec(dp=1, sp=8))
+    got = ra.sharded_ring_attention(mesh, q, k, v, causal=False)
+    expected = ra.reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_transformer_forward():
+    model = Transformer(TINY)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, TINY.vocab_size)
+    # scan stacked the blocks: params carry a leading layers axis
+    flat = jax.tree.leaves(params)
+    assert any(p.shape[0] == TINY.n_layers for p in flat if p.ndim >= 2)
+
+
+def test_lm_trainer_fsdp_tp():
+    tr = LMTrainer(TINY, MeshSpec(fsdp=2, tp=4))
+    state = tr.init_state()
+    tokens = tr.synthetic_batch(batch=4, seq_len=32)
+    state, m = tr.train_step(state, tokens)
+    assert np.isfinite(float(m["loss"]))
+    assert int(state["step"]) == 1
+    # embedding sharded over tp (vocab) per the rules
+    emb = state["params"]["embedding"]
+    assert "tp" in jax.tree.leaves(tuple(emb.sharding.spec)) or emb.sharding.spec != jax.sharding.PartitionSpec()
+
+
+def test_lm_trainer_ring_sp():
+    """Full train step with dp×sp mesh and ring attention enabled."""
+    tr = LMTrainer(TINY, MeshSpec(dp=2, sp=4))
+    assert tr.cfg.ring
+    state = tr.init_state()
+    tokens = tr.synthetic_batch(batch=2, seq_len=32)
+    state, m = tr.train_step(state, tokens)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_lm_sp_matches_dp_loss():
+    """Ring-attention sharding must not change the numbers."""
+    losses = []
+    for spec in (MeshSpec(dp=8), MeshSpec(dp=2, sp=4)):
+        tr = LMTrainer(TINY, spec)
+        state = tr.init_state(jax.random.key(3))
+        tokens = tr.synthetic_batch(batch=8, seq_len=32, seed=5)
+        _, m = tr.train_step(state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-3)
+
+
+def test_flops_per_token_positive():
+    assert flops_per_token(TINY, 128) > 0
