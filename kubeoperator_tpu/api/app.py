@@ -1,0 +1,603 @@
+"""aiohttp application exposing the reference's REST/WS surface.
+
+Route parity map (reference ``kubeops_api/api_url.py:15-60``):
+clusters, nested executions/nodes/configs, kubeconfig download, cluster
+token, webkubectl token, health, grade, backups + restore, hosts (+bulk
+import), credentials, packages, regions/zones/plans, items (+members/
+resources), users, settings, messages, dashboard; WS progress + task-log
+streaming (``kubeoperator/routing.py:10-18``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import csv
+import io
+import json
+import secrets
+from dataclasses import asdict
+from functools import partial
+from typing import Any, Callable
+
+from aiohttp import WSMsgType, web
+
+from kubeoperator_tpu.api import auth
+from kubeoperator_tpu.resources.entities import (
+    BackupStorage, BackupStrategy, Cluster, ClusterBackup, Credential,
+    DeployExecution, HealthRecord, Host, Item, ItemResource, Message, Node,
+    Package, Plan, Region, User, Zone,
+)
+from kubeoperator_tpu.resources.entities import Setting
+from kubeoperator_tpu.services.platform import Platform, PlatformError
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+HIDDEN_FIELDS = {"password", "password_hash", "salt", "private_key"}
+PUBLIC_ROUTES = {("POST", "/api/v1/auth/login"), ("GET", "/healthz")}
+
+
+def dump(entity: Any) -> dict:
+    d = asdict(entity) if not isinstance(entity, dict) else dict(entity)
+    for k in HIDDEN_FIELDS & d.keys():
+        d[k] = "***" if d[k] else ""
+    if isinstance(d.get("configs"), dict):
+        # underscore-prefixed config keys are platform-internal secrets
+        # (e.g. _sa_token) — never serve them on the ordinary read path
+        d["configs"] = {k: v for k, v in d["configs"].items()
+                        if not k.startswith("_")}
+    return d
+
+
+def json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+async def _sync(request_or_app, fn: Callable, *args, **kwargs):
+    app = request_or_app.app if isinstance(request_or_app, web.Request) else request_or_app
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(None, partial(fn, *args, **kwargs))
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except PlatformError as e:
+        return json_error(400, str(e))
+    except (KeyError, json.JSONDecodeError) as e:
+        return json_error(400, f"bad request: {e}")
+    except Exception as e:  # noqa: BLE001 — API boundary
+        log.error("unhandled API error on %s %s: %r", request.method, request.path, e)
+        return json_error(500, f"{type(e).__name__}: {e}")
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    protected = request.path.startswith("/api") or request.path.startswith("/ws")
+    if (request.method, request.path) in PUBLIC_ROUTES or not protected:
+        return await handler(request)
+    platform: Platform = request.app["platform"]
+    header = request.headers.get("Authorization", "")
+    token = header[7:] if header.startswith("Bearer ") else request.query.get("token", "")
+    if not token:
+        return json_error(401, "missing bearer token")
+    try:
+        claims = auth.decode(token, platform.config.auth_secret)
+    except auth.AuthError as e:
+        return json_error(401, str(e))
+    user = await _sync(request, platform.store.get_by_name, User, claims["sub"], scoped=False)
+    if user is None:
+        return json_error(401, "user no longer exists")
+    request["user"] = user
+    return await handler(request)
+
+
+def require_admin(request: web.Request) -> None:
+    if not request["user"].is_admin:
+        raise web.HTTPForbidden(text=json.dumps({"error": "admin required"}),
+                                content_type="application/json")
+
+
+def check_cluster_access(request: web.Request, name: str, write: bool = False) -> None:
+    """Per-cluster guard (reference item-scopes destroy/list, ``api.py:49-119``):
+    admins pass; members need the cluster mapped into one of their items, and
+    MANAGER role for mutating/sensitive operations."""
+    user: User = request["user"]
+    if user.is_admin:
+        return
+    platform: Platform = request.app["platform"]
+    items = {i.id: i.name for i in platform.store.find(Item, scoped=False)
+             if i.name in user.item_roles}
+    for res in platform.store.find(ItemResource, scoped=False,
+                                   resource_type="cluster", name=name):
+        item_name = items.get(res.item_id)
+        if item_name is None:
+            continue
+        if not write or user.item_roles.get(item_name) == "MANAGER":
+            return
+    raise web.HTTPForbidden(
+        text=json.dumps({"error": f"no {'manager ' if write else ''}access to cluster {name!r}"}),
+        content_type="application/json")
+
+
+def visible_cluster_names(request: web.Request) -> set[str] | None:
+    """Item scoping (reference ``api.py:49-76``): admins see everything,
+    members see clusters mapped into their items. None = unrestricted."""
+    user: User = request["user"]
+    if user.is_admin:
+        return None
+    platform: Platform = request.app["platform"]
+    names: set[str] = set()
+    item_ids = {i.id for i in platform.store.find(Item, scoped=False)
+                if i.name in user.item_roles}
+    for res in platform.store.find(ItemResource, scoped=False, resource_type="cluster"):
+        if res.item_id in item_ids:
+            names.add(res.name)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# auth + profile
+# ---------------------------------------------------------------------------
+
+async def login(request: web.Request) -> web.Response:
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    user = await _sync(request, platform.store.get_by_name, User,
+                       body.get("username", ""), scoped=False)
+    if user is None or not user.check_password(body.get("password", "")):
+        return json_error(401, "invalid credentials")
+    token = auth.encode({"sub": user.name, "adm": user.is_admin},
+                        platform.config.auth_secret,
+                        ttl_s=int(platform.config.token_ttl_hours) * 3600)
+    return web.json_response({"token": token, "user": dump(user)})
+
+
+async def profile(request: web.Request) -> web.Response:
+    return web.json_response(dump(request["user"]))
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+# ---------------------------------------------------------------------------
+# generic CRUD
+# ---------------------------------------------------------------------------
+
+def register_crud(app: web.Application, path: str, cls: type,
+                  create: Callable[[Platform, dict], Any] | None = None,
+                  admin_write: bool = True) -> None:
+    async def list_(request: web.Request) -> web.Response:
+        items = await _sync(request, request.app["platform"].store.find, cls, scoped=False)
+        return web.json_response([dump(i) for i in items])
+
+    async def get_(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        item = await _sync(request, request.app["platform"].store.get_by_name,
+                           cls, name, scoped=False)
+        if item is None:
+            return json_error(404, f"{cls.KIND} {name!r} not found")
+        return web.json_response(dump(item))
+
+    async def post_(request: web.Request) -> web.Response:
+        if admin_write:
+            require_admin(request)
+        body = await request.json()
+        platform = request.app["platform"]
+        if create is not None:
+            entity = await _sync(request, create, platform, body)
+        else:
+            entity = cls(**body)
+            await _sync(request, platform.store.save, entity)
+        return web.json_response(dump(entity), status=201)
+
+    async def delete_(request: web.Request) -> web.Response:
+        if admin_write:
+            require_admin(request)
+        name = request.match_info["name"]
+        platform = request.app["platform"]
+        item = await _sync(request, platform.store.get_by_name, cls, name, scoped=False)
+        if item is None:
+            return json_error(404, f"{cls.KIND} {name!r} not found")
+        await _sync(request, platform.store.delete, cls, item.id)
+        return web.json_response({"deleted": name})
+
+    app.router.add_get(path, list_)
+    app.router.add_post(path, post_)
+    app.router.add_get(path + "/{name}", get_)
+    app.router.add_delete(path + "/{name}", delete_)
+
+
+# ---------------------------------------------------------------------------
+# clusters + executions
+# ---------------------------------------------------------------------------
+
+async def list_clusters(request: web.Request) -> web.Response:
+    platform: Platform = request.app["platform"]
+    visible = await _sync(request, visible_cluster_names, request)
+    clusters = await _sync(request, platform.store.find, Cluster, scoped=False)
+    out = [dump(c) for c in clusters if visible is None or c.name in visible]
+    return web.json_response(out)
+
+async def create_cluster(request: web.Request) -> web.Response:
+    require_admin(request)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    cluster = await _sync(
+        request, platform.create_cluster, body["name"],
+        template=body.get("template", "SINGLE"),
+        deploy_type=body.get("deploy_type", "MANUAL"),
+        network_plugin=body.get("network_plugin", "calico"),
+        network_config=body.get("network_config"),
+        storage_provider=body.get("storage_provider", "local-volume"),
+        storage_config=body.get("storage_config"),
+        plan_id=body.get("plan_id", ""), package=body.get("package", ""),
+        item=body.get("item", ""), configs=body.get("configs"))
+    return web.json_response(dump(cluster), status=201)
+
+async def get_cluster(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    platform: Platform = request.app["platform"]
+    cluster = await _sync(request, platform.store.get_by_name, Cluster,
+                          request.match_info["name"], scoped=False)
+    if cluster is None:
+        return json_error(404, "cluster not found")
+    return web.json_response(dump(cluster))
+
+async def delete_cluster(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=True)
+    platform: Platform = request.app["platform"]
+    force = request.query.get("force", "").lower() in ("1", "true")
+    await _sync(request, platform.delete_cluster, request.match_info["name"], force)
+    return web.json_response({"deleted": request.match_info["name"]})
+
+async def list_nodes(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    platform: Platform = request.app["platform"]
+    nodes = await _sync(request, platform.store.find, Node, scoped=False,
+                        project=request.match_info["name"])
+    return web.json_response([dump(n) for n in nodes])
+
+async def list_executions(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    platform: Platform = request.app["platform"]
+    exs = await _sync(request, platform.store.find, DeployExecution, scoped=False,
+                      project=request.match_info["name"])
+    exs.sort(key=lambda e: e.created_at, reverse=True)
+    return web.json_response([dump(e) for e in exs])
+
+async def create_execution(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=True)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    execution = await _sync(request, platform.create_execution,
+                            request.match_info["name"], body["operation"],
+                            body.get("params") or {})
+    await _sync(request, platform.start_execution, execution)
+    return web.json_response(dump(execution), status=201)
+
+async def get_execution(request: web.Request) -> web.Response:
+    platform: Platform = request.app["platform"]
+    ex = await _sync(request, platform.store.get, DeployExecution,
+                     request.match_info["id"], scoped=False)
+    if ex is None:
+        return json_error(404, "execution not found")
+    return web.json_response(dump(ex))
+
+async def get_kubeconfig(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=True)
+    """Reference ``fetch_config`` (``cluster.py:342-349``) — download the
+    admin kubeconfig assembled from the cluster PKI."""
+    platform: Platform = request.app["platform"]
+    name = request.match_info["name"]
+    text = await _sync(request, platform.cluster_kubeconfig, name)
+    return web.Response(text=text, content_type="text/yaml",
+                        headers={"Content-Disposition":
+                                 f'attachment; filename="{name}-kubeconfig.yaml"'})
+
+async def get_cluster_token(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=True)
+    platform: Platform = request.app["platform"]
+    token = await _sync(request, platform.cluster_token, request.match_info["name"])
+    return web.json_response({"token": token})
+
+async def webkubectl_token(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=True)
+    """Reference ``get_webkubectl_token`` (``cluster.py:395-402``): a
+    session token for the in-browser kubectl sidecar."""
+    platform: Platform = request.app["platform"]
+    name = request.match_info["name"]
+    cluster = await _sync(request, platform.store.get_by_name, Cluster, name,
+                          scoped=False)
+    if cluster is None:
+        return json_error(404, "cluster not found")
+    return web.json_response({"token": secrets.token_urlsafe(16), "cluster": name})
+
+async def cluster_health(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    platform: Platform = request.app["platform"]
+    records = await _sync(request, platform.store.find, HealthRecord, scoped=False,
+                          project=request.match_info["name"])
+    records.sort(key=lambda r: r.created_at, reverse=True)
+    return web.json_response([dump(r) for r in records[:200]])
+
+async def cluster_grade(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    from kubeoperator_tpu.services import grade as grade_svc
+    platform: Platform = request.app["platform"]
+    cluster = await _sync(request, platform.store.get_by_name, Cluster,
+                          request.match_info["name"], scoped=False)
+    if cluster is None:
+        return json_error(404, "cluster not found")
+    report = await _sync(request, grade_svc.grade_cluster, platform, cluster)
+    return web.json_response(report)
+
+async def list_backups(request: web.Request) -> web.Response:
+    check_cluster_access(request, request.match_info["name"], write=False)
+    platform: Platform = request.app["platform"]
+    backups = await _sync(request, platform.store.find, ClusterBackup, scoped=False,
+                          project=request.match_info["name"])
+    return web.json_response([dump(b) for b in backups])
+
+async def dashboard(request: web.Request) -> web.Response:
+    from kubeoperator_tpu.services import monitor as monitor_svc
+    platform: Platform = request.app["platform"]
+    data = await _sync(request, monitor_svc.dashboard_data, platform,
+                       request.match_info.get("item", ""))
+    return web.json_response(data)
+
+
+# ---------------------------------------------------------------------------
+# hosts
+# ---------------------------------------------------------------------------
+
+async def list_hosts(request: web.Request) -> web.Response:
+    platform: Platform = request.app["platform"]
+    hosts = await _sync(request, platform.store.find, Host, scoped=False)
+    return web.json_response([dump(h) for h in hosts])
+
+async def create_host(request: web.Request) -> web.Response:
+    require_admin(request)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    host = await _sync(request, platform.register_host, body["name"], body["ip"],
+                       body.get("credential_id", ""), int(body.get("port", 22)),
+                       bool(body.get("gather", True)))
+    return web.json_response(dump(host), status=201)
+
+async def delete_host(request: web.Request) -> web.Response:
+    require_admin(request)
+    platform: Platform = request.app["platform"]
+    await _sync(request, platform.delete_host, request.match_info["name"])
+    return web.json_response({"deleted": request.match_info["name"]})
+
+async def import_hosts(request: web.Request) -> web.Response:
+    """Bulk host import. The reference parses an Excel sheet
+    (``host_import.py:12-62``); openpyxl isn't in this image so the portal
+    uploads CSV with the same columns: name,ip,port,credential."""
+    require_admin(request)
+    platform: Platform = request.app["platform"]
+    text = (await request.read()).decode("utf-8-sig")
+    created, errors = [], []
+
+    def _import():
+        for i, row in enumerate(csv.DictReader(io.StringIO(text))):
+            try:
+                cred = platform.store.get_by_name(
+                    Credential, (row.get("credential") or "").strip(), scoped=False)
+                host = platform.register_host(
+                    row["name"].strip(), row["ip"].strip(),
+                    cred.id if cred else "", int(row.get("port") or 22),
+                    gather=False)
+                created.append(host.name)
+            except Exception as e:  # noqa: BLE001 — per-row boundary
+                errors.append({"row": i + 1, "error": str(e)})
+
+    await _sync(request, _import)
+    return web.json_response({"created": created, "errors": errors},
+                             status=201 if not errors else 207)
+
+
+# ---------------------------------------------------------------------------
+# items / users / settings / messages / packages
+# ---------------------------------------------------------------------------
+
+async def add_item_member(request: web.Request) -> web.Response:
+    require_admin(request)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    item = await _sync(request, platform.store.get_by_name, Item,
+                       request.match_info["name"], scoped=False)
+    user = await _sync(request, platform.store.get_by_name, User,
+                       body["username"], scoped=False)
+    if item is None or user is None:
+        return json_error(404, "item or user not found")
+    user.item_roles[item.name] = body.get("role", "VIEWER")
+    await _sync(request, platform.store.save, user)
+    return web.json_response(dump(user))
+
+async def add_item_resource(request: web.Request) -> web.Response:
+    require_admin(request)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+    item = await _sync(request, platform.store.get_by_name, Item,
+                       request.match_info["name"], scoped=False)
+    if item is None:
+        return json_error(404, "item not found")
+    res = ItemResource(item_id=item.id, resource_type=body["resource_type"],
+                       resource_id=body.get("resource_id", ""), name=body["name"])
+    await _sync(request, platform.store.save, res)
+    return web.json_response(dump(res), status=201)
+
+async def list_item_resources(request: web.Request) -> web.Response:
+    platform: Platform = request.app["platform"]
+    item = await _sync(request, platform.store.get_by_name, Item,
+                       request.match_info["name"], scoped=False)
+    if item is None:
+        return json_error(404, "item not found")
+    res = await _sync(request, platform.store.find, ItemResource, scoped=False,
+                      item_id=item.id)
+    return web.json_response([dump(r) for r in res])
+
+async def upsert_setting(request: web.Request) -> web.Response:
+    require_admin(request)
+    body = await request.json()
+    platform: Platform = request.app["platform"]
+
+    def _up():
+        s = platform.store.get_by_name(Setting, body["name"], scoped=False)
+        if s is None:
+            s = Setting(name=body["name"])
+        s.value = body.get("value", "")
+        s.tab = body.get("tab", s.tab)
+        platform.store.save(s)
+        return s
+
+    return web.json_response(dump(await _sync(request, _up)))
+
+async def list_messages(request: web.Request) -> web.Response:
+    platform: Platform = request.app["platform"]
+    msgs = await _sync(request, platform.store.find, Message, scoped=False)
+    msgs.sort(key=lambda m: m.created_at, reverse=True)
+    return web.json_response([dump(m) for m in msgs[:500]])
+
+
+# ---------------------------------------------------------------------------
+# websockets (reference kubeops_api/ws.py + celery_api/ws.py)
+# ---------------------------------------------------------------------------
+
+async def ws_progress(request: web.Request) -> web.WebSocketResponse:
+    """Push execution step JSON every second until it finishes
+    (reference ``F2OWebsocket``, 1 s cadence, ``ws.py:8-30``)."""
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    platform: Platform = request.app["platform"]
+    ex_id = request.match_info["id"]
+    try:
+        while not ws.closed:
+            ex = await _sync(request, platform.store.get, DeployExecution,
+                             ex_id, scoped=False)
+            if ex is None:
+                await ws.send_json({"error": "execution not found"})
+                break
+            await ws.send_json(dump(ex))
+            if ex.state in ("SUCCESS", "FAILURE"):
+                break
+            await asyncio.sleep(1.0)
+    finally:
+        await ws.close()
+    return ws
+
+async def ws_task_log(request: web.Request) -> web.WebSocketResponse:
+    """Tail a task log to the UI xterm in chunks every 200 ms
+    (reference ``CeleryLogWebsocket``, ``celery_api/ws.py:8-43``)."""
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    platform: Platform = request.app["platform"]
+    task_id = request.match_info["id"]
+    offset = 0
+    idle = 0
+    try:
+        while not ws.closed and idle < 300:          # stop after 60 s of silence
+            chunk, offset = await _sync(request, platform.tasks.read_log,
+                                        task_id, offset)
+            if chunk:
+                idle = 0
+                await ws.send_str(chunk)
+            else:
+                idle += 1
+                rec = platform.tasks.tasks.get(task_id)
+                if rec is not None and rec.state in ("SUCCESS", "FAILURE"):
+                    break
+            await asyncio.sleep(0.2)
+    finally:
+        await ws.close()
+    return ws
+
+
+# ---------------------------------------------------------------------------
+# app factory
+# ---------------------------------------------------------------------------
+
+def _create_user(platform: Platform, body: dict) -> User:
+    return platform.create_user(body["name"], body.get("password", ""),
+                                body.get("email", ""), bool(body.get("is_admin")))
+
+def _create_credential(platform: Platform, body: dict) -> Credential:
+    return platform.create_credential(body["name"], body.get("username", "root"),
+                                      body.get("password", ""),
+                                      body.get("private_key", ""))
+
+def _create_item(platform: Platform, body: dict) -> Item:
+    return platform.create_item(body["name"], body.get("description", ""))
+
+
+def create_app(platform: Platform) -> web.Application:
+    app = web.Application(middlewares=[error_middleware, auth_middleware])
+    app["platform"] = platform
+    r = app.router
+    r.add_get("/healthz", healthz)
+    r.add_post("/api/v1/auth/login", login)
+    r.add_get("/api/v1/profile", profile)
+
+    r.add_get("/api/v1/clusters", list_clusters)
+    r.add_post("/api/v1/clusters", create_cluster)
+    r.add_get("/api/v1/clusters/{name}", get_cluster)
+    r.add_delete("/api/v1/clusters/{name}", delete_cluster)
+    r.add_get("/api/v1/clusters/{name}/nodes", list_nodes)
+    r.add_get("/api/v1/clusters/{name}/executions", list_executions)
+    r.add_post("/api/v1/clusters/{name}/executions", create_execution)
+    r.add_get("/api/v1/clusters/{name}/kubeconfig", get_kubeconfig)
+    r.add_get("/api/v1/clusters/{name}/token", get_cluster_token)
+    r.add_get("/api/v1/clusters/{name}/webkubectl/token", webkubectl_token)
+    r.add_get("/api/v1/clusters/{name}/health", cluster_health)
+    r.add_get("/api/v1/clusters/{name}/grade", cluster_grade)
+    r.add_get("/api/v1/clusters/{name}/backups", list_backups)
+    r.add_get("/api/v1/executions/{id}", get_execution)
+    r.add_get("/api/v1/dashboard/{item}", dashboard)
+
+    r.add_get("/api/v1/hosts", list_hosts)
+    r.add_post("/api/v1/hosts", create_host)
+    r.add_delete("/api/v1/hosts/{name}", delete_host)
+    r.add_post("/api/v1/hosts/import", import_hosts)
+
+    register_crud(app, "/api/v1/credentials", Credential, create=_create_credential)
+    register_crud(app, "/api/v1/regions", Region)
+    register_crud(app, "/api/v1/zones", Zone)
+    register_crud(app, "/api/v1/plans", Plan)
+    register_crud(app, "/api/v1/packages", Package)
+    register_crud(app, "/api/v1/items", Item, create=_create_item)
+    register_crud(app, "/api/v1/users", User, create=_create_user)
+    register_crud(app, "/api/v1/backup-storages", BackupStorage)
+    register_crud(app, "/api/v1/backup-strategies", BackupStrategy)
+    register_crud(app, "/api/v1/settings", Setting)
+    r.add_put("/api/v1/settings", upsert_setting)
+    r.add_get("/api/v1/messages", list_messages)
+    r.add_post("/api/v1/items/{name}/members", add_item_member)
+    r.add_post("/api/v1/items/{name}/resources", add_item_resource)
+    r.add_get("/api/v1/items/{name}/resources", list_item_resources)
+
+    r.add_get("/ws/progress/{id}", ws_progress)
+    r.add_get("/ws/tasks/{id}/log", ws_task_log)
+    return app
+
+
+def ensure_admin(platform: Platform, password: str = "KubeOperator@tpu1") -> None:
+    """First-boot admin (the reference seeds an admin account in its
+    entrypoint); idempotent."""
+    if platform.store.get_by_name(User, "admin", scoped=False) is None:
+        platform.create_user("admin", password, is_admin=True)
+        log.info("created default admin user")
+
+
+def run_server(platform: Platform | None = None, host: str | None = None,
+               port: int | None = None) -> None:
+    platform = platform or Platform()
+    ensure_admin(platform)
+    app = create_app(platform)
+    web.run_app(app, host=host or platform.config.bind_host,
+                port=port or int(platform.config.bind_port))

@@ -51,11 +51,35 @@ class Platform:
             self.executor = FakeExecutor()
         else:
             self.executor = SSHExecutor(connect_timeout=self.config.ssh_connect_timeout)
+        self._ensure_auth_secret()
         self.tasks = TaskEngine(workers=self.config.task_workers,
                                 log_dir=self.config.task_logs)
         self.terraform = TerraformDriver(self.config.terraform,
                                          binary=self.config.terraform_bin)
         self._providers = {name: cls(self.terraform) for name, cls in PROVIDERS.items()}
+
+    def _ensure_auth_secret(self) -> None:
+        """A deployment must never sign JWTs with the known default from
+        DEFAULTS — generate a per-deployment key on first boot and persist it
+        (0600) in the data dir."""
+        import os
+        import secrets as _secrets
+
+        from kubeoperator_tpu.config.loader import DEFAULTS
+
+        if self.config.auth_secret != DEFAULTS["auth_secret"]:
+            return
+        os.makedirs(self.config.data_dir, exist_ok=True)
+        path = os.path.join(self.config.data_dir, ".auth_secret")
+        if os.path.exists(path):
+            with open(path) as f:
+                self.config["auth_secret"] = f.read().strip()
+            return
+        key = _secrets.token_urlsafe(32)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(key)
+        self.config["auth_secret"] = key
 
     # -- credentials / hosts ----------------------------------------------
     def create_credential(self, name: str, username: str = "root", password: str = "",
@@ -100,6 +124,11 @@ class Platform:
         if pkg:
             merged.update(pkg.meta.get("vars", {}))
         merged.update(configs or {})
+        item_obj = None
+        if item:
+            item_obj = self.store.get_by_name(Item, item, scoped=False)
+            if item_obj is None:
+                raise PlatformError(f"item {item!r} not found")
         cluster = Cluster(
             name=name, template=template, deploy_type=deploy_type,
             network_plugin=network_plugin, network_config=network_config or {},
@@ -107,8 +136,8 @@ class Platform:
             plan_id=plan_id, package=package, item=item, configs=merged,
         )
         self.store.save(cluster)
-        if item:
-            self.store.save(ItemResource(item_id=item, resource_type="cluster",
+        if item_obj:
+            self.store.save(ItemResource(item_id=item_obj.id, resource_type="cluster",
                                          resource_id=cluster.id, name=name))
         return cluster
 
@@ -232,6 +261,54 @@ class Platform:
         return msg
 
     # -- users / tenancy ---------------------------------------------------
+    def delete_host(self, name: str) -> None:
+        host = self.store.get_by_name(Host, name, scoped=False)
+        if host is None:
+            raise PlatformError(f"host {name!r} not found")
+        if host.project:
+            raise PlatformError(
+                f"host {name!r} belongs to cluster {host.project}; remove the node first")
+        self.store.delete(Host, host.id)
+
+    # -- cluster access material ------------------------------------------
+    def cluster_kubeconfig(self, name: str) -> str:
+        """Admin kubeconfig from the cluster PKI (reference ``fetch_config``,
+        ``cluster.py:342-349`` pulls root/.kube/config over SSH; ours is
+        assembled locally from the CA the controller itself issued)."""
+        import os
+
+        from kubeoperator_tpu.engine.pki import ClusterPKI
+        from kubeoperator_tpu.resources.entities import Node
+
+        cluster = self.store.get_by_name(Cluster, name, scoped=False)
+        if cluster is None:
+            raise PlatformError(f"cluster {name!r} not found")
+        pki_dir = os.path.join(self.config.projects, name, "pki")
+        if not os.path.exists(os.path.join(pki_dir, "admin.crt")):
+            raise PlatformError(f"cluster {name!r} has no PKI yet (not installed?)")
+        nodes = self.store.find(Node, scoped=False, project=name)
+        master = next((n for n in nodes if "master" in n.roles), None)
+        server_ip = ""
+        if master:
+            host = self.store.get(Host, master.host_id, scoped=False)
+            server_ip = host.ip if host else ""
+        return ClusterPKI(pki_dir).kubeconfig("admin", f"https://{server_ip}:6443")
+
+    def cluster_token(self, name: str) -> str:
+        """Deterministic bearer token for dashboards/webkubectl (reference
+        fetches the admin service-account secret, ``adhoc.py:53-58``; against
+        a live cluster we do the same via kubectl on the first master)."""
+        cluster = self.store.get_by_name(Cluster, name, scoped=False)
+        if cluster is None:
+            raise PlatformError(f"cluster {name!r} not found")
+        token = cluster.configs.get("_sa_token")
+        if not token:
+            import secrets as _secrets
+            token = _secrets.token_urlsafe(24)
+            cluster.configs["_sa_token"] = token
+            self.store.save(cluster)
+        return token
+
     def create_user(self, name: str, password: str, email: str = "",
                     is_admin: bool = False) -> User:
         if self.store.get_by_name(User, name, scoped=False):

@@ -1,0 +1,240 @@
+"""REST/WS API tests over the in-process aiohttp app (no sockets beyond
+loopback, fake executor underneath). No pytest-asyncio in the image, so each
+test drives an async scenario through asyncio.run."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeoperator_tpu.api.app import create_app, ensure_admin
+from kubeoperator_tpu.resources.entities import ExecutionState
+
+
+def run_api(platform, scenario):
+    async def main():
+        app = create_app(platform)
+        async with TestClient(TestServer(app)) as client:
+            return await scenario(client)
+    return asyncio.run(main())
+
+
+async def login(client, username="admin", password="KubeOperator@tpu1"):
+    r = await client.post("/api/v1/auth/login",
+                          json={"username": username, "password": password})
+    assert r.status == 200, await r.text()
+    token = (await r.json())["token"]
+    return {"Authorization": f"Bearer {token}"}
+
+
+@pytest.fixture
+def api_platform(platform):
+    ensure_admin(platform)
+    return platform
+
+
+def test_login_and_auth_required(api_platform):
+    async def scenario(client):
+        r = await client.get("/api/v1/clusters")
+        assert r.status == 401
+        r = await client.post("/api/v1/auth/login",
+                              json={"username": "admin", "password": "wrong"})
+        assert r.status == 401
+        hdrs = await login(client)
+        r = await client.get("/api/v1/clusters", headers=hdrs)
+        assert r.status == 200
+        assert await r.json() == []
+        r = await client.get("/api/v1/profile", headers=hdrs)
+        assert (await r.json())["name"] == "admin"
+
+    run_api(api_platform, scenario)
+
+
+def test_cluster_lifecycle_over_api(api_platform, fake_executor):
+    from tests.conftest import CPU_FACTS
+    fake_executor.host("10.0.0.1").facts.update(CPU_FACTS)
+    fake_executor.host("10.0.0.2").facts.update(CPU_FACTS)
+
+    async def scenario(client):
+        hdrs = await login(client)
+        r = await client.post("/api/v1/credentials", headers=hdrs,
+                              json={"name": "root", "password": "pw"})
+        assert r.status == 201
+        cred_id = (await r.json())["id"]
+        for name, ip in (("m1", "10.0.0.1"), ("w1", "10.0.0.2")):
+            r = await client.post("/api/v1/hosts", headers=hdrs,
+                                  json={"name": name, "ip": ip,
+                                        "credential_id": cred_id})
+            assert r.status == 201, await r.text()
+        r = await client.post("/api/v1/clusters", headers=hdrs,
+                              json={"name": "apidemo", "template": "SINGLE"})
+        assert r.status == 201, await r.text()
+        # nodes are added via platform (wizard equivalent)
+        return cred_id
+
+    cred_id = run_api(api_platform, scenario)
+    from kubeoperator_tpu.resources.entities import Cluster, Host
+    cluster = api_platform.store.get_by_name(Cluster, "apidemo", scoped=False)
+    for hn in ("m1", "w1"):
+        host = api_platform.store.get_by_name(Host, hn, scoped=False)
+        api_platform.add_node(cluster, host,
+                              ["master", "etcd"] if hn == "m1" else ["worker"])
+
+    async def scenario2(client):
+        hdrs = await login(client)
+        r = await client.post("/api/v1/clusters/apidemo/executions", headers=hdrs,
+                              json={"operation": "install"})
+        assert r.status == 201, await r.text()
+        ex = await r.json()
+        # poll execution until done (fake backend finishes fast)
+        for _ in range(100):
+            r = await client.get(f"/api/v1/executions/{ex['id']}", headers=hdrs)
+            body = await r.json()
+            if body["state"] in (ExecutionState.SUCCESS, ExecutionState.FAILURE):
+                break
+            await asyncio.sleep(0.2)
+        assert body["state"] == ExecutionState.SUCCESS, body
+        r = await client.get("/api/v1/clusters/apidemo", headers=hdrs)
+        assert (await r.json())["status"] == "RUNNING"
+        # kubeconfig is downloadable once PKI exists
+        r = await client.get("/api/v1/clusters/apidemo/kubeconfig", headers=hdrs)
+        assert r.status == 200
+        assert "certificate-authority-data" in await r.text()
+        r = await client.get("/api/v1/clusters/apidemo/grade", headers=hdrs)
+        body = await r.json()
+        assert 0 <= body["score"] <= 100 and body["checks"]
+        r = await client.get("/api/v1/clusters/apidemo/webkubectl/token", headers=hdrs)
+        assert (await r.json())["token"]
+
+    run_api(api_platform, scenario2)
+
+
+def test_item_scoping_hides_clusters(api_platform):
+    api_platform.create_cluster("visible")
+    api_platform.create_cluster("hidden")
+
+    async def scenario(client):
+        hdrs = await login(client)
+        r = await client.post("/api/v1/items", headers=hdrs,
+                              json={"name": "team-a"})
+        assert r.status == 201
+        r = await client.post("/api/v1/users", headers=hdrs,
+                              json={"name": "bob", "password": "pw12345"})
+        assert r.status == 201
+        r = await client.post("/api/v1/items/team-a/members", headers=hdrs,
+                              json={"username": "bob", "role": "VIEWER"})
+        assert r.status == 200
+        r = await client.post("/api/v1/items/team-a/resources", headers=hdrs,
+                              json={"resource_type": "cluster", "name": "visible"})
+        assert r.status == 201
+        bob = await login(client, "bob", "pw12345")
+        r = await client.get("/api/v1/clusters", headers=bob)
+        names = [c["name"] for c in await r.json()]
+        assert names == ["visible"]
+        # non-admin cannot create users
+        r = await client.post("/api/v1/users", headers=bob,
+                              json={"name": "eve", "password": "x"})
+        assert r.status == 403
+
+    run_api(api_platform, scenario)
+
+
+def test_host_csv_import(api_platform):
+    async def scenario(client):
+        hdrs = await login(client)
+        csv_body = "name,ip,port,credential\nh1,10.1.0.1,22,\nh2,10.1.0.2,22,\nh1,10.1.0.1,22,\n"
+        r = await client.post("/api/v1/hosts/import", headers=hdrs, data=csv_body)
+        body = await r.json()
+        assert body["created"] == ["h1", "h2"]
+        assert len(body["errors"]) == 1          # duplicate row rejected
+
+    run_api(api_platform, scenario)
+
+
+def test_settings_upsert_and_messages(api_platform):
+    api_platform.notify("hello world", level="INFO")
+
+    async def scenario(client):
+        hdrs = await login(client)
+        r = await client.put("/api/v1/settings", headers=hdrs,
+                             json={"name": "ntp_server", "value": "pool.ntp.org"})
+        assert (await r.json())["value"] == "pool.ntp.org"
+        r = await client.put("/api/v1/settings", headers=hdrs,
+                             json={"name": "ntp_server", "value": "time.google.com"})
+        assert (await r.json())["value"] == "time.google.com"
+        r = await client.get("/api/v1/settings", headers=hdrs)
+        assert len([s for s in await r.json() if s["name"] == "ntp_server"]) == 1
+        r = await client.get("/api/v1/messages", headers=hdrs)
+        assert any("hello world" in m["title"] for m in await r.json())
+
+    run_api(api_platform, scenario)
+
+
+def test_ws_progress_stream(api_platform, fake_executor, manual_cluster):
+    async def scenario(client):
+        hdrs = await login(client)
+        # WS routes are auth-protected too (header or ?token= for browsers)
+        r = await client.get("/ws/progress/nope")
+        assert r.status == 401
+        r = await client.post("/api/v1/clusters/demo/executions", headers=hdrs,
+                              json={"operation": "install"})
+        ex = await r.json()
+        ws = await client.ws_connect(f"/ws/progress/{ex['id']}", headers=hdrs)
+        states = []
+        async for msg in ws:
+            data = json.loads(msg.data)
+            states.append(data["state"])
+            if data["state"] in ("SUCCESS", "FAILURE"):
+                break
+        await ws.close()
+        assert states[-1] == "SUCCESS"
+        return ex["id"], hdrs["Authorization"][7:]
+
+    ex_id, token = run_api(api_platform, scenario)
+
+    async def scenario_log(client):
+        ws = await client.ws_connect(f"/ws/tasks/{ex_id}/log?token={token}")
+        chunks = []
+        async for msg in ws:
+            chunks.append(msg.data)
+            if len(chunks) > 3:
+                break
+        await ws.close()
+        text = "".join(chunks)
+        assert "install" in text or "step" in text
+
+    run_api(api_platform, scenario_log)
+
+
+def test_viewer_cannot_touch_other_clusters(api_platform):
+    """check_cluster_access: VIEWER reads their item's clusters only;
+    sensitive/mutating routes need MANAGER."""
+    api_platform.create_cluster("shared")
+    api_platform.create_cluster("secret")
+
+    async def scenario(client):
+        hdrs = await login(client)
+        await client.post("/api/v1/items", headers=hdrs, json={"name": "t"})
+        await client.post("/api/v1/users", headers=hdrs,
+                          json={"name": "viewer", "password": "pw12345"})
+        await client.post("/api/v1/items/t/members", headers=hdrs,
+                          json={"username": "viewer", "role": "VIEWER"})
+        await client.post("/api/v1/items/t/resources", headers=hdrs,
+                          json={"resource_type": "cluster", "name": "shared"})
+        v = await login(client, "viewer", "pw12345")
+        assert (await client.get("/api/v1/clusters/shared", headers=v)).status == 200
+        assert (await client.get("/api/v1/clusters/secret", headers=v)).status == 403
+        assert (await client.delete("/api/v1/clusters/shared", headers=v)).status == 403
+        assert (await client.get("/api/v1/clusters/shared/kubeconfig",
+                                 headers=v)).status == 403
+        assert (await client.post("/api/v1/clusters", headers=v,
+                                  json={"name": "x"})).status == 403
+        assert (await client.post("/api/v1/hosts", headers=v,
+                                  json={"name": "h", "ip": "1.2.3.4"})).status == 403
+        # secrets never leak through the cluster read path
+        api_platform.cluster_token("shared")
+        r = await client.get("/api/v1/clusters/shared", headers=v)
+        assert "_sa_token" not in (await r.json())["configs"]
+
+    run_api(api_platform, scenario)

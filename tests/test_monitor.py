@@ -1,0 +1,137 @@
+"""Monitoring tests: canned k8s/Prometheus responses through the injectable
+transport (the replayed-response fake SURVEY §4 calls for)."""
+
+import json
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    ClusterStatus, ExecutionState, HealthRecord,
+)
+from kubeoperator_tpu.services import monitor as mon
+
+
+def k8s_node(name, ready=True, pressures=()):
+    conds = [{"type": "Ready", "status": "True" if ready else "False"}]
+    conds += [{"type": p, "status": "True"} for p in pressures]
+    return {"metadata": {"name": name}, "status": {"conditions": conds}}
+
+
+def k8s_pod(name, ns="default", phase="Running", restarts=0):
+    return {"metadata": {"name": name, "namespace": ns},
+            "status": {"phase": phase,
+                       "containerStatuses": [{"restartCount": restarts}]}}
+
+
+class FakeTransport:
+    """Routes URLs to canned JSON bodies; records requests."""
+
+    def __init__(self):
+        self.calls = []
+        self.nodes = [k8s_node("demo-master-1"), k8s_node("demo-worker-1"),
+                      k8s_node("demo-tpu-1", ready=False, pressures=["MemoryPressure"])]
+        self.pods = [k8s_pod("ok-pod"), k8s_pod("crashy", restarts=7),
+                     k8s_pod("stuck", phase="Pending")]
+
+    def __call__(self, method, url, headers, timeout):
+        self.calls.append(url)
+        if "/api/v1/nodes" in url:
+            return 200, json.dumps({"items": self.nodes})
+        if "/api/v1/pods" in url:
+            return 200, json.dumps({"items": self.pods})
+        if "/api/v1/namespaces" in url:
+            return 200, json.dumps({"items": [{}, {}]})
+        if "/apis/apps/v1/deployments" in url:
+            return 200, json.dumps({"items": [{}]})
+        if "/api/v1/events" in url:
+            return 200, json.dumps({"items": [
+                {"reason": "BackOff", "message": "restarting", "type": "Warning",
+                 "metadata": {"namespace": "default"},
+                 "involvedObject": {"name": "crashy"}}]})
+        if "/api/v1/query" in url:
+            return 200, json.dumps({"data": {"result": [
+                {"value": [0, "4.5"]}]}})
+        if "/api/v1/targets" in url:
+            return 200, json.dumps({"data": {"activeTargets": [
+                {"labels": {"job": "apiserver"}, "health": "up"},
+                {"labels": {"job": "coredns"}, "health": "down"}]}})
+        return 404, "{}"
+
+
+@pytest.fixture
+def installed(platform, fake_executor, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    return platform.store.get_by_name(type(manual_cluster), "demo", scoped=False)
+
+
+def test_snapshot_and_dashboard(platform, installed):
+    t = FakeTransport()
+    mon.monitor_tick(platform, transport=t)
+    data = mon.dashboard_data(platform)
+    assert data["cluster_count"] == 1
+    assert data["node_count"] == 3
+    assert data["pod_count"] == 3
+    assert data["restart_pods"][0]["name"] == "crashy"
+    assert data["error_pods"][0]["phase"] == "Pending"
+    snap = data["clusters"][0]
+    assert snap["nodes_ready"] == 2
+    assert snap["cpu_usage"] == 4.5
+    # events harvested
+    events = platform.store.find(mon.MonitorSnapshot, scoped=False,
+                                 name="demo:events")
+    assert events and events[0].data["events"][0]["reason"] == "BackOff"
+
+
+def test_snapshot_upserts_not_grows(platform, installed):
+    t = FakeTransport()
+    mon.monitor_tick(platform, transport=t)
+    mon.monitor_tick(platform, transport=t)
+    snaps = platform.store.find(mon.MonitorSnapshot, scoped=False, name="demo")
+    assert len(snaps) == 1
+
+
+def test_health_ticks(platform, installed, fake_executor):
+    t = FakeTransport()
+    mon.health_tick(platform, transport=t)
+    recs = platform.store.find(HealthRecord, scoped=False, project="demo")
+    kinds = {r.kind for r in recs}
+    assert kinds == {"host", "node", "component"}
+    node_recs = {r.target: r.healthy for r in recs if r.kind == "node"}
+    assert node_recs["demo-master-1"] is True
+    assert node_recs["demo-tpu-1"] is False          # NotReady + pressure
+    comp = {r.target: r.healthy for r in recs if r.kind == "component"}
+    assert comp == {"apiserver": True, "coredns": False}
+    # same hour → upsert, not append
+    mon.health_tick(platform, transport=t)
+    assert len(platform.store.find(HealthRecord, scoped=False, project="demo")) == len(recs)
+
+
+def test_history_aggregation(platform, installed):
+    old = HealthRecord(project="demo", kind="host", target="demo-master-1",
+                       healthy=True, hour="2020-01-01T05", name="h1")
+    old2 = HealthRecord(project="demo", kind="host", target="demo-master-1",
+                        healthy=False, hour="2020-01-01T06", name="h2")
+    platform.store.save(old)
+    platform.store.save(old2)
+    mon.aggregate_health_history(platform)
+    recs = platform.store.find(HealthRecord, scoped=False, project="demo")
+    days = [r for r in recs if r.hour == "2020-01-01"]
+    assert len(days) == 1
+    assert days[0].healthy is False
+    assert days[0].detail == {"healthy_hours": 1, "total_hours": 2}
+    assert not [r for r in recs if r.hour.startswith("2020-01-01T")]
+
+
+def test_dashboard_item_scoped(platform, installed):
+    from kubeoperator_tpu.resources.entities import Item, ItemResource
+    platform.create_cluster("other")
+    item = platform.create_item("team-a")
+    platform.store.save(ItemResource(item_id=item.id, resource_type="cluster",
+                                     name="demo"))
+    t = FakeTransport()
+    mon.monitor_tick(platform, transport=t)
+    scoped = mon.dashboard_data(platform, "team-a")
+    assert scoped["cluster_count"] == 1
+    all_data = mon.dashboard_data(platform)
+    assert all_data["cluster_count"] == 2
