@@ -30,13 +30,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from kubeoperator_tpu.api.app import ensure_admin, run_server
-    from kubeoperator_tpu.services import monitor
+    from kubeoperator_tpu.services import backups, monitor
     from kubeoperator_tpu.services.platform import Platform
 
     platform = Platform()
     ensure_admin(platform)
     if not args.no_beat:
         monitor.schedule(platform)
+        backups.schedule(platform)
     try:
         run_server(platform, host=args.host, port=args.port)
     finally:

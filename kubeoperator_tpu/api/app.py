@@ -146,9 +146,16 @@ def visible_cluster_names(request: web.Request) -> set[str] | None:
 async def login(request: web.Request) -> web.Response:
     body = await request.json()
     platform: Platform = request.app["platform"]
-    user = await _sync(request, platform.store.get_by_name, User,
-                       body.get("username", ""), scoped=False)
-    if user is None or not user.check_password(body.get("password", "")):
+    username, password = body.get("username", ""), body.get("password", "")
+    user = await _sync(request, platform.store.get_by_name, User, username,
+                       scoped=False)
+    if user is not None and user.source == "ldap":
+        user = await _sync(request, _ldap_auth, platform, username, password)
+    elif user is None or not user.check_password(password):
+        # unknown local user → LDAP fallback (reference: django-auth-ldap
+        # backend ordered after ModelBackend)
+        user = await _sync(request, _ldap_auth, platform, username, password)
+    if user is None:
         return json_error(401, "invalid credentials")
     token = auth.encode({"sub": user.name, "adm": user.is_admin},
                         platform.config.auth_secret,
@@ -156,8 +163,21 @@ async def login(request: web.Request) -> web.Response:
     return web.json_response({"token": token, "user": dump(user)})
 
 
+def _ldap_auth(platform: Platform, username: str, password: str):
+    from kubeoperator_tpu.services.ldap_auth import LdapAuthenticator
+    return LdapAuthenticator(platform).authenticate(username, password)
+
+
 async def profile(request: web.Request) -> web.Response:
     return web.json_response(dump(request["user"]))
+
+
+async def mark_message_read(request: web.Request) -> web.Response:
+    from kubeoperator_tpu.services.messages import MessageCenter
+    platform: Platform = request.app["platform"]
+    await _sync(request, MessageCenter(platform).mark_read,
+                request.match_info["id"], request["user"].name)
+    return web.json_response({"read": request.match_info["id"]})
 
 
 async def healthz(request: web.Request) -> web.Response:
@@ -286,6 +306,8 @@ async def get_execution(request: web.Request) -> web.Response:
                      request.match_info["id"], scoped=False)
     if ex is None:
         return json_error(404, "execution not found")
+    if ex.project:
+        check_cluster_access(request, ex.project, write=False)
     return web.json_response(dump(ex))
 
 async def get_kubeconfig(request: web.Request) -> web.Response:
@@ -462,6 +484,10 @@ async def upsert_setting(request: web.Request) -> web.Response:
 async def list_messages(request: web.Request) -> web.Response:
     platform: Platform = request.app["platform"]
     msgs = await _sync(request, platform.store.find, Message, scoped=False)
+    visible = await _sync(request, visible_cluster_names, request)
+    if visible is not None:
+        # members see system messages + their items' cluster messages only
+        msgs = [m for m in msgs if m.project is None or m.project in visible]
     msgs.sort(key=lambda m: m.created_at, reverse=True)
     return web.json_response([dump(m) for m in msgs[:500]])
 
@@ -473,10 +499,14 @@ async def list_messages(request: web.Request) -> web.Response:
 async def ws_progress(request: web.Request) -> web.WebSocketResponse:
     """Push execution step JSON every second until it finishes
     (reference ``F2OWebsocket``, 1 s cadence, ``ws.py:8-30``)."""
-    ws = web.WebSocketResponse()
-    await ws.prepare(request)
     platform: Platform = request.app["platform"]
     ex_id = request.match_info["id"]
+    first = await _sync(request, platform.store.get, DeployExecution, ex_id,
+                        scoped=False)
+    if first is not None and first.project:
+        check_cluster_access(request, first.project, write=False)
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
     try:
         while not ws.closed:
             ex = await _sync(request, platform.store.get, DeployExecution,
@@ -495,10 +525,20 @@ async def ws_progress(request: web.Request) -> web.WebSocketResponse:
 async def ws_task_log(request: web.Request) -> web.WebSocketResponse:
     """Tail a task log to the UI xterm in chunks every 200 ms
     (reference ``CeleryLogWebsocket``, ``celery_api/ws.py:8-43``)."""
-    ws = web.WebSocketResponse()
-    await ws.prepare(request)
     platform: Platform = request.app["platform"]
     task_id = request.match_info["id"]
+    # task ids for deploy operations ARE execution ids (idempotent dispatch):
+    # apply the same per-cluster guard before streaming logs
+    ex = await _sync(request, platform.store.get, DeployExecution, task_id,
+                     scoped=False)
+    if ex is not None and ex.project:
+        check_cluster_access(request, ex.project, write=False)
+    elif ex is None and not request["user"].is_admin:
+        raise web.HTTPForbidden(text=json.dumps(
+            {"error": "non-execution task logs are admin-only"}),
+            content_type="application/json")
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
     offset = 0
     idle = 0
     try:
@@ -577,6 +617,7 @@ def create_app(platform: Platform) -> web.Application:
     register_crud(app, "/api/v1/settings", Setting)
     r.add_put("/api/v1/settings", upsert_setting)
     r.add_get("/api/v1/messages", list_messages)
+    r.add_post("/api/v1/messages/{id}/read", mark_message_read)
     r.add_post("/api/v1/items/{name}/members", add_item_member)
     r.add_post("/api/v1/items/{name}/resources", add_item_resource)
     r.add_get("/api/v1/items/{name}/resources", list_item_resources)

@@ -1,0 +1,175 @@
+"""LDAP authentication (reference ``users/authentication/ldap.py`` (121 LoC)
+via django-auth-ldap + periodic sync ``users/sync/ldap.py``).
+
+No LDAP client library ships in this image, and the needed subset is tiny:
+an LDAPv3 *simple bind* is one BER-encoded request/response pair. The DN is
+built from a template setting (django-auth-ldap's ``AUTH_LDAP_USER_DN_TEMPLATE``
+mode — the non-search flow, which is what air-gapped deployments use).
+
+Settings rows (``Setting`` kind):
+  ldap_enabled=true|false, ldap_host, ldap_port (389),
+  ldap_user_dn_template  e.g. "uid={username},ou=people,dc=corp,dc=example"
+  ldap_email_domain      fallback email domain for auto-created users
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable
+
+from kubeoperator_tpu.resources.entities import Setting, User
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+# -- minimal BER ------------------------------------------------------------
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _tlv(tag: int, content: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(content)) + content
+
+
+def _int(value: int) -> bytes:
+    body = value.to_bytes(max(1, (value.bit_length() + 8) // 8), "big", signed=True)
+    return _tlv(0x02, body)
+
+
+def bind_request(message_id: int, dn: str, password: str) -> bytes:
+    """LDAPMessage{ messageID, BindRequest{ version=3, name, simple pw } }"""
+    bind = (_int(3)
+            + _tlv(0x04, dn.encode())              # name: OCTET STRING
+            + _tlv(0x80, password.encode()))       # auth: [0] simple
+    op = _tlv(0x60, bind)                          # [APPLICATION 0] BindRequest
+    return _tlv(0x30, _int(message_id) + op)
+
+
+def parse_bind_result(data: bytes) -> int:
+    """Return the resultCode of a BindResponse (0 == success).
+
+    Walks: SEQUENCE { INTEGER msgid, [APPLICATION 1] { ENUMERATED code ... } }
+    """
+    def read_tlv(buf: bytes, pos: int) -> tuple[int, bytes, int]:
+        tag = buf[pos]
+        length = buf[pos + 1]
+        pos += 2
+        if length & 0x80:
+            n = length & 0x7F
+            length = int.from_bytes(buf[pos:pos + n], "big")
+            pos += n
+        return tag, buf[pos:pos + length], pos + length
+
+    tag, seq, _ = read_tlv(data, 0)
+    if tag != 0x30:
+        raise ValueError("not an LDAPMessage")
+    _, _msgid, pos = read_tlv(seq, 0)
+    op_tag, op, _ = read_tlv(seq, pos)
+    if op_tag != 0x61:                             # [APPLICATION 1] BindResponse
+        raise ValueError(f"not a BindResponse (tag {op_tag:#x})")
+    code_tag, code, _ = read_tlv(op, 0)
+    if code_tag != 0x0A:                           # ENUMERATED
+        raise ValueError("malformed BindResponse")
+    return int.from_bytes(code, "big")
+
+
+# -- client -----------------------------------------------------------------
+
+def escape_dn(value: str) -> str:
+    """RFC 4514 escaping for an attribute value inside a DN (the reference's
+    django-auth-ldap applies escape_dn_chars in DN-template mode)."""
+    out = []
+    for i, ch in enumerate(value):
+        if ch in ',+"\\<>;=#' or (ch == " " and i in (0, len(value) - 1)):
+            out.append("\\" + ch)
+        elif ord(ch) < 0x20:
+            out.append(f"\\{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _recv_message(sock: socket.socket) -> bytes:
+    """Read one complete BER TLV (the outer LDAPMessage) — responses may
+    arrive split across TCP segments."""
+    data = b""
+    while len(data) < 2:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("LDAP server closed connection")
+        data += chunk
+    # total length = header + encoded length field + content length
+    first = data[1]
+    if first & 0x80:
+        n = first & 0x7F
+        while len(data) < 2 + n:
+            data += sock.recv(4096)
+        total = 2 + n + int.from_bytes(data[2:2 + n], "big")
+    else:
+        total = 2 + first
+    while len(data) < total:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("truncated LDAP response")
+        data += chunk
+    return data
+
+
+def simple_bind(host: str, port: int, dn: str, password: str,
+                timeout: float = 5.0,
+                connector: Callable[..., socket.socket] | None = None) -> bool:
+    """True iff the DN/password bind succeeds (resultCode 0)."""
+    connect = connector or (lambda: socket.create_connection((host, port),
+                                                             timeout=timeout))
+    with connect() as sock:
+        sock.sendall(bind_request(1, dn, password))
+        return parse_bind_result(_recv_message(sock)) == 0
+
+
+class LdapAuthenticator:
+    def __init__(self, platform, connector=None):
+        self.platform = platform
+        self.connector = connector
+
+    def _setting(self, name: str, default: str = "") -> str:
+        s = self.platform.store.get_by_name(Setting, name, scoped=False)
+        return s.value if s else default
+
+    @property
+    def enabled(self) -> bool:
+        return self._setting("ldap_enabled", "false").lower() == "true"
+
+    def authenticate(self, username: str, password: str) -> User | None:
+        """Bind as the templated DN; on success mirror a local ``source=ldap``
+        user (reference sync creates Profile rows for LDAP users)."""
+        if not self.enabled or not password:
+            return None
+        template = self._setting("ldap_user_dn_template")
+        host = self._setting("ldap_host")
+        if not template or not host:
+            return None
+        # an existing LOCAL account must never be reachable via LDAP —
+        # otherwise a directory entry with the same uid takes over the
+        # local admin
+        user = self.platform.store.get_by_name(User, username, scoped=False)
+        if user is not None and user.source != "ldap":
+            return None
+        try:
+            dn = template.format(username=escape_dn(username))
+            ok = simple_bind(host, int(self._setting("ldap_port", "389")), dn,
+                             password, connector=self.connector)
+        except Exception as e:  # noqa: BLE001 — auth boundary: fail closed
+            log.warning("LDAP bind for %s failed: %s", username, e)
+            return None
+        if not ok:
+            return None
+        if user is None:
+            domain = self._setting("ldap_email_domain", "example.com")
+            user = User(name=username, email=f"{username}@{domain}", source="ldap")
+            self.platform.store.save(user)
+        return user

@@ -258,7 +258,22 @@ class Platform:
         msg = Message(title=title, level=level, project=project,
                       content=content or {}, name=title[:64])
         self.store.save(msg)
+        # fan-out runs on the task pool: SMTP/webhook timeouts must not
+        # block the operation worker that is reporting its result
+        self.tasks.submit(f"notify-{msg.id}", "notify",
+                          self.message_center.dispatch, msg)
         return msg
+
+    @property
+    def message_center(self):
+        if getattr(self, "_message_center", None) is None:
+            from kubeoperator_tpu.services.messages import MessageCenter
+            self._message_center = MessageCenter(self)
+        return self._message_center
+
+    @message_center.setter
+    def message_center(self, mc) -> None:
+        self._message_center = mc
 
     # -- users / tenancy ---------------------------------------------------
     def delete_host(self, name: str) -> None:

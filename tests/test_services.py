@@ -1,0 +1,168 @@
+"""Message fan-out, backup-strategy beat, LDAP bind."""
+
+import socket
+import threading
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    BackupStrategy, ClusterStatus, ExecutionState, Message, Setting, User,
+)
+from kubeoperator_tpu.services import backups, ldap_auth
+from kubeoperator_tpu.services.messages import MessageCenter
+
+
+def put_setting(platform, name, value):
+    platform.store.save(Setting(name=name, value=value))
+
+
+# -- message center ---------------------------------------------------------
+
+def test_message_fanout_channels(platform):
+    platform.create_user("alice", "pw", email="alice@example.com", is_admin=True)
+    platform.create_user("bob", "pw", email="")
+    put_setting(platform, "smtp_host", "mail.local")
+    put_setting(platform, "notify.alice", "LOCAL,EMAIL,WEBHOOK")
+    put_setting(platform, "webhook_url", "http://hook.local/x")
+
+    emails, hooks = [], []
+    mc = MessageCenter(platform,
+                       email_sender=lambda smtp, to, subj, body: emails.append(to),
+                       webhook_sender=lambda url, payload: hooks.append(payload))
+    platform.message_center = mc         # notify() dispatches via the task pool
+    msg = platform.notify("cluster demo install failed", level="ERROR")
+    platform.tasks.wait(f"notify-{msg.id}", timeout=10)
+    sent = mc.dispatch(msg)              # direct call for the return contract
+    assert "alice" in sent["LOCAL"] and "bob" in sent["LOCAL"]
+    assert "alice@example.com" in emails       # bob has no email
+    assert hooks and "[ERROR]" in hooks[0]["text"]["content"]
+
+
+def test_message_min_level_filter(platform):
+    platform.create_user("alice", "pw", is_admin=True)
+    put_setting(platform, "notify_min_level", "ERROR")
+    mc = MessageCenter(platform)
+    info = platform.notify("routine", level="INFO")
+    assert mc.dispatch(info) == {"LOCAL": [], "EMAIL": [], "WEBHOOK": []}
+
+
+def test_mark_read(platform):
+    msg = platform.notify("note")
+    MessageCenter(platform).mark_read(msg.id, "admin")
+    MessageCenter(platform).mark_read(msg.id, "admin")      # idempotent
+    got = platform.store.get(Message, msg.id, scoped=False)
+    assert got.read_by == ["admin"]
+
+
+# -- backup strategy beat ---------------------------------------------------
+
+def test_backup_tick_runs_due_strategy(platform, fake_executor, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    platform.store.save(BackupStrategy(project="demo", enabled=True, name="daily"))
+
+    # before the backup hour → nothing
+    assert backups.backup_tick(platform, "2026-07-29T00:30:00+00:00") == []
+    started = backups.backup_tick(platform, "2026-07-29T01:05:00+00:00")
+    assert started == ["demo"]
+    # wait for the backup execution to finish
+    from kubeoperator_tpu.resources.entities import DeployExecution
+    import time
+    for _ in range(100):
+        exs = platform.store.find(DeployExecution, scoped=False, project="demo",
+                                  operation="backup")
+        if exs and exs[0].state in (ExecutionState.SUCCESS, ExecutionState.FAILURE):
+            break
+        time.sleep(0.1)
+    assert exs and exs[0].state == ExecutionState.SUCCESS, exs and exs[0].result
+    # same day again → not due
+    assert backups.backup_tick(platform, "2026-07-29T01:59:00+00:00") == []
+
+
+def test_backup_tick_skips_disabled_and_not_running(platform):
+    platform.create_cluster("idle")
+    platform.store.save(BackupStrategy(project="idle", enabled=True, name="s1"))
+    assert backups.due_strategies(platform) == []            # cluster READY, not RUNNING
+
+
+# -- LDAP -------------------------------------------------------------------
+
+class FakeLdapServer(threading.Thread):
+    """Accepts one connection, records the bind DN/password, answers
+    success for password 'letmein' and invalidCredentials (49) otherwise."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.seen = []
+
+    def run(self):
+        conn, _ = self.sock.accept()
+        data = conn.recv(4096)
+        # password is the last TLV in our bind_request (tag 0x80 simple auth)
+        idx = data.rfind(b"\x80")
+        password = data[idx + 2: idx + 2 + data[idx + 1]].decode()
+        self.seen.append(password)
+        code = 0 if password == "letmein" else 49
+        body = b"\x0a\x01" + bytes([code]) + b"\x04\x00\x04\x00"
+        op = b"\x61" + bytes([len(body)]) + body
+        msg = b"\x02\x01\x01" + op
+        conn.sendall(b"\x30" + bytes([len(msg)]) + msg)
+        conn.close()
+
+
+def _ldap_platform(platform, port):
+    put_setting(platform, "ldap_enabled", "true")
+    put_setting(platform, "ldap_host", "127.0.0.1")
+    put_setting(platform, "ldap_port", str(port))
+    put_setting(platform, "ldap_user_dn_template",
+                "uid={username},ou=people,dc=corp")
+    return ldap_auth.LdapAuthenticator(platform)
+
+
+def test_ldap_bind_success_creates_user(platform):
+    server = FakeLdapServer()
+    server.start()
+    auth = _ldap_platform(platform, server.port)
+    user = auth.authenticate("carol", "letmein")
+    assert user is not None and user.source == "ldap"
+    assert platform.store.get_by_name(User, "carol", scoped=False)
+
+
+def test_ldap_bind_failure(platform):
+    server = FakeLdapServer()
+    server.start()
+    auth = _ldap_platform(platform, server.port)
+    assert auth.authenticate("carol", "wrongpw") is None
+    assert platform.store.get_by_name(User, "carol", scoped=False) is None
+
+
+def test_ldap_cannot_take_over_local_account(platform):
+    """A directory uid matching a LOCAL user (e.g. admin) must not
+    authenticate via LDAP."""
+    platform.create_user("admin", "localpw", is_admin=True)
+    server = FakeLdapServer()
+    server.start()
+    auth = _ldap_platform(platform, server.port)
+    assert auth.authenticate("admin", "letmein") is None
+
+
+def test_ldap_dn_escaping():
+    assert ldap_auth.escape_dn("x,ou=svc") == "x\\,ou\\=svc"
+    assert ldap_auth.escape_dn(" lead") == "\\ lead"
+
+
+def test_ldap_disabled_fails_closed(platform):
+    auth = ldap_auth.LdapAuthenticator(platform)
+    assert auth.authenticate("anyone", "pw") is None
+
+
+def test_ber_roundtrip():
+    req = ldap_auth.bind_request(1, "uid=x,dc=y", "secret")
+    assert req[0] == 0x30
+    # success + failure responses parse
+    ok = b"\x30\x0c\x02\x01\x01\x61\x07\x0a\x01\x00\x04\x00\x04\x00"
+    bad = b"\x30\x0c\x02\x01\x01\x61\x07\x0a\x01\x31\x04\x00\x04\x00"
+    assert ldap_auth.parse_bind_result(ok) == 0
+    assert ldap_auth.parse_bind_result(bad) == 49
