@@ -14,6 +14,7 @@ import asyncio
 import csv
 import io
 import json
+import os
 import secrets
 from dataclasses import asdict
 from functools import partial
@@ -624,6 +625,18 @@ def create_app(platform: Platform) -> web.Application:
 
     r.add_get("/ws/progress/{id}", ws_progress)
     r.add_get("/ws/tasks/{id}/log", ws_task_log)
+
+    ui_dir = os.path.join(os.path.dirname(__file__), "..", "ui")
+
+    async def ui_index(request: web.Request) -> web.Response:
+        with open(os.path.join(ui_dir, "index.html"), encoding="utf-8") as f:
+            return web.Response(text=f.read(), content_type="text/html")
+
+    async def root_redirect(request: web.Request) -> web.Response:
+        raise web.HTTPFound("/ui/")
+
+    r.add_get("/", root_redirect)
+    r.add_get("/ui/", ui_index)
     return app
 
 

@@ -16,6 +16,8 @@ log = get_logger(__name__)
 
 def due_strategies(platform, now_iso: str | None = None) -> list[BackupStrategy]:
     """Enabled strategies whose cluster is RUNNING and has no backup today."""
+    from kubeoperator_tpu.resources.entities import DeployExecution
+
     now_iso = now_iso or iso()
     today = now_iso[:10]
     due = []
@@ -25,9 +27,12 @@ def due_strategies(platform, now_iso: str | None = None) -> list[BackupStrategy]
         cluster = platform.store.get_by_name(Cluster, strategy.project, scoped=False)
         if cluster is None or cluster.status != ClusterStatus.RUNNING:
             continue
-        backups = platform.store.find(ClusterBackup, scoped=False,
-                                      project=strategy.project)
-        if any(b.created_at[:10] == today for b in backups):
+        # gate on today's backup *executions* (any state), not just completed
+        # ClusterBackup rows — otherwise a running or failed backup gets
+        # re-dispatched every tick for the rest of the day
+        attempts = platform.store.find(DeployExecution, scoped=False,
+                                       project=strategy.project, operation="backup")
+        if any(a.created_at[:10] == today for a in attempts):
             continue
         due.append(strategy)
     return due

@@ -108,7 +108,10 @@ def _recv_message(sock: socket.socket) -> bytes:
     if first & 0x80:
         n = first & 0x7F
         while len(data) < 2 + n:
-            data += sock.recv(4096)
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("truncated LDAP length field")
+            data += chunk
         total = 2 + n + int.from_bytes(data[2:2 + n], "big")
     else:
         total = 2 + first
@@ -137,8 +140,7 @@ class LdapAuthenticator:
         self.connector = connector
 
     def _setting(self, name: str, default: str = "") -> str:
-        s = self.platform.store.get_by_name(Setting, name, scoped=False)
-        return s.value if s else default
+        return self.platform.setting(name, default)
 
     @property
     def enabled(self) -> bool:

@@ -54,8 +54,7 @@ class MessageCenter:
 
     # -- settings ----------------------------------------------------------
     def _setting(self, name: str, default: str = "") -> str:
-        s = self.platform.store.get_by_name(Setting, name, scoped=False)
-        return s.value if s else default
+        return self.platform.setting(name, default)
 
     def smtp_config(self) -> dict | None:
         host = self._setting("smtp_host")

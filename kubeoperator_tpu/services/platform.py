@@ -264,6 +264,13 @@ class Platform:
                           self.message_center.dispatch, msg)
         return msg
 
+    def setting(self, name: str, default: str = "") -> str:
+        """Read a Setting row (reference DB Setting key/values,
+        ``models/setting.py:9-21``); shared by messages/LDAP/UI consumers."""
+        from kubeoperator_tpu.resources.entities import Setting
+        s = self.store.get_by_name(Setting, name, scoped=False)
+        return s.value if s else default
+
     @property
     def message_center(self):
         if getattr(self, "_message_center", None) is None:

@@ -143,28 +143,53 @@ class Trainer:
             self._step_fn = self._build_step()
         return self._step_fn(state, images, labels)
 
-    def _build_step(self) -> Callable:
+    def _py_step(self, state: TrainState, images, labels):
         cfg, model, tx = self.cfg, self.model, self.tx
 
-        def step(state: TrainState, images, labels):
-            def loss_fn(params):
-                logits, mutated = model.apply(
-                    {"params": params, "batch_stats": state.batch_stats},
-                    images, train=True, mutable=["batch_stats"])
-                loss = cross_entropy(logits, labels, cfg.label_smoothing)
-                return loss, (logits, mutated["batch_stats"])
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            loss = cross_entropy(logits, labels, cfg.label_smoothing)
+            return loss, (logits, mutated["batch_stats"])
 
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
-            updates, opt_state = tx.update(grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
-            metrics = {"loss": loss,
-                       "accuracy": (jnp.argmax(logits, -1) == labels).mean()}
-            return TrainState(step=state.step + 1, params=params,
-                              batch_stats=new_stats, opt_state=opt_state), metrics
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss,
+                   "accuracy": (jnp.argmax(logits, -1) == labels).mean()}
+        return TrainState(step=state.step + 1, params=params,
+                          batch_stats=new_stats, opt_state=opt_state), metrics
 
-        return jax.jit(step, donate_argnums=(0,),
+    def _build_step(self) -> Callable:
+        return jax.jit(self._py_step, donate_argnums=(0,),
                        in_shardings=(None, self.batch_shd, self.batch_shd))
+
+    def multi_step_fn(self, k: int) -> Callable:
+        """K train steps per dispatch via lax.scan, each on a fresh on-device
+        synthetic batch. Amortizes the per-dispatch launch overhead (~5 ms
+        through the axon relay on this pod — measured 29.4% → 31.8% MFU at
+        k=8) the way a real input pipeline amortizes it with device prefetch.
+
+        Returns ``fn(state, key) -> (state, losses[k])``.
+        """
+        cfg = self.cfg
+        shape = (cfg.batch_size, cfg.image_size, cfg.image_size, 3)
+
+        def body(carry, _):
+            state, key = carry
+            key, ki, kl = jax.random.split(key, 3)
+            images = jax.random.normal(ki, shape, jnp.bfloat16)
+            labels = jax.random.randint(kl, (cfg.batch_size,), 0, cfg.num_classes)
+            state, metrics = self._py_step(state, images, labels)
+            return (state, key), metrics["loss"]
+
+        def multi(state, key):
+            (state, key), losses = jax.lax.scan(body, (state, key), None, length=k)
+            return state, losses
+
+        return jax.jit(multi, donate_argnums=(0,))
 
     # -- data --------------------------------------------------------------
     def synthetic_batch(self, batch: int | None = None, seed: int = 0):
@@ -185,29 +210,55 @@ class Trainer:
                                      self.cfg.num_classes)
         return 3.0 * fwd * (batch or self.cfg.batch_size)
 
-    def measure(self, steps: int = 20, warmup: int = 3, batch: int | None = None) -> dict:
-        """Timed loop → img/sec/chip + MFU. Blocks on device each iteration."""
+    def measure(self, steps: int = 20, warmup: int = 3, batch: int | None = None,
+                steps_per_call: int = 1) -> dict:
+        """Timed loop → img/sec/chip + MFU.
+
+        ``steps_per_call > 1`` uses the scanned multi-step (fresh data each
+        step); ``steps`` then counts scan calls, so total steps =
+        steps × steps_per_call. The scanned path always trains at
+        cfg.batch_size (the scan body generates its own batches), so a
+        ``batch`` override is rejected there rather than silently
+        misreporting throughput. warmup is clamped to ≥1: the post-warmup
+        fence is what keeps compile time out of the timed loop.
+        """
+        if steps_per_call > 1 and batch not in (None, self.cfg.batch_size):
+            raise ValueError("batch override is incompatible with steps_per_call>1; "
+                             "set TrainConfig.batch_size instead")
         batch = batch or self.cfg.batch_size
+        warmup = max(1, warmup)
         state = self.init_state()
-        images, labels = self.synthetic_batch(batch)
-        for _ in range(warmup):
-            state, metrics = self.train_step(state, images, labels)
         # barrier via host transfer: on the axon TPU relay platform,
         # block_until_ready returns before execution finishes — a value
         # fetch is the only reliable fence (measured: 0.007s "block" vs
         # 9.4s actual for the same queue).
-        float(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = self.train_step(state, images, labels)
-        float(metrics["loss"])
+        if steps_per_call > 1:
+            fn = self.multi_step_fn(steps_per_call)
+            key = jax.random.key(1)
+            for _ in range(warmup):
+                state, losses = fn(state, key)
+            float(losses[-1])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, losses = fn(state, key)
+            float(losses[-1])
+        else:
+            images, labels = self.synthetic_batch(batch)
+            for _ in range(warmup):
+                state, metrics = self.train_step(state, images, labels)
+            float(metrics["loss"])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = self.train_step(state, images, labels)
+            float(metrics["loss"])
         dt = time.perf_counter() - t0
+        total_steps = steps * steps_per_call
         n_chips = self.mesh.devices.size
-        img_per_sec = batch * steps / dt
-        achieved = self.flops_per_step(batch) * steps / dt
+        img_per_sec = batch * total_steps / dt
+        achieved = self.flops_per_step(batch) * total_steps / dt
         mfu = achieved / (peak_flops_per_chip() * n_chips)
         return {"img_per_sec": img_per_sec, "img_per_sec_per_chip": img_per_sec / n_chips,
-                "step_time_ms": dt / steps * 1e3, "mfu": mfu, "chips": n_chips,
+                "step_time_ms": dt / total_steps * 1e3, "mfu": mfu, "chips": n_chips,
                 "batch": batch, "achieved_tflops": achieved / 1e12}
 
 
