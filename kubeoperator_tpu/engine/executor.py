@@ -84,6 +84,13 @@ class Executor:
     def ping(self, conn: Conn) -> bool:
         return self.run(conn, "true", timeout=10).ok
 
+    def run_many(self, targets: list[tuple[Conn, str]], timeout: int = 300,
+                 max_parallel: int = 32) -> list[ExecResult]:
+        """Run one command per connection, concurrently where the transport
+        supports it. Base implementation is sequential (FakeExecutor relies
+        on it for deterministic histories)."""
+        return [self.run(conn, cmd, timeout=timeout) for conn, cmd in targets]
+
 
 # ---------------------------------------------------------------------------
 
@@ -161,6 +168,22 @@ class SSHExecutor(Executor):
             return ExecResult(p.returncode, p.stdout, p.stderr)
         except subprocess.TimeoutExpired:
             return ExecResult(124, "", f"timeout after {timeout}s")
+
+    def run_many(self, targets: list[tuple[Conn, str]], timeout: int = 300,
+                 max_parallel: int = 32) -> list[ExecResult]:
+        """Fan out over the koagent C++ thread pool (GIL-free, process-group
+        timeouts); falls back to the sequential base path without the lib."""
+        from kubeoperator_tpu import native
+
+        cmds = [" ".join(shlex.quote(a) for a in self._base(conn)) + " " +
+                shlex.quote(cmd) for conn, cmd in targets]
+        results = native.fanout(cmds, max_parallel=max_parallel,
+                                timeout_s=float(timeout))
+        if results is None:
+            return super().run_many(targets, timeout=timeout,
+                                    max_parallel=max_parallel)
+        return [ExecResult(124 if code == -2 else code, out, err)
+                for code, out, err in results]
 
     def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
         d = os.path.dirname(path)

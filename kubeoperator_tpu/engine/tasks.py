@@ -93,14 +93,14 @@ class TaskEngine:
 
     def read_log(self, task_id: str, offset: int = 0) -> tuple[str, int]:
         """Incremental log read for streaming (the reference tails the file
-        in 4 KB chunks for the UI xterm, ``celery_api/ws.py:8-43``)."""
+        in 4 KB chunks for the UI xterm, ``celery_api/ws.py:8-43``); uses the
+        koagent native tail when built."""
+        from kubeoperator_tpu import native
+
         path = self.task_log_path(task_id)
         if not os.path.exists(path):
             return "", offset
-        with open(path, encoding="utf-8", errors="replace") as f:
-            f.seek(offset)
-            chunk = f.read()
-            return chunk, f.tell()
+        return native.tail(path, offset)
 
     # -- periodic tasks ----------------------------------------------------
     def every(self, interval_s: float, name: str, fn: Callable) -> None:

@@ -235,23 +235,43 @@ class ClusterMonitor:
 
     # -- health (reference models/health/*, 5-min beat) --------------------
     def host_health(self) -> list[HealthRecord]:
-        """SSH ping every cluster host (reference ``host_health.py:9-43``)."""
+        """SSH ping every cluster host (reference ``host_health.py:9-43``),
+        batched through Executor.run_many — one C++ fan-out instead of a
+        serial ssh per host."""
         from kubeoperator_tpu.engine.executor import Conn
 
-        records = []
         hour = iso_now()[:13]
-        for host in self.platform.store.find(Host, scoped=False,
-                                             project=self.cluster.name):
-            cred = (self.platform.store.get(Credential, host.credential_id,
-                                            scoped=False)
-                    if host.credential_id else None)
+        hosts = self.platform.store.find(Host, scoped=False,
+                                         project=self.cluster.name)
+        targets = []
+        conn_errors: dict[str, str] = {}
+        for host in hosts:
             try:
-                r = self.platform.executor.run(Conn.from_host(host, cred), "true",
-                                               timeout=10)
-                healthy = r.ok
-                detail = {} if r.ok else {"error": r.stderr[:200]}
-            except Exception as e:  # noqa: BLE001 — unreachable host is data
-                healthy, detail = False, {"error": str(e)[:200]}
+                cred = (self.platform.store.get(Credential, host.credential_id,
+                                                scoped=False)
+                        if host.credential_id else None)
+                targets.append((host, Conn.from_host(host, cred)))
+            except Exception as e:  # noqa: BLE001 — bad credential = that host unhealthy
+                conn_errors[host.name] = str(e)[:200]
+        try:
+            results = self.platform.executor.run_many(
+                [(conn, "true") for _, conn in targets], timeout=10)
+        except Exception as e:  # noqa: BLE001 — transport down = all unhealthy
+            results = None
+            err = str(e)[:200]
+        by_name = {}
+        for i, (host, _) in enumerate(targets):
+            if results is None:
+                by_name[host.name] = (False, {"error": err})
+            else:
+                by_name[host.name] = (results[i].ok, {} if results[i].ok
+                                      else {"error": results[i].stderr[:200]})
+        records = []
+        for host in hosts:
+            if host.name in conn_errors:
+                healthy, detail = False, {"error": conn_errors[host.name]}
+            else:
+                healthy, detail = by_name[host.name]
             records.append(self._record("host", host.name, healthy, detail, hour))
         return records
 

@@ -40,6 +40,8 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     ring: bool = False          # use ring attention (sequence sharded on 'sp')
+    attention: str = "auto"     # auto | flash | dense — auto picks the pallas
+                                # flash kernel on TPU, dense elsewhere
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +93,12 @@ class Attention(nn.Module):
             # GSPMD outside, manual collectives inside: shard_map hands each
             # device its [B, T/sp, H/tp, D] block; K/V ride the ring.
             out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
+        elif (cfg.attention == "flash" and q.shape[1] % 128 == 0) or (
+                cfg.attention == "auto"
+                and jax.default_backend() in ("tpu", "axon")
+                and q.shape[1] % 128 == 0):
+            from kubeoperator_tpu.workloads.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
         else:
             out = ra.reference_attention(q, k, v, causal=True)
         return dense(features=x.shape[-1], axis=(-2, -1),

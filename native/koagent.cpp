@@ -1,0 +1,159 @@
+// koagent — native runtime helpers for the control plane.
+//
+// The reference delegates its native needs to external Go binaries
+// (terraform, kube*, nexus; SURVEY §2.9) and fans SSH out through
+// ansible's forked workers (forks=5, runner.py:39). Here the fan-out hot
+// path (one controller driving hundreds of TPU-pool hosts) is a C++
+// thread pool running the ssh/scp subprocesses: no GIL, no Python thread
+// stacks, bounded concurrency, per-task wall-clock timeouts.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in the image):
+//   ko_fanout(cmds, n, max_parallel, timeout_s) -> results (exit codes +
+//     captured stdout/stderr, caller frees with ko_free_results)
+//   ko_tail(path, offset, buf, cap) -> bytes read (incremental log tail
+//     for the WS streamer)
+//
+// Build: g++ -O2 -shared -fPIC -o libkoagent.so koagent.cpp -lpthread
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+
+struct KoResult {
+  int exit_code;      // -1: spawn failure, -2: timeout
+  char* out;          // malloc'd, NUL-terminated
+  char* err;          // malloc'd, NUL-terminated
+};
+
+// Run one command via /bin/sh -c, capture stdout/stderr, enforce timeout.
+static void run_one(const char* cmd, double timeout_s, KoResult* res) {
+  int out_pipe[2], err_pipe[2];
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
+    res->exit_code = -1;
+    res->out = strdup("");
+    res->err = strdup("pipe() failed");
+    return;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    res->exit_code = -1;
+    res->out = strdup("");
+    res->err = strdup("fork() failed");
+    return;
+  }
+  if (pid == 0) {
+    // child: own process group so a timeout can kill ssh and its children
+    setpgid(0, 0);
+    dup2(out_pipe[1], 1);
+    dup2(err_pipe[1], 2);
+    close(out_pipe[0]); close(out_pipe[1]);
+    close(err_pipe[0]); close(err_pipe[1]);
+    execl("/bin/sh", "sh", "-c", cmd, (char*)nullptr);
+    _exit(127);
+  }
+  close(out_pipe[1]);
+  close(err_pipe[1]);
+
+  std::string out_buf, err_buf;
+  struct pollfd fds[2] = {{out_pipe[0], POLLIN, 0}, {err_pipe[0], POLLIN, 0}};
+  bool open_fds[2] = {true, true};
+  const auto deadline = std::chrono::steady_clock::now() +
+      std::chrono::milliseconds((long long)(timeout_s * 1000));
+  bool timed_out = false;
+  char buf[8192];
+
+  while (open_fds[0] || open_fds[1]) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (left <= 0) { timed_out = true; break; }
+    int nfds = 0;
+    struct pollfd active[2];
+    int map[2];
+    for (int i = 0; i < 2; i++)
+      if (open_fds[i]) { active[nfds] = fds[i]; map[nfds++] = i; }
+    int rc = poll(active, nfds, (int)std::min<long long>(left, 1000));
+    if (rc < 0) break;
+    for (int i = 0; i < nfds; i++) {
+      if (active[i].revents & (POLLIN | POLLHUP)) {
+        ssize_t n = read(active[i].fd, buf, sizeof buf);
+        if (n <= 0) { open_fds[map[i]] = false; close(active[i].fd); }
+        else (map[i] == 0 ? out_buf : err_buf).append(buf, n);
+      }
+    }
+  }
+  if (timed_out) {
+    kill(-pid, SIGKILL);                    // whole process group
+    err_buf.append("\n[koagent] timeout");
+  }
+  for (int i = 0; i < 2; i++) if (open_fds[i]) close(fds[i].fd);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  res->exit_code = timed_out ? -2
+      : (WIFEXITED(status) ? WEXITSTATUS(status) : -1);
+  res->out = strdup(out_buf.c_str());
+  res->err = strdup(err_buf.c_str());
+}
+
+// Fan N commands out over a bounded thread pool. Returns a malloc'd
+// KoResult[n]; caller frees with ko_free_results.
+KoResult* ko_fanout(const char** cmds, int n, int max_parallel, double timeout_s) {
+  auto* results = (KoResult*)calloc(n, sizeof(KoResult));
+  if (n <= 0) return results;
+  std::atomic<int> next{0};
+  int workers = std::min(std::max(max_parallel, 1), n);
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int w = 0; w < workers; w++) {
+    pool.emplace_back([&] {
+      for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1))
+        run_one(cmds[i], timeout_s, &results[i]);
+    });
+  }
+  for (auto& t : pool) t.join();
+  return results;
+}
+
+void ko_free_results(KoResult* results, int n) {
+  if (!results) return;
+  for (int i = 0; i < n; i++) {
+    free(results[i].out);
+    free(results[i].err);
+  }
+  free(results);
+}
+
+// Incremental file tail: read up to cap bytes starting at offset.
+// Returns bytes read (0 = nothing new), -1 = open failure.
+long ko_tail(const char* path, long offset, char* out, long cap) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  if (lseek(fd, offset, SEEK_SET) < 0) { close(fd); return -1; }
+  long total = 0;
+  while (total < cap) {
+    ssize_t n = read(fd, out + total, cap - total);
+    if (n <= 0) break;
+    total += n;
+  }
+  close(fd);
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,67 @@
+"""koagent C++ library: build, fan-out semantics, tail."""
+
+import os
+import time
+
+import pytest
+
+from kubeoperator_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("g++ unavailable — python fallbacks cover this path")
+    return lib
+
+
+def test_fanout_outputs_aligned(lib):
+    results = native.fanout(["echo one", "echo two >&2; exit 3", "printf x"],
+                            max_parallel=2)
+    assert [r[0] for r in results] == [0, 3, 0]
+    assert results[0][1].strip() == "one"
+    assert results[1][2].strip() == "two"
+    assert results[2][1] == "x"
+
+
+def test_fanout_parallelism(lib):
+    t0 = time.perf_counter()
+    results = native.fanout(["sleep 0.4"] * 8, max_parallel=8)
+    dt = time.perf_counter() - t0
+    assert all(r[0] == 0 for r in results)
+    assert dt < 1.5            # serial would be ~3.2s
+
+
+def test_fanout_timeout_kills(lib):
+    t0 = time.perf_counter()
+    results = native.fanout(["sleep 30"], timeout_s=0.5)
+    assert time.perf_counter() - t0 < 5
+    assert results[0][0] == -2
+    assert "timeout" in results[0][2]
+
+
+def test_tail_incremental(lib, tmp_path):
+    p = tmp_path / "log.txt"
+    p.write_text("hello ")
+    chunk, off = native.tail(str(p), 0)
+    assert chunk == "hello "
+    with open(p, "a") as f:
+        f.write("world")
+    chunk, off = native.tail(str(p), off)
+    assert chunk == "world"
+    chunk, off2 = native.tail(str(p), off)
+    assert chunk == "" and off2 == off
+
+
+def test_executor_run_many_fanout(platform):
+    """SSHExecutor.run_many path with FakeExecutor (sequential base) and
+    command alignment under the engine's Conn shape."""
+    from kubeoperator_tpu.engine.executor import Conn, FakeExecutor
+    fake = FakeExecutor()
+    fake.host("10.9.0.1").facts.update({"cpu_core": 2})
+    results = fake.run_many([(Conn(ip="10.9.0.1"), "true"),
+                             (Conn(ip="10.9.0.2"), "true")])
+    assert len(results) == 2
+    assert os.path.exists(os.path.join(os.path.dirname(native.__file__),
+                                       "..", "native", "koagent.cpp"))
