@@ -60,6 +60,7 @@ class TrainConfig:
     warmup_steps: int = 500
     total_steps: int = 50_000
     dtype: Any = jnp.bfloat16
+    stem: str = "conv"               # "space_to_depth" = MLPerf conv0 s2d (TPU)
 
 
 @dataclass
@@ -112,10 +113,12 @@ class Trainer:
         self.spec = spec or MeshSpec(dp=len(devices))
         self.mesh = build_mesh(self.spec, devices)
         self.model = resnet.ResNet(num_classes=self.cfg.num_classes,
-                                   depth=self.cfg.depth, dtype=self.cfg.dtype)
+                                   depth=self.cfg.depth, dtype=self.cfg.dtype,
+                                   stem=self.cfg.stem)
         self.tx = make_optimizer(self.cfg)
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None
+        self._init_fn: Callable | None = None
 
     # -- state -------------------------------------------------------------
     def init_state(self, rng: jax.Array | None = None) -> TrainState:
@@ -128,13 +131,15 @@ class Trainer:
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               batch_stats=stats, opt_state=self.tx.init(params))
 
-        abstract = jax.eval_shape(init, rng)
-        # one shape-based rule over the whole state: params and their
-        # momentum buffers land on identical fsdp shards, scalars replicate
-        shardings = jax.tree.map(
-            lambda x: place_by_shape(x, self.mesh, self.spec), abstract)
-        self.state_shardings = shardings
-        return jax.jit(init, out_shardings=shardings)(rng)
+        if self._init_fn is None:
+            abstract = jax.eval_shape(init, rng)
+            # one shape-based rule over the whole state: params and their
+            # momentum buffers land on identical fsdp shards, scalars replicate
+            shardings = jax.tree.map(
+                lambda x: place_by_shape(x, self.mesh, self.spec), abstract)
+            self.state_shardings = shardings
+            self._init_fn = jax.jit(init, out_shardings=shardings)
+        return self._init_fn(rng)
 
     # -- step --------------------------------------------------------------
     def train_step(self, state: TrainState, images: jnp.ndarray,
@@ -166,26 +171,41 @@ class Trainer:
         return jax.jit(self._py_step, donate_argnums=(0,),
                        in_shardings=(None, self.batch_shd, self.batch_shd))
 
-    def multi_step_fn(self, k: int) -> Callable:
-        """K train steps per dispatch via lax.scan, each on a fresh on-device
-        synthetic batch. Amortizes the per-dispatch launch overhead (~5 ms
-        through the axon relay on this pod — measured 29.4% → 31.8% MFU at
-        k=8) the way a real input pipeline amortizes it with device prefetch.
+    def multi_step_fn(self, k: int, fresh_data: bool = False) -> Callable:
+        """K train steps per dispatch via lax.scan. Amortizes the
+        per-dispatch launch overhead (~5 ms through the axon relay on this
+        pod — measured 29.4% → 31.8% MFU at k=8) the way a real input
+        pipeline amortizes it with device prefetch.
+
+        By default the batch is generated once and reused each iteration —
+        the profile showed per-step threefry (38 M bf16 normals) fused into
+        the stem conv, billing data synthesis to the model. ``fresh_data``
+        regenerates per step (for loss-curve realism, not for MFU).
 
         Returns ``fn(state, key) -> (state, losses[k])``.
         """
         cfg = self.cfg
         shape = (cfg.batch_size, cfg.image_size, cfg.image_size, 3)
 
-        def body(carry, _):
-            state, key = carry
-            key, ki, kl = jax.random.split(key, 3)
+        def synth(key):
+            ki, kl = jax.random.split(key)
             images = jax.random.normal(ki, shape, jnp.bfloat16)
             labels = jax.random.randint(kl, (cfg.batch_size,), 0, cfg.num_classes)
-            state, metrics = self._py_step(state, images, labels)
-            return (state, key), metrics["loss"]
+            return images, labels
 
         def multi(state, key):
+            fixed = None if fresh_data else synth(key)
+
+            def body(carry, _):
+                state, key = carry
+                if fresh_data:
+                    key, kb = jax.random.split(key)
+                    images, labels = synth(kb)
+                else:
+                    images, labels = fixed  # generated once, outside the loop
+                state, metrics = self._py_step(state, images, labels)
+                return (state, key), metrics["loss"]
+
             (state, key), losses = jax.lax.scan(body, (state, key), None, length=k)
             return state, losses
 
@@ -207,20 +227,29 @@ class Trainer:
     def flops_per_step(self, batch: int | None = None) -> float:
         """fwd + bwd ≈ 3× forward FLOPs (bwd is two matmul-shaped passes)."""
         fwd = resnet.flops_per_image(self.cfg.depth, self.cfg.image_size,
-                                     self.cfg.num_classes)
+                                     self.cfg.num_classes, stem=self.cfg.stem)
         return 3.0 * fwd * (batch or self.cfg.batch_size)
 
     def measure(self, steps: int = 20, warmup: int = 3, batch: int | None = None,
-                steps_per_call: int = 1) -> dict:
+                steps_per_call: int = 1, profile_dir: str | None = None,
+                fresh_data: bool = False) -> dict:
         """Timed loop → img/sec/chip + MFU.
 
-        ``steps_per_call > 1`` uses the scanned multi-step (fresh data each
-        step); ``steps`` then counts scan calls, so total steps =
-        steps × steps_per_call. The scanned path always trains at
-        cfg.batch_size (the scan body generates its own batches), so a
-        ``batch`` override is rejected there rather than silently
-        misreporting throughput. warmup is clamped to ≥1: the post-warmup
-        fence is what keeps compile time out of the timed loop.
+        ``steps_per_call > 1`` uses the scanned multi-step; ``steps`` then
+        counts scan calls, so total steps = steps × steps_per_call. The
+        scan trains on ONE device-resident batch generated outside the loop
+        (same convention as the non-scanned path; per-step threefry was
+        measured fusing into the stem conv and billing data synthesis to
+        the model — PERF.md); pass ``fresh_data=True`` to regenerate per
+        step instead. The scanned path always trains at cfg.batch_size
+        (the scan body owns its batch), so a ``batch`` override is rejected
+        there rather than silently misreporting throughput. warmup is
+        clamped to ≥1: the post-warmup fence is what keeps compile time out
+        of the timed loop.
+
+        ``profile_dir`` wraps the timed loop in ``jax.profiler.trace`` so the
+        XLA op breakdown can be inspected (tensorboard or the trace.json.gz
+        directly) instead of tuning blind.
         """
         if steps_per_call > 1 and batch not in (None, self.cfg.batch_size):
             raise ValueError("batch override is incompatible with steps_per_call>1; "
@@ -228,29 +257,34 @@ class Trainer:
         batch = batch or self.cfg.batch_size
         warmup = max(1, warmup)
         state = self.init_state()
+        import contextlib
+        prof = (jax.profiler.trace(profile_dir) if profile_dir
+                else contextlib.nullcontext())
         # barrier via host transfer: on the axon TPU relay platform,
         # block_until_ready returns before execution finishes — a value
         # fetch is the only reliable fence (measured: 0.007s "block" vs
         # 9.4s actual for the same queue).
         if steps_per_call > 1:
-            fn = self.multi_step_fn(steps_per_call)
+            fn = self.multi_step_fn(steps_per_call, fresh_data=fresh_data)
             key = jax.random.key(1)
             for _ in range(warmup):
                 state, losses = fn(state, key)
             float(losses[-1])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                state, losses = fn(state, key)
-            float(losses[-1])
+            with prof:
+                for _ in range(steps):
+                    state, losses = fn(state, key)
+                float(losses[-1])
         else:
             images, labels = self.synthetic_batch(batch)
             for _ in range(warmup):
                 state, metrics = self.train_step(state, images, labels)
             float(metrics["loss"])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                state, metrics = self.train_step(state, images, labels)
-            float(metrics["loss"])
+            with prof:
+                for _ in range(steps):
+                    state, metrics = self.train_step(state, images, labels)
+                float(metrics["loss"])
         dt = time.perf_counter() - t0
         total_steps = steps * steps_per_call
         n_chips = self.mesh.devices.size

@@ -18,6 +18,15 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: the workload tests re-jit identical programs
+# (e.g. the jobs entrypoint builds a fresh Trainer per invocation); this
+# makes reruns and resume-paths hit disk instead of XLA. Per-checkout path:
+# a shared /tmp dir would collide across users and can replay AOT artifacts
+# compiled for a different CPU feature set (SIGILL risk).
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 import pytest
 

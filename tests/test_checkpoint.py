@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeoperator_tpu.workloads.checkpoint import WorkloadCheckpointer
 from kubeoperator_tpu.workloads.sharding import MeshSpec
@@ -12,8 +13,18 @@ TINY = TrainConfig(batch_size=16, image_size=32, num_classes=10, depth=18,
                    warmup_steps=2, total_steps=10)
 
 
-def test_save_restore_roundtrip(tmp_path):
-    tr = Trainer(TINY, MeshSpec(fsdp=8))
+@pytest.fixture(scope="module")
+def tr_dp8():
+    return Trainer(TINY, MeshSpec(dp=8))
+
+
+@pytest.fixture(scope="module")
+def tr_fsdp8():
+    return Trainer(TINY, MeshSpec(fsdp=8))
+
+
+def test_save_restore_roundtrip(tmp_path, tr_fsdp8):
+    tr = tr_fsdp8
     state = tr.init_state()
     images, labels = tr.synthetic_batch()
     state, _ = tr.train_step(state, images, labels)
@@ -32,9 +43,8 @@ def test_save_restore_roundtrip(tmp_path):
     ckpt.close()
 
 
-def test_retention(tmp_path):
-    tr = Trainer(TINY, MeshSpec(dp=8))
-    state = tr.init_state()
+def test_retention(tmp_path, tr_dp8):
+    state = tr_dp8.init_state()
     ckpt = WorkloadCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2)
     for step in (1, 2, 3):
         ckpt.save(step, state)
@@ -43,22 +53,20 @@ def test_retention(tmp_path):
     ckpt.close()
 
 
-def test_restore_into_different_mesh(tmp_path):
+def test_restore_into_different_mesh(tmp_path, tr_dp8, tr_fsdp8):
     """Save under dp=8, restore under fsdp=8 — shardings come from the
     abstract target, not the checkpoint."""
-    tr_a = Trainer(TINY, MeshSpec(dp=8))
-    state = tr_a.init_state(jax.random.key(5))
+    state = tr_dp8.init_state(jax.random.key(5))
     ckpt = WorkloadCheckpointer(str(tmp_path / "ckpt"))
     ckpt.save(0, state)
 
-    tr_b = Trainer(TINY, MeshSpec(fsdp=8))
-    target = tr_b.init_state(jax.random.key(5))
+    target = tr_fsdp8.init_state(jax.random.key(5))
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), target)
     restored = ckpt.restore(abstract)
     np.testing.assert_array_equal(np.asarray(jax.tree.leaves(state)[0]),
                                   np.asarray(jax.tree.leaves(restored)[0]))
-    images, labels = tr_b.synthetic_batch()
-    state2, metrics = tr_b.train_step(restored, images, labels)
+    images, labels = tr_fsdp8.synthetic_batch()
+    state2, metrics = tr_fsdp8.train_step(restored, images, labels)
     assert np.isfinite(float(metrics["loss"]))
     ckpt.close()

@@ -61,9 +61,13 @@ def test_backup_tick_runs_due_strategy(platform, fake_executor, manual_cluster):
     assert ex.state == ExecutionState.SUCCESS, ex.result
     platform.store.save(BackupStrategy(project="demo", enabled=True, name="daily"))
 
+    # timestamps must share the store's (real) date: due_strategies compares
+    # the tick date against execution created_at dates
+    from kubeoperator_tpu.utils.timeutil import iso
+    d = iso()[:10]
     # before the backup hour → nothing
-    assert backups.backup_tick(platform, "2026-07-29T00:30:00+00:00") == []
-    started = backups.backup_tick(platform, "2026-07-29T01:05:00+00:00")
+    assert backups.backup_tick(platform, f"{d}T00:30:00+00:00") == []
+    started = backups.backup_tick(platform, f"{d}T01:05:00+00:00")
     assert started == ["demo"]
     # wait for the backup execution to finish
     from kubeoperator_tpu.resources.entities import DeployExecution
@@ -76,7 +80,7 @@ def test_backup_tick_runs_due_strategy(platform, fake_executor, manual_cluster):
         time.sleep(0.1)
     assert exs and exs[0].state == ExecutionState.SUCCESS, exs and exs[0].result
     # same day again → not due
-    assert backups.backup_tick(platform, "2026-07-29T01:59:00+00:00") == []
+    assert backups.backup_tick(platform, f"{d}T01:59:00+00:00") == []
 
 
 def test_backup_tick_skips_disabled_and_not_running(platform):
