@@ -30,7 +30,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from kubeoperator_tpu.api.app import ensure_admin, run_server
-    from kubeoperator_tpu.services import backups, monitor
+    from kubeoperator_tpu.services import backups, ldap_auth, monitor
     from kubeoperator_tpu.services.platform import Platform
 
     platform = Platform()
@@ -38,6 +38,7 @@ def main(argv: list[str] | None = None) -> int:
     if not args.no_beat:
         monitor.schedule(platform)
         backups.schedule(platform)
+        ldap_auth.schedule(platform)
     try:
         run_server(platform, host=args.host, port=args.port)
     finally:

@@ -26,10 +26,12 @@ from kubeoperator_tpu.api import auth
 from kubeoperator_tpu.resources.entities import (
     BackupStorage, BackupStrategy, Cluster, ClusterBackup, Credential,
     DeployExecution, HealthRecord, Host, Item, ItemResource, Message, Node,
-    Package, Plan, Region, User, Zone,
+    Package, Plan, Region, StorageBackend, User, Zone,
 )
 from kubeoperator_tpu.resources.entities import Setting
-from kubeoperator_tpu.services.platform import Platform, PlatformError
+from kubeoperator_tpu.services.platform import (
+    Platform, PlatformError, WebkubectlSessionError,
+)
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -47,6 +49,10 @@ def dump(entity: Any) -> dict:
         # (e.g. _sa_token) — never serve them on the ordinary read path
         d["configs"] = {k: v for k, v in d["configs"].items()
                         if not k.startswith("_")}
+    if isinstance(d.get("config"), dict):
+        # storage-backend configs carry credentials (external-ceph userKey)
+        d["config"] = {k: ("***" if k in ("key", "password", "secret") and v else v)
+                       for k, v in d["config"].items()}
     return d
 
 
@@ -78,6 +84,11 @@ async def error_middleware(request: web.Request, handler):
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     protected = request.path.startswith("/api") or request.path.startswith("/ws")
+    # webkubectl sessions authenticate by their own one-time token (issued
+    # to an already-authorized caller by the token route), like the
+    # reference's webkubectl sidecar
+    if request.path.startswith("/ws/webkubectl/"):
+        protected = False
     if (request.method, request.path) in PUBLIC_ROUTES or not protected:
         return await handler(request)
     platform: Platform = request.app["platform"]
@@ -90,7 +101,7 @@ async def auth_middleware(request: web.Request, handler):
     except auth.AuthError as e:
         return json_error(401, str(e))
     user = await _sync(request, platform.store.get_by_name, User, claims["sub"], scoped=False)
-    if user is None:
+    if user is None or user.disabled:
         return json_error(401, "user no longer exists")
     request["user"] = user
     return await handler(request)
@@ -156,7 +167,7 @@ async def login(request: web.Request) -> web.Response:
         # unknown local user → LDAP fallback (reference: django-auth-ldap
         # backend ordered after ModelBackend)
         user = await _sync(request, _ldap_auth, platform, username, password)
-    if user is None:
+    if user is None or user.disabled:
         return json_error(401, "invalid credentials")
     token = auth.encode({"sub": user.name, "adm": user.is_admin},
                         platform.config.auth_secret,
@@ -329,16 +340,19 @@ async def get_cluster_token(request: web.Request) -> web.Response:
     return web.json_response({"token": token})
 
 async def webkubectl_token(request: web.Request) -> web.Response:
-    check_cluster_access(request, request.match_info["name"], write=True)
     """Reference ``get_webkubectl_token`` (``cluster.py:395-402``): a
-    session token for the in-browser kubectl sidecar."""
+    session token for the in-browser kubectl bridge. The token is honored
+    by ``/ws/webkubectl/{token}``, which executes kubectl on the first
+    master (Platform.webkubectl_exec)."""
+    check_cluster_access(request, request.match_info["name"], write=True)
     platform: Platform = request.app["platform"]
     name = request.match_info["name"]
-    cluster = await _sync(request, platform.store.get_by_name, Cluster, name,
-                          scoped=False)
-    if cluster is None:
-        return json_error(404, "cluster not found")
-    return web.json_response({"token": secrets.token_urlsafe(16), "cluster": name})
+    try:
+        token = await _sync(request, platform.webkubectl_session, name)
+    except PlatformError as e:
+        return json_error(404, str(e))
+    return web.json_response({"token": token, "cluster": name,
+                              "ws": f"/ws/webkubectl/{token}"})
 
 async def cluster_health(request: web.Request) -> web.Response:
     check_cluster_access(request, request.match_info["name"], write=False)
@@ -365,6 +379,53 @@ async def list_backups(request: web.Request) -> web.Response:
     backups = await _sync(request, platform.store.find, ClusterBackup, scoped=False,
                           project=request.match_info["name"])
     return web.json_response([dump(b) for b in backups])
+
+async def cluster_error_logs(request: web.Request) -> web.Response:
+    """Loki-harvested error lines for one cluster (reference Loki scrape
+    plane, ``prometheus_client.py:119-149``; persisted by
+    ``monitor.ClusterMonitor.harvest_error_logs``)."""
+    check_cluster_access(request, request.match_info["name"], write=False)
+    from kubeoperator_tpu.services.monitor import MonitorSnapshot
+    platform: Platform = request.app["platform"]
+    snaps = await _sync(request, platform.store.find, MonitorSnapshot,
+                        scoped=False,
+                        name=f"{request.match_info['name']}:errorlogs")
+    data = snaps[0].data if snaps else {"error_logs": []}
+    return web.json_response(data)
+
+async def search_system_logs(request: web.Request) -> web.Response:
+    """System-log search over the task logs (reference ES log plane,
+    ``log/es.py:9-52``). ?query=&level=&task=&limit="""
+    require_admin(request)
+    from kubeoperator_tpu.services import logsearch
+    platform: Platform = request.app["platform"]
+    q = request.query
+    try:
+        records = await _sync(request, logsearch.search_logs, platform,
+                              q.get("query", ""), q.get("level", ""),
+                              q.get("task", ""), int(q.get("limit", "200")))
+    except ValueError as e:
+        return json_error(400, str(e))
+    return web.json_response({"logs": records})
+
+async def search_cluster_events(request: web.Request) -> web.Response:
+    """Event search over harvested events (reference ``search_event``,
+    ``log/es.py`` + ``api.py:546-554``). ?query=&cluster=&type=&limit=
+    Item-scoped: members only see events of clusters their items grant."""
+    from kubeoperator_tpu.services import logsearch
+    platform: Platform = request.app["platform"]
+    q = request.query
+    try:
+        limit = int(q.get("limit", "200"))
+    except ValueError:
+        return json_error(400, "limit must be an integer")
+    events = await _sync(request, logsearch.search_events, platform,
+                         q.get("query", ""), q.get("cluster", ""),
+                         q.get("type", ""), limit)
+    visible = await _sync(request, visible_cluster_names, request)
+    if visible is not None:
+        events = [e for e in events if e.get("cluster") in visible]
+    return web.json_response({"events": events})
 
 async def dashboard(request: web.Request) -> web.Response:
     from kubeoperator_tpu.services import monitor as monitor_svc
@@ -497,6 +558,70 @@ async def list_messages(request: web.Request) -> web.Response:
 # websockets (reference kubeops_api/ws.py + celery_api/ws.py)
 # ---------------------------------------------------------------------------
 
+async def deploy_storage_backend(request: web.Request) -> web.Response:
+    """Converge a managed NFS/Ceph backend (reference NfsStorage deploys
+    its server via the nfs.yml playbook, storage/models.py:20-60)."""
+    require_admin(request)
+    platform: Platform = request.app["platform"]
+    try:
+        backend = await _sync(request, platform.deploy_storage_backend,
+                              request.match_info["name"])
+    except PlatformError as e:
+        return json_error(400, str(e))
+    return web.json_response(dump(backend))
+
+async def scan_packages_route(request: web.Request) -> web.Response:
+    """Rescan <data>/packages/*/meta.yml (reference re-runs Package.lookup
+    on app-ready; this exposes it on demand too)."""
+    require_admin(request)
+    from kubeoperator_tpu.services import packages as packages_svc
+    platform: Platform = request.app["platform"]
+    pkgs = await _sync(request, packages_svc.scan_packages, platform)
+    return web.json_response({"packages": [dump(p) for p in pkgs]})
+
+async def repo_file(request: web.Request) -> web.Response:
+    """Static package repo (nexus-lite): nodes `curl $repo_url/<path>` from
+    here during installs — the reference's per-package nexus container
+    (package_manage.py:31-53) without the sidecar. Unauthenticated by
+    design, like the in-cluster nexus."""
+    from kubeoperator_tpu.services import packages as packages_svc
+    platform: Platform = request.app["platform"]
+    try:
+        path = await _sync(request, packages_svc.resolve_file, platform,
+                           request.match_info["package"],
+                           request.match_info["path"])
+    except FileNotFoundError as e:
+        return json_error(404, str(e))
+    except PermissionError as e:
+        return json_error(403, str(e))
+    return web.FileResponse(path)
+
+async def ws_webkubectl(request: web.Request) -> web.WebSocketResponse:
+    """In-browser kubectl: each text frame is one kubectl command line,
+    the reply frame is its output (reference webkubectl sidecar,
+    ``docker-compose.yml``; session token from the token route is the
+    auth, as with the sidecar)."""
+    platform: Platform = request.app["platform"]
+    token = request.match_info["token"]
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    try:
+        async for msg in ws:
+            if msg.type != web.WSMsgType.TEXT:
+                break
+            try:
+                out = await _sync(request, platform.webkubectl_exec, token,
+                                  msg.data)
+                await ws.send_json({"output": out})
+            except WebkubectlSessionError as e:
+                await ws.send_json({"error": str(e)})
+                break                      # dead session: close the bridge
+            except PlatformError as e:
+                await ws.send_json({"error": str(e)})   # per-command error
+    finally:
+        await ws.close()
+    return ws
+
 async def ws_progress(request: web.Request) -> web.WebSocketResponse:
     """Push execution step JSON every second until it finishes
     (reference ``F2OWebsocket``, 1 s cadence, ``ws.py:8-30``)."""
@@ -598,8 +723,11 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/clusters/{name}/health", cluster_health)
     r.add_get("/api/v1/clusters/{name}/grade", cluster_grade)
     r.add_get("/api/v1/clusters/{name}/backups", list_backups)
+    r.add_get("/api/v1/clusters/{name}/errorlogs", cluster_error_logs)
     r.add_get("/api/v1/executions/{id}", get_execution)
     r.add_get("/api/v1/dashboard/{item}", dashboard)
+    r.add_get("/api/v1/logs", search_system_logs)
+    r.add_get("/api/v1/events", search_cluster_events)
 
     r.add_get("/api/v1/hosts", list_hosts)
     r.add_post("/api/v1/hosts", create_host)
@@ -611,8 +739,12 @@ def create_app(platform: Platform) -> web.Application:
     register_crud(app, "/api/v1/zones", Zone)
     register_crud(app, "/api/v1/plans", Plan)
     register_crud(app, "/api/v1/packages", Package)
+    r.add_post("/api/v1/packages/scan", scan_packages_route)
+    r.add_get("/repo/{package}/{path:.+}", repo_file)
     register_crud(app, "/api/v1/items", Item, create=_create_item)
     register_crud(app, "/api/v1/users", User, create=_create_user)
+    register_crud(app, "/api/v1/storage-backends", StorageBackend)
+    r.add_post("/api/v1/storage-backends/{name}/deploy", deploy_storage_backend)
     register_crud(app, "/api/v1/backup-storages", BackupStorage)
     register_crud(app, "/api/v1/backup-strategies", BackupStrategy)
     register_crud(app, "/api/v1/settings", Setting)
@@ -625,6 +757,7 @@ def create_app(platform: Platform) -> web.Application:
 
     r.add_get("/ws/progress/{id}", ws_progress)
     r.add_get("/ws/tasks/{id}/log", ws_task_log)
+    r.add_get("/ws/webkubectl/{token}", ws_webkubectl)
 
     ui_dir = os.path.join(os.path.dirname(__file__), "..", "ui")
 
@@ -652,6 +785,10 @@ def run_server(platform: Platform | None = None, host: str | None = None,
                port: int | None = None) -> None:
     platform = platform or Platform()
     ensure_admin(platform)
+    # boot-time package registry scan (reference runs Package.lookup on
+    # app-ready, signal_handlers.py:38-43)
+    from kubeoperator_tpu.services import packages as packages_svc
+    packages_svc.scan_packages(platform)
     app = create_app(platform)
     web.run_app(app, host=host or platform.config.bind_host,
                 port=port or int(platform.config.bind_port))

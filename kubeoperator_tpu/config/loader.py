@@ -33,6 +33,8 @@ DEFAULTS: dict[str, Any] = {
     "ssh_connect_timeout": 10,
     # api
     "bind_host": "127.0.0.1",
+    "repo_host": "",                        # node-reachable controller addr for
+                                            # the /repo package plane (KO_REPO_HOST)
     "bind_port": 8000,
     "auth_secret": "kubeoperator-tpu-dev-key",
     "token_ttl_hours": 24,                  # ref JWT_AUTH expiration (settings.py:218-223)

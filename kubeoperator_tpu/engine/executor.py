@@ -214,7 +214,13 @@ class FakeHost:
     facts: dict[str, Any] = field(default_factory=dict)
     history: list[str] = field(default_factory=list)
     fail_patterns: list[str] = field(default_factory=list)
+    responses: list[tuple[str, str]] = field(default_factory=list)  # pattern -> stdout
     down: bool = False
+
+    def respond(self, pattern: str, stdout: str) -> None:
+        """Canned stdout for commands matching ``pattern`` (checked before
+        the built-in shell emulation)."""
+        self.responses.append((pattern, stdout))
 
 
 class FakeExecutor(Executor):
@@ -272,6 +278,9 @@ class FakeExecutor(Executor):
     # -- command emulation -------------------------------------------------
     def _interpret(self, h: FakeHost, command: str) -> ExecResult:
         facts = h.facts
+        for pat, stdout in h.responses:
+            if re.search(pat, command):
+                return ExecResult(0, stdout)
         if command.strip() == "true":
             return ExecResult(0)
         if m := re.match(r"^test -[ef] (\S+)$", command.strip()):

@@ -58,6 +58,38 @@ spec:
   resources: {requests: {storage: 1Gi}}
 """
 
+CEPH_SECRET = """apiVersion: v1
+kind: Secret
+metadata: {{name: ceph-csi-secret, namespace: kube-system}}
+stringData:
+  userID: "{ceph_user}"
+  userKey: "{ceph_key}"
+"""
+
+
+def _resolve_backend(ctx: StepContext, cfg: dict) -> None:
+    """A ``backend`` name in storage_config points at a managed
+    StorageBackend (reference NfsStorage/CephStorage rows) — pull the
+    server address/credentials from it."""
+    from kubeoperator_tpu.resources.entities import StorageBackend
+
+    backend = ctx.store.get_by_name(StorageBackend, cfg["backend"], scoped=False)
+    if backend is None:
+        raise StepError(f"storage backend {cfg['backend']!r} not found")
+    if backend.status != "READY":
+        raise StepError(f"storage backend {backend.name!r} is {backend.status}, "
+                        "deploy it first")
+    # one precedence rule for every field: an explicit value in the
+    # cluster's storage_config wins, the backend fills the gaps
+    fill = lambda key, value: cfg.__setitem__(key, cfg.get(key) or value)
+    if backend.type == "nfs":
+        fill("nfs_server", backend.config.get("server_ip", ""))
+        fill("nfs_path", backend.config.get("export_path", "/export"))
+    elif backend.type == "external-ceph":
+        fill("ceph_monitors", backend.config.get("monitors", ""))
+        fill("ceph_user", backend.config.get("user", "admin"))
+        fill("ceph_key", backend.config.get("key", ""))
+
 
 def run(ctx: StepContext):
     provider = ctx.cluster.storage_provider
@@ -66,9 +98,17 @@ def run(ctx: StepContext):
     if ctx.cluster.deploy_type not in spec["deploy_types"]:
         raise StepError(f"storage {provider!r} not allowed for {ctx.cluster.deploy_type}")
     tmpl = TEMPLATES[provider]
-    cfg = {"nfs_server": "", "nfs_path": "/export", "ceph_monitors": ""}
-    cfg.update(ctx.cluster.storage_config)
+    # precedence: explicit cluster storage_config > managed backend > defaults
+    cfg = dict(ctx.cluster.storage_config)
+    if cfg.get("backend"):
+        _resolve_backend(ctx, cfg)
+    for key, default in (("nfs_server", ""), ("nfs_path", "/export"),
+                         ("ceph_monitors", ""), ("ceph_user", "admin"),
+                         ("ceph_key", "")):
+        cfg.setdefault(key, default)
     manifest = tmpl.format(**cfg)
+    if provider == "external-ceph" and cfg["ceph_key"]:
+        manifest += "---\n" + CEPH_SECRET.format(**cfg)
 
     def per(th):
         o = ctx.ops(th)

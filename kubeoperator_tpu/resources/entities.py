@@ -326,6 +326,7 @@ class User:
     email: str = ""
     is_admin: bool = False
     source: str = "local"          # local | ldap
+    disabled: bool = False         # set by LDAP sync when the entry vanishes
     password_hash: str = ""
     salt: str = ""
     item_roles: dict[str, str] = field(default_factory=dict)  # item name -> ItemRole
@@ -367,6 +368,24 @@ class Message:
     project: str | None = None
     read_by: list[str] = field(default_factory=list)
     name: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class StorageBackend:
+    """Managed storage backend (reference ``storage/models.py:20-60``:
+    ``NfsStorage`` — an NFS server the platform itself deploys onto a
+    host — and ``CephStorage`` — credentials for an external Ceph).
+
+    type=nfs  config: {host: <registered host name>, export_path: /export}
+    type=external-ceph  config: {monitors, user, key, pool}
+    """
+    KIND = "storage_backend"
+    name: str = ""
+    type: str = "nfs"              # nfs | external-ceph
+    config: dict[str, Any] = field(default_factory=dict)
+    status: str = "PENDING"        # PENDING | READY | ERROR
     id: str = field(default_factory=new_id)
     created_at: str = field(default_factory=iso)
 

@@ -135,6 +135,42 @@ class PromClient:
         return out
 
 
+class LokiClient:
+    """LogQL over the master-routed ingress — the error-log scrape plane
+    (reference ``prometheus_client.py:119-149`` queries Loki for
+    ``|~ "error"`` lines per namespace on an hourly beat)."""
+
+    def __init__(self, master_ip: str, transport: Transport | None = None,
+                 timeout: float = 10.0):
+        self.base = f"http://{master_ip}:30910"   # same ingress nodePort
+        self.headers = {"Host": "loki.apps.ko"}
+        self.transport = transport or urllib_transport
+        self.timeout = timeout
+
+    def query(self, logql: str, limit: int = 100) -> list[dict]:
+        """Instant query → flattened entries
+        ``[{"labels": {...}, "ts": ns_str, "line": str}, ...]``."""
+        from urllib.parse import quote
+        status, body = self.transport(
+            "GET", f"{self.base}/loki/api/v1/query?query={quote(logql)}&limit={limit}",
+            self.headers, self.timeout)
+        if status != 200:
+            raise RuntimeError(f"loki {status}: {body[:200]}")
+        out = []
+        for stream in json.loads(body).get("data", {}).get("result", []):
+            labels = stream.get("stream", {})
+            for ts, line in stream.get("values", []):
+                out.append({"labels": labels, "ts": ts, "line": line})
+        out.sort(key=lambda e: e["ts"], reverse=True)
+        return out
+
+    def error_logs(self, limit: int = 100) -> list[dict]:
+        """Recent error-ish lines across all namespaces (reference LogQL,
+        ``prometheus_client.py:119-149``)."""
+        return self.query('{namespace=~".+"} |~ `(?i)(error|exception|fatal)`',
+                          limit=limit)
+
+
 class ClusterMonitor:
     def __init__(self, platform, cluster: Cluster, transport: Transport | None = None):
         self.platform = platform
@@ -157,6 +193,9 @@ class ClusterMonitor:
 
     def prom(self) -> PromClient:
         return PromClient(self.master_ip, self.transport)
+
+    def loki(self) -> LokiClient:
+        return LokiClient(self.master_ip, self.transport)
 
     # -- snapshot (reference get_cluster_data → Redis) ---------------------
     def snapshot(self) -> dict[str, Any]:
@@ -233,6 +272,26 @@ class ClusterMonitor:
         store.save(snap)
         return events
 
+    # -- error logs (reference Loki hourly beat, prometheus_client.py:119-149)
+    def harvest_error_logs(self, limit: int = 200) -> list[dict]:
+        """Pull recent error lines from the in-cluster Loki and persist them
+        as a ``<name>:errorlogs`` snapshot for the dashboard/UI read path
+        (the role ES plays for the reference's log plane)."""
+        entries = [{
+            "namespace": e["labels"].get("namespace", ""),
+            "pod": e["labels"].get("pod", e["labels"].get("instance", "")),
+            "ts": e["ts"], "line": e["line"][:500],
+        } for e in self.loki().error_logs(limit=limit)]
+        store = self.platform.store
+        existing = store.find(MonitorSnapshot, scoped=False,
+                              name=f"{self.cluster.name}:errorlogs")
+        snap = existing[0] if existing else MonitorSnapshot(
+            project=self.cluster.name, name=f"{self.cluster.name}:errorlogs")
+        snap.data = {"error_logs": entries[:limit]}
+        snap.created_at = iso_now()
+        store.save(snap)
+        return entries
+
     # -- health (reference models/health/*, 5-min beat) --------------------
     def host_health(self) -> list[HealthRecord]:
         """SSH ping every cluster host (reference ``host_health.py:9-43``),
@@ -267,12 +326,27 @@ class ClusterMonitor:
                 by_name[host.name] = (results[i].ok, {} if results[i].ok
                                       else {"error": results[i].stderr[:200]})
         records = []
+        host_ok: dict[str, bool] = {}
         for host in hosts:
             if host.name in conn_errors:
                 healthy, detail = False, {"error": conn_errors[host.name]}
             else:
                 healthy, detail = by_name[host.name]
+            host_ok[host.name] = healthy
             records.append(self._record("host", host.name, healthy, detail, hour))
+        # slice grain: a TPU pod slice is one schedulable unit — any dead
+        # member makes the whole slice unusable (catalog.yml slice topology;
+        # the reference has no equivalent, its hosts are independent VMs)
+        slices: dict[str, list] = {}
+        for host in hosts:
+            if host.tpu_slice_id:
+                slices.setdefault(host.tpu_slice_id, []).append(host)
+        for slice_id, members in slices.items():
+            down = [h.name for h in members if not host_ok.get(h.name, False)]
+            records.append(self._record(
+                "slice", slice_id, not down,
+                {"members": len(members), "down": down} if down
+                else {"members": len(members)}, hour))
         return records
 
     def node_health(self) -> list[HealthRecord]:
@@ -390,11 +464,24 @@ def dashboard_data(platform, item: str = "") -> dict[str, Any]:
         allowed = {r.name for r in platform.store.find(
             ItemResource, scoped=False, item_id=it.id, resource_type="cluster")} if it else set()
         clusters = [c for c in clusters if c.name in allowed]
-    snaps = []
+    snaps, error_logs, bad_slices = [], [], []
     for c in clusters:
         found = platform.store.find(MonitorSnapshot, scoped=False, name=c.name)
         snaps.append(found[0].data if found else {"cluster": c.name,
                                                   "status": c.status})
+        logsnap = platform.store.find(MonitorSnapshot, scoped=False,
+                                      name=f"{c.name}:errorlogs")
+        if logsnap:
+            for e in logsnap[0].data.get("error_logs", [])[:5]:
+                error_logs.append({"cluster": c.name, **e})
+        # latest slice-grain health records (degraded slices only)
+        slice_recs = platform.store.find(HealthRecord, scoped=False,
+                                         project=c.name, kind="slice")
+        latest: dict[str, HealthRecord] = {}
+        for r in sorted(slice_recs, key=lambda r: r.hour):
+            latest[r.target] = r
+        bad_slices += [{"cluster": c.name, "slice": r.target, **r.detail}
+                       for r in latest.values() if not r.healthy]
     restart_pods = sorted(
         (p for s in snaps for p in s.get("restart_pods", [])),
         key=lambda p: -p.get("restarts", 0))[:10]
@@ -408,8 +495,20 @@ def dashboard_data(platform, item: str = "") -> dict[str, Any]:
         "deployment_count": sum(s.get("deployment_count", 0) for s in snaps),
         "restart_pods": restart_pods,
         "error_pods": error_pods,
+        "error_logs": error_logs[:20],
+        "degraded_slices": bad_slices,
         "clusters": snaps,
     }
+
+
+def loki_tick(platform, transport: Transport | None = None) -> None:
+    """Hourly beat: scrape error logs from every running cluster's Loki
+    (reference ``tasks.py`` hourly loki task)."""
+    for cluster in _running_clusters(platform):
+        try:
+            ClusterMonitor(platform, cluster, transport).harvest_error_logs()
+        except Exception as e:  # noqa: BLE001 — per-cluster boundary
+            log.warning("loki tick failed for %s: %s", cluster.name, e)
 
 
 def schedule(platform, transport: Transport | None = None) -> None:
@@ -419,5 +518,7 @@ def schedule(platform, transport: Transport | None = None) -> None:
                          lambda: monitor_tick(platform, transport))
     platform.tasks.every(cfg.health_interval, "health",
                          lambda: health_tick(platform, transport))
+    platform.tasks.every(3600, "loki",
+                         lambda: loki_tick(platform, transport))
     platform.tasks.every(24 * 3600, "health-aggregate",
                          lambda: aggregate_health_history(platform))

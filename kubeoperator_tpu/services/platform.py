@@ -39,6 +39,11 @@ class PlatformError(RuntimeError):
     pass
 
 
+class WebkubectlSessionError(PlatformError):
+    """The session token itself is invalid/expired — the WS bridge tears
+    the connection down on this, but not on per-command errors."""
+
+
 class Platform:
     def __init__(self, config: Config | None = None, store: Store | None = None,
                  executor: Executor | None = None, catalog: Catalog | None = None):
@@ -122,7 +127,16 @@ class Platform:
         merged: dict[str, Any] = {}
         pkg = self.store.get_by_name(Package, package, scoped=False) if package else None
         if pkg:
+            from kubeoperator_tpu.services import packages as packages_svc
+
             merged.update(pkg.meta.get("vars", {}))
+            # nodes pull binaries from the controller-served package repo
+            # (nexus-lite; reference package_manage.py:31-53)
+            if "repo_url" not in (configs or {}):
+                try:
+                    merged["repo_url"] = packages_svc.repo_url(self, pkg)
+                except ValueError as e:
+                    raise PlatformError(str(e)) from e
         merged.update(configs or {})
         item_obj = None
         if item:
@@ -330,6 +344,123 @@ class Platform:
             cluster.configs["_sa_token"] = token
             self.store.save(cluster)
         return token
+
+    # -- storage backends (reference storage/models.py:20-60) --------------
+    def deploy_storage_backend(self, name: str) -> "StorageBackend":
+        """Converge a managed storage backend. ``nfs``: install an NFS
+        server on the named host and export the share (the reference
+        deploys ``NfsStorage`` as a Project running nfs.yml); ``external-
+        ceph``: validate the credential bundle (nothing to install)."""
+        from kubeoperator_tpu.engine.executor import Conn
+        from kubeoperator_tpu.resources.entities import StorageBackend
+
+        backend = self.store.get_by_name(StorageBackend, name, scoped=False)
+        if backend is None:
+            raise PlatformError(f"storage backend {name!r} not found")
+        try:
+            if backend.type == "nfs":
+                host_name = backend.config.get("host", "")
+                host = self.store.get_by_name(Host, host_name, scoped=False)
+                if host is None:
+                    raise PlatformError(f"nfs host {host_name!r} not registered")
+                cred = (self.store.get(Credential, host.credential_id, scoped=False)
+                        if host.credential_id else None)
+                conn = Conn.from_host(host, cred)
+                path = backend.config.get("export_path", "/export")
+                run = lambda cmd, t=300: self._run_checked(conn, cmd, t)
+                run("test -e /usr/sbin/exportfs || "
+                    "(apt-get install -y nfs-kernel-server || yum install -y nfs-utils)",
+                    1200)
+                run(f"mkdir -p {path} && chmod 777 {path}")
+                line = f"{path} *(rw,sync,no_subtree_check,no_root_squash)"
+                run(f"grep -qF '{path} ' /etc/exports || echo '{line}' >> /etc/exports")
+                run("systemctl enable nfs-server || systemctl enable nfs 2>/dev/null; "
+                    "systemctl restart nfs-server || systemctl restart nfs")
+                run("exportfs -ra")
+                backend.config["server_ip"] = host.ip
+            elif backend.type == "external-ceph":
+                missing = [k for k in ("monitors", "user", "key")
+                           if not backend.config.get(k)]
+                if missing:
+                    raise PlatformError(f"external-ceph config missing {missing}")
+            else:
+                raise PlatformError(f"unknown storage backend type {backend.type!r}")
+            backend.status = "READY"
+        except Exception:
+            backend.status = "ERROR"
+            self.store.save(backend)
+            raise
+        self.store.save(backend)
+        return backend
+
+    def _run_checked(self, conn, cmd: str, timeout: int = 300):
+        result = self.executor.run(conn, cmd, timeout=timeout)
+        if not result.ok:
+            raise PlatformError(f"{cmd!r} failed: {result.stderr[:200]}")
+        return result
+
+    # -- webkubectl sessions ----------------------------------------------
+    # Reference: a webkubectl sidecar issues session tokens
+    # (cluster.py:395-402, docker-compose webkubectl service). Here the
+    # controller itself is the kubectl bridge: a token maps to a cluster
+    # session and /ws/webkubectl/{token} executes kubectl on the first
+    # master over the normal executor.
+    WEBKUBECTL_TTL = 3600.0
+
+    def webkubectl_session(self, name: str) -> str:
+        cluster = self.store.get_by_name(Cluster, name, scoped=False)
+        if cluster is None:
+            raise PlatformError(f"cluster {name!r} not found")
+        import secrets as _secrets
+        import time as _time
+
+        token = _secrets.token_urlsafe(24)
+        if not hasattr(self, "_webkubectl_sessions"):
+            self._webkubectl_sessions = {}
+        # drop expired sessions while we're here
+        now = _time.monotonic()
+        self._webkubectl_sessions = {
+            t: s for t, s in self._webkubectl_sessions.items() if s[1] > now}
+        self._webkubectl_sessions[token] = (name, now + self.WEBKUBECTL_TTL)
+        return token
+
+    def webkubectl_exec(self, token: str, command: str) -> str:
+        """Run one kubectl command line for a session token. The line is the
+        *arguments* to kubectl (e.g. ``get pods -A``); shell metacharacters
+        are rejected — the session is a kubectl bridge, not a shell."""
+        import shlex
+        import time as _time
+
+        sessions = getattr(self, "_webkubectl_sessions", {})
+        session = sessions.get(token)
+        if session is None or session[1] <= _time.monotonic():
+            sessions.pop(token, None)
+            raise WebkubectlSessionError("invalid or expired webkubectl token")
+        name = session[0]
+        try:
+            args = shlex.split(command)
+        except ValueError as e:
+            raise PlatformError(f"unparseable command: {e}") from e
+        if not args:
+            raise PlatformError("empty command")
+        if args[0] == "kubectl":
+            args = args[1:]
+        banned = {";", "|", "&", ">", "<", "`", "$("}
+        if any(b in tok for tok in args for b in banned):
+            raise PlatformError("shell metacharacters are not allowed")
+        from kubeoperator_tpu.engine.executor import Conn
+        from kubeoperator_tpu.resources.entities import Node
+
+        nodes = self.store.find(Node, scoped=False, project=name)
+        master = next((n for n in nodes if "master" in n.roles), None)
+        if master is None:
+            raise PlatformError(f"cluster {name!r} has no master node")
+        host = self.store.get(Host, master.host_id, scoped=False)
+        cred = (self.store.get(Credential, host.credential_id, scoped=False)
+                if host.credential_id else None)
+        cmd = "kubectl " + " ".join(shlex.quote(a) for a in args)
+        result = self.executor.run(Conn.from_host(host, cred), cmd, timeout=60)
+        return result.stdout if result.ok else (result.stdout + result.stderr)
 
     def create_user(self, name: str, password: str, email: str = "",
                     is_admin: bool = False) -> User:

@@ -50,6 +50,7 @@ def platform(tmp_path, fake_executor):
         "terraform_bin": "",      # fake-apply
         "task_workers": 2,
         "node_forks": 8,
+        "repo_host": "127.0.0.1",   # package repo URL needs a routable host
     })
     p = Platform(config=cfg, store=Store(), executor=fake_executor)
     yield p

@@ -1,0 +1,75 @@
+"""System-log search plane: task-log scan + event search + the
+/api/v1/logs and /api/v1/events routes (replacing the reference's
+ES-backed log/es.py:9-52)."""
+
+import asyncio
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import ExecutionState
+from kubeoperator_tpu.services import logsearch
+from tests.test_api import login, run_api
+
+
+@pytest.fixture
+def with_task_logs(platform, fake_executor, manual_cluster):
+    """An install run leaves a real per-task log file behind."""
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    return ex
+
+
+def test_search_logs_matches_and_orders(platform, with_task_logs):
+    records = logsearch.search_logs(platform, query="install")
+    assert records, "install run should have produced task log lines"
+    assert all("install" in (r["message"] + r["logger"]).lower() for r in records)
+    # newest first
+    assert records == sorted(records, key=lambda r: r["ts"], reverse=True)
+    # level filter: the happy-path install logs INFO only
+    assert logsearch.search_logs(platform, level="ERROR") == []
+    with pytest.raises(ValueError):
+        logsearch.search_logs(platform, level="LOUD")
+
+
+def test_search_logs_by_task(platform, with_task_logs):
+    ex = with_task_logs
+    records = logsearch.search_logs(platform, task_id=ex.id)
+    assert records and all(r["task"] == ex.id for r in records)
+    assert logsearch.search_logs(platform, task_id="nope") == []
+
+
+def test_search_events(platform, with_task_logs):
+    from tests.test_monitor import FakeTransport
+    from kubeoperator_tpu.services import monitor as mon
+
+    mon.monitor_tick(platform, transport=FakeTransport())
+    events = logsearch.search_events(platform, query="restarting")
+    assert events and events[0]["cluster"] == "demo"
+    assert events[0]["reason"] == "BackOff"
+    assert logsearch.search_events(platform, event_type="Normal") == []
+    assert logsearch.search_events(platform, cluster="other") == []
+
+
+def test_logs_api_routes(platform, with_task_logs):
+    from kubeoperator_tpu.api.app import ensure_admin
+
+    ensure_admin(platform)
+
+    async def scenario(client):
+        hdrs = await login(client)
+        r = await client.get("/api/v1/logs?query=install", headers=hdrs)
+        assert r.status == 200
+        logs = (await r.json())["logs"]
+        assert logs and "install" in logs[0]["message"].lower()
+        r = await client.get("/api/v1/logs?level=LOUD", headers=hdrs)
+        assert r.status == 400
+        r = await client.get("/api/v1/events?query=", headers=hdrs)
+        assert r.status == 200
+        # non-admin cannot search system logs
+        await client.post("/api/v1/users", headers=hdrs,
+                          json={"name": "bob", "password": "pw12345"})
+        bob = await login(client, "bob", "pw12345")
+        r = await client.get("/api/v1/logs", headers=bob)
+        assert r.status == 403
+
+    run_api(platform, scenario)

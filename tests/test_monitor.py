@@ -48,6 +48,14 @@ class FakeTransport:
                 {"reason": "BackOff", "message": "restarting", "type": "Warning",
                  "metadata": {"namespace": "default"},
                  "involvedObject": {"name": "crashy"}}]})
+        if "/loki/api/v1/query" in url:
+            return 200, json.dumps({"data": {"result": [
+                {"stream": {"namespace": "default", "pod": "crashy"},
+                 "values": [["1700000002000000000", "ERROR: back-off restarting"],
+                            ["1700000001000000000", "error: probe failed"]]},
+                {"stream": {"namespace": "kube-system", "pod": "dns"},
+                 "values": [["1700000003000000000", "Exception in resolver"]]},
+            ]}})
         if "/api/v1/query" in url:
             return 200, json.dumps({"data": {"result": [
                 {"value": [0, "4.5"]}]}})
@@ -96,7 +104,7 @@ def test_health_ticks(platform, installed, fake_executor):
     mon.health_tick(platform, transport=t)
     recs = platform.store.find(HealthRecord, scoped=False, project="demo")
     kinds = {r.kind for r in recs}
-    assert kinds == {"host", "node", "component"}
+    assert kinds == {"host", "node", "component", "slice"}
     node_recs = {r.target: r.healthy for r in recs if r.kind == "node"}
     assert node_recs["demo-master-1"] is True
     assert node_recs["demo-tpu-1"] is False          # NotReady + pressure
@@ -121,6 +129,48 @@ def test_history_aggregation(platform, installed):
     assert days[0].healthy is False
     assert days[0].detail == {"healthy_hours": 1, "total_hours": 2}
     assert not [r for r in recs if r.hour.startswith("2020-01-01T")]
+
+
+def test_slice_health_degrades_with_member(platform, installed, fake_executor):
+    """A TPU slice with one dead host is a dead slice (catalog slice
+    topology) — the slice-grain record must go unhealthy even though the
+    other members answer."""
+    t = FakeTransport()
+    mon.health_tick(platform, transport=t)
+    recs = platform.store.find(HealthRecord, scoped=False, project="demo",
+                               kind="slice")
+    assert recs and recs[0].target == "tpu-a"
+    assert recs[0].healthy is True
+
+    fake_executor.fail_on("10.0.0.3", "true")            # TPU host dies
+    mon.health_tick(platform, transport=t)
+    recs = platform.store.find(HealthRecord, scoped=False, project="demo",
+                               kind="slice")
+    assert recs[0].healthy is False
+    assert recs[0].detail["down"] == ["demo-tpu-1"]
+    # dashboard surfaces the degraded slice
+    data = mon.dashboard_data(platform)
+    assert data["degraded_slices"] == [
+        {"cluster": "demo", "slice": "tpu-a", "members": 1,
+         "down": ["demo-tpu-1"]}]
+
+
+def test_loki_error_log_harvest(platform, installed):
+    t = FakeTransport()
+    mon.loki_tick(platform, transport=t)
+    snaps = platform.store.find(mon.MonitorSnapshot, scoped=False,
+                                name="demo:errorlogs")
+    assert snaps
+    logs = snaps[0].data["error_logs"]
+    assert len(logs) == 3
+    assert logs[0]["line"] == "Exception in resolver"     # newest first
+    assert logs[0]["namespace"] == "kube-system"
+    # re-tick upserts, and the dashboard carries the lines
+    mon.loki_tick(platform, transport=t)
+    assert len(platform.store.find(mon.MonitorSnapshot, scoped=False,
+                                   name="demo:errorlogs")) == 1
+    data = mon.dashboard_data(platform)
+    assert data["error_logs"] and data["error_logs"][0]["cluster"] == "demo"
 
 
 def test_dashboard_item_scoped(platform, installed):

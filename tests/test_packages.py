@@ -1,0 +1,111 @@
+"""Offline-package subsystem: meta.yml scan, nexus-lite file repo, and
+vars/repo_url flow into cluster configs (reference package.py lookup +
+package_manage.py:31-53)."""
+
+import os
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import Cluster, ExecutionState, Package
+from kubeoperator_tpu.services import packages as pkgs
+from tests.conftest import CPU_FACTS
+from tests.test_api import login, run_api
+
+
+@pytest.fixture
+def package_fixture(platform):
+    """A package dir with meta.yml + a binary under files/."""
+    root = os.path.join(platform.config.packages, "k8s-v1.28-tpu")
+    os.makedirs(os.path.join(root, "files"), exist_ok=True)
+    with open(os.path.join(root, "meta.yml"), "w") as f:
+        f.write("name: k8s-v1.28-tpu\nversion: '1.28.2'\n"
+                "vars:\n  kube_version: v1.28.2\n  libtpu_version: '0.9'\n")
+    with open(os.path.join(root, "files", "kubeadm"), "wb") as f:
+        f.write(b"#!/bin/sh\necho kubeadm\n")
+    return root
+
+
+def test_scan_upserts_and_prunes(platform, package_fixture):
+    found = pkgs.scan_packages(platform)
+    assert [p.name for p in found] == ["k8s-v1.28-tpu"]
+    assert found[0].meta["vars"]["kube_version"] == "v1.28.2"
+    assert found[0].k8s_version == "v1.28.2"
+    # rescan upserts (no duplicate row)
+    pkgs.scan_packages(platform)
+    assert len(platform.store.find(Package, scoped=False)) == 1
+    # directory gone → row pruned; API-created rows survive
+    platform.store.save(Package(name="manual-entry"))
+    os.remove(os.path.join(package_fixture, "meta.yml"))
+    pkgs.scan_packages(platform)
+    names = {p.name for p in platform.store.find(Package, scoped=False)}
+    assert names == {"manual-entry"}
+
+
+def test_bad_meta_skipped(platform, package_fixture):
+    bad = os.path.join(platform.config.packages, "broken")
+    os.makedirs(bad, exist_ok=True)
+    with open(os.path.join(bad, "meta.yml"), "w") as f:
+        f.write("- just\n- a list\n")
+    found = pkgs.scan_packages(platform)
+    assert [p.name for p in found] == ["k8s-v1.28-tpu"]
+
+
+def test_package_vars_and_repo_url_flow_into_cluster(platform, package_fixture):
+    pkgs.scan_packages(platform)
+    cluster = platform.create_cluster("pkgd", package="k8s-v1.28-tpu")
+    assert cluster.configs["kube_version"] == "v1.28.2"
+    assert cluster.configs["libtpu_version"] == "0.9"
+    assert cluster.configs["repo_url"].endswith("/repo/k8s-v1.28-tpu")
+    # explicit configs still win over package vars
+    c2 = platform.create_cluster("pkgd2", package="k8s-v1.28-tpu",
+                                 configs={"kube_version": "v1.29.0"})
+    assert c2.configs["kube_version"] == "v1.29.0"
+
+
+def test_install_pulls_from_package_repo(platform, fake_executor, package_fixture):
+    """End-to-end on fakes: the engine steps' download commands must point
+    at the controller-served package repo."""
+    pkgs.scan_packages(platform)
+    cred = platform.create_credential("k", private_key="FAKE")
+    fake_executor.host("10.1.0.1").facts.update(CPU_FACTS)
+    fake_executor.host("10.1.0.2").facts.update(CPU_FACTS)
+    m = platform.register_host("p-m", "10.1.0.1", cred.id)
+    w = platform.register_host("p-w", "10.1.0.2", cred.id)
+    cluster = platform.create_cluster("pkg-demo", package="k8s-v1.28-tpu",
+                                      configs={"registry": "reg.local:8082"})
+    platform.add_node(cluster, m, ["master"])
+    platform.add_node(cluster, w, ["worker"])
+    ex = platform.run_operation("pkg-demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    repo = cluster.configs["repo_url"]
+    cmds = [c for h in ("10.1.0.1", "10.1.0.2")
+            for c in fake_executor.host(h).history]
+    assert any(repo in c for c in cmds), \
+        f"no step pulled from the package repo {repo}"
+
+
+def test_repo_route_serves_files(platform, package_fixture):
+    from kubeoperator_tpu.api.app import ensure_admin
+
+    ensure_admin(platform)
+    pkgs.scan_packages(platform)
+
+    async def scenario(client):
+        # unauthenticated, like the reference's in-cluster nexus
+        r = await client.get("/repo/k8s-v1.28-tpu/files/kubeadm")
+        assert r.status == 200
+        assert b"kubeadm" in await r.read()
+        r = await client.get("/repo/k8s-v1.28-tpu/files/missing")
+        assert r.status == 404
+        r = await client.get("/repo/nope/files/kubeadm")
+        assert r.status == 404
+        # traversal is blocked
+        r = await client.get("/repo/k8s-v1.28-tpu/..%2F..%2Fkubeoperator.sqlite3")
+        assert r.status in (403, 404)
+        # admin rescan endpoint
+        hdrs = await login(client)
+        r = await client.post("/api/v1/packages/scan", headers=hdrs)
+        assert r.status == 200
+        assert (await r.json())["packages"][0]["name"] == "k8s-v1.28-tpu"
+
+    run_api(platform, scenario)

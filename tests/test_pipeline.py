@@ -1,0 +1,87 @@
+"""Scan-over-stages pipeline (workloads/pipeline.py) on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeoperator_tpu.workloads import pipeline as pl
+from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh, shard_params_fsdp
+
+
+def mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def make_stage(key, d):
+    k1, k2 = jax.random.split(key)
+    return {"w1": jax.random.normal(k1, (d, d)) * 0.1, "b1": jnp.zeros((d,)),
+            "w2": jax.random.normal(k2, (d, d)) * 0.1, "b2": jnp.zeros((d,))}
+
+
+def test_scan_matches_sequential():
+    d, n = 16, 4
+    stages = [make_stage(jax.random.key(i), d) for i in range(n)]
+    x = jax.random.normal(jax.random.key(99), (8, d))
+    want = x
+    for s in stages:
+        want = mlp_stage(s, want)
+    stacked = pl.stack_stages(stages)
+    got = pl.scan_stages(mlp_stage, stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # remat off gives the same numbers
+    got2 = pl.scan_stages(mlp_stage, stacked, x, remat=False)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want), rtol=1e-5)
+
+
+def test_stack_unstack_roundtrip():
+    stages = [make_stage(jax.random.key(i), 8) for i in range(3)]
+    back = pl.unstack_stages(pl.stack_stages(stages))
+    for a, b in zip(stages, back):
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_gradients_flow_with_remat():
+    d, n = 8, 3
+    stacked = pl.stack_stages([make_stage(jax.random.key(i), d) for i in range(n)])
+    x = jax.random.normal(jax.random.key(7), (4, d))
+
+    def loss(stacked):
+        return (pl.scan_stages(mlp_stage, stacked, x) ** 2).mean()
+
+    g = jax.grad(loss)(stacked)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in jax.tree.leaves(g))
+    assert float(jnp.abs(g["w1"]).sum()) > 0
+
+
+def test_pipeline_under_fsdp_mesh():
+    """Stacked stage params shard over fsdp and the scanned forward jits
+    on the 8-device mesh — pipeline composes with ZeRO-3."""
+    spec = MeshSpec(fsdp=8)
+    mesh = build_mesh(spec)
+    d, n = 32, 4
+    stacked = pl.stack_stages([make_stage(jax.random.key(i), d) for i in range(n)])
+    shardings = shard_params_fsdp(stacked, mesh, spec, min_size=64)
+    stacked = jax.device_put(stacked, shardings)
+    assert any("fsdp" in str(s.spec) for s in jax.tree.leaves(shardings))
+    x = jax.device_put(jax.random.normal(jax.random.key(0), (16, d)),
+                       NamedSharding(mesh, P("fsdp")))
+    out = jax.jit(lambda p, x: pl.scan_stages(mlp_stage, p, x))(stacked, x)
+    assert out.shape == (16, d)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_three_phase_forward():
+    d, vocab, n = 8, 32, 2
+    params = {
+        "embed": jax.random.normal(jax.random.key(0), (vocab, d)) * 0.1,
+        "stages": pl.stack_stages([make_stage(jax.random.key(i + 1), d)
+                                   for i in range(n)]),
+        "head": jax.random.normal(jax.random.key(9), (d, vocab)) * 0.1,
+    }
+    tokens = jnp.array([[1, 2, 3], [4, 5, 6]])
+    logits = pl.pipeline_forward(
+        lambda e, t: e[t], mlp_stage, lambda h, a: a @ h, params, tokens)
+    assert logits.shape == (2, 3, vocab)

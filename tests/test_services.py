@@ -13,7 +13,10 @@ from kubeoperator_tpu.services.messages import MessageCenter
 
 
 def put_setting(platform, name, value):
-    platform.store.save(Setting(name=name, value=value))
+    existing = platform.store.get_by_name(Setting, name, scoped=False)
+    s = existing or Setting(name=name)
+    s.value = value
+    platform.store.save(s)
 
 
 # -- message center ---------------------------------------------------------
@@ -170,3 +173,86 @@ def test_ber_roundtrip():
     bad = b"\x30\x0c\x02\x01\x01\x61\x07\x0a\x01\x31\x04\x00\x04\x00"
     assert ldap_auth.parse_bind_result(ok) == 0
     assert ldap_auth.parse_bind_result(bad) == 49
+
+
+# -- LDAP periodic sync ------------------------------------------------------
+
+class FakeLdapDirectory(threading.Thread):
+    """Accepts one connection: answers a simple bind, then a search with
+    one SearchResultEntry per (uid, mail) pair and a SearchResultDone."""
+
+    def __init__(self, entries):
+        super().__init__(daemon=True)
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.entries = entries
+
+    def run(self):
+        from kubeoperator_tpu.services.ldap_auth import _tlv, _int
+        conn, _ = self.sock.accept()
+        conn.recv(4096)                                      # bind request
+        ok = _tlv(0x61, b"\x0a\x01\x00\x04\x00\x04\x00")
+        conn.sendall(_tlv(0x30, _int(1) + ok))
+        conn.recv(4096)                                      # search request
+        out = b""
+        for uid, mail in self.entries:
+            attrs = _tlv(0x30, _tlv(0x04, b"uid") + _tlv(0x31, _tlv(0x04, uid.encode())))
+            attrs += _tlv(0x30, _tlv(0x04, b"mail") + _tlv(0x31, _tlv(0x04, mail.encode())))
+            entry = (_tlv(0x04, f"uid={uid},ou=people,dc=corp".encode())
+                     + _tlv(0x30, attrs))
+            out += _tlv(0x30, _int(2) + _tlv(0x64, entry))
+        done = _tlv(0x65, b"\x0a\x01\x00\x04\x00\x04\x00")
+        out += _tlv(0x30, _int(2) + done)
+        conn.sendall(out)
+        conn.close()
+
+
+def _sync_platform(platform, port):
+    put_setting(platform, "ldap_enabled", "true")
+    put_setting(platform, "ldap_sync_enabled", "true")
+    put_setting(platform, "ldap_host", "127.0.0.1")
+    put_setting(platform, "ldap_port", str(port))
+    put_setting(platform, "ldap_base_dn", "ou=people,dc=corp")
+    put_setting(platform, "ldap_bind_dn", "cn=sync,dc=corp")
+    put_setting(platform, "ldap_bind_password", "syncpw")
+
+
+def test_ldap_sync_creates_and_disables(platform):
+    platform.create_user("admin", "pw", is_admin=True)          # local: untouched
+    server = FakeLdapDirectory([("carol", "carol@corp.io"), ("dave", "dave@corp.io")])
+    server.start()
+    _sync_platform(platform, server.port)
+    report = ldap_auth.sync_users(platform)
+    assert sorted(report["created"]) == ["carol", "dave"]
+    carol = platform.store.get_by_name(User, "carol", scoped=False)
+    assert carol.source == "ldap" and carol.email == "carol@corp.io"
+
+    # next sync: carol vanished from the directory -> disabled, not deleted
+    server2 = FakeLdapDirectory([("dave", "dave@corp.io")])
+    server2.start()
+    put_setting(platform, "ldap_port", str(server2.port))
+    report = ldap_auth.sync_users(platform)
+    assert report["disabled"] == ["carol"]
+    carol = platform.store.get_by_name(User, "carol", scoped=False)
+    assert carol.disabled is True
+    admin = platform.store.get_by_name(User, "admin", scoped=False)
+    assert admin.disabled is False                              # local untouched
+
+    # directory brings carol back -> re-enabled
+    server3 = FakeLdapDirectory([("carol", "carol@corp.io"), ("dave", "dave@corp.io")])
+    server3.start()
+    put_setting(platform, "ldap_port", str(server3.port))
+    report = ldap_auth.sync_users(platform)
+    assert report["reenabled"] == ["carol"]
+
+
+def test_ldap_sync_disabled_by_default(platform):
+    assert ldap_auth.sync_users(platform) == {"enabled": False}
+
+
+def test_disabled_ldap_user_cannot_authenticate(platform):
+    platform.store.save(User(name="gone", source="ldap", disabled=True))
+    server = FakeLdapServer()
+    server.start()
+    auth = _ldap_platform(platform, server.port)
+    assert auth.authenticate("gone", "letmein") is None

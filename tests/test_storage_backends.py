@@ -1,0 +1,92 @@
+"""Managed storage backends: NFS server provisioning on a host + external
+Ceph config flowing into the cluster storage step (reference
+storage/models.py:20-60 NfsStorage/CephStorage)."""
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    ExecutionState, StorageBackend,
+)
+from kubeoperator_tpu.services.platform import PlatformError
+from tests.conftest import CPU_FACTS
+
+
+@pytest.fixture
+def nfs_host(platform, fake_executor):
+    cred = platform.create_credential("k", private_key="FAKE")
+    fake_executor.host("10.2.0.9").facts.update(CPU_FACTS)
+    return platform.register_host("nfs-1", "10.2.0.9", cred.id)
+
+
+def test_nfs_backend_deploy_converges_server(platform, fake_executor, nfs_host):
+    platform.store.save(StorageBackend(name="shared-nfs", type="nfs",
+                                       config={"host": "nfs-1",
+                                               "export_path": "/data/share"}))
+    backend = platform.deploy_storage_backend("shared-nfs")
+    assert backend.status == "READY"
+    assert backend.config["server_ip"] == "10.2.0.9"
+    history = fake_executor.host("10.2.0.9").history
+    assert any("exportfs -ra" in c for c in history)
+    assert any("/etc/exports" in c for c in history)
+    assert any("mkdir -p /data/share" in c for c in history)
+
+
+def test_nfs_backend_bad_host_errors(platform):
+    platform.store.save(StorageBackend(name="bad", type="nfs",
+                                       config={"host": "ghost"}))
+    with pytest.raises(PlatformError):
+        platform.deploy_storage_backend("bad")
+    assert platform.store.get_by_name(StorageBackend, "bad",
+                                      scoped=False).status == "ERROR"
+
+
+def test_external_ceph_validation(platform):
+    platform.store.save(StorageBackend(
+        name="ceph", type="external-ceph",
+        config={"monitors": "10.3.0.1:6789", "user": "admin", "key": "AQx="}))
+    assert platform.deploy_storage_backend("ceph").status == "READY"
+    platform.store.save(StorageBackend(name="ceph-bad", type="external-ceph",
+                                       config={"monitors": "10.3.0.1:6789"}))
+    with pytest.raises(PlatformError):
+        platform.deploy_storage_backend("ceph-bad")
+
+
+def test_cluster_install_uses_nfs_backend(platform, fake_executor, nfs_host):
+    """Install with storage_config.backend → StorageClass points at the
+    deployed NFS server's IP."""
+    platform.store.save(StorageBackend(name="shared-nfs", type="nfs",
+                                       config={"host": "nfs-1",
+                                               "export_path": "/data/share"}))
+    platform.deploy_storage_backend("shared-nfs")
+
+    cred = platform.create_credential("k2", private_key="FAKE")
+    fake_executor.host("10.2.0.1").facts.update(CPU_FACTS)
+    fake_executor.host("10.2.0.2").facts.update(CPU_FACTS)
+    m = platform.register_host("s-m", "10.2.0.1", cred.id)
+    w = platform.register_host("s-w", "10.2.0.2", cred.id)
+    cluster = platform.create_cluster("nfsdemo", storage_provider="nfs",
+                                      storage_config={"backend": "shared-nfs"},
+                                      configs={"registry": "reg.local:8082"})
+    platform.add_node(cluster, m, ["master"])
+    platform.add_node(cluster, w, ["worker"])
+    ex = platform.run_operation("nfsdemo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    sc = fake_executor.host("10.2.0.1").files.get(
+        "/etc/kubernetes/addons/storage-nfs.yaml", b"").decode()
+    assert 'server: "10.2.0.9"' in sc
+    assert 'share: "/data/share"' in sc
+
+
+def test_undeployed_backend_fails_install(platform, fake_executor):
+    platform.store.save(StorageBackend(name="pending-nfs", type="nfs",
+                                       config={"host": "nfs-1"}))
+    cred = platform.create_credential("k3", private_key="FAKE")
+    fake_executor.host("10.2.1.1").facts.update(CPU_FACTS)
+    m = platform.register_host("u-m", "10.2.1.1", cred.id)
+    cluster = platform.create_cluster("undep", storage_provider="nfs",
+                                      storage_config={"backend": "pending-nfs"},
+                                      configs={"registry": "reg.local:8082"})
+    platform.add_node(cluster, m, ["master"])
+    ex = platform.run_operation("undep", "install")
+    assert ex.state == ExecutionState.FAILURE
+    assert "PENDING" in str(ex.result)
