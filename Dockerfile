@@ -1,0 +1,30 @@
+# Control-plane image (reference: core/Dockerfile + docker-compose.yml run
+# a Django+Celery+MySQL+Redis+ES stack; this stack is one Python process
+# over sqlite, so one small image replaces five services).
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+COPY native /src/native
+RUN g++ -O2 -shared -fPIC -pthread -o /src/native/libkoagent.so /src/native/koagent.cpp
+
+FROM python:3.12-slim
+WORKDIR /opt/kubeoperator-tpu
+COPY kubeoperator_tpu ./kubeoperator_tpu
+COPY pyproject.toml README.md ./
+COPY --from=build /src/native/libkoagent.so ./native/libkoagent.so
+
+# control-plane deps only — the JAX/TPU workload layer runs in the
+# ko-workloads image on cluster nodes, not in the controller. The ssh
+# client is the executor's transport to every managed host.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        openssh-client curl \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir aiohttp pyyaml
+
+ENV KO_DATA_DIR=/data \
+    KO_BIND_HOST=0.0.0.0 \
+    KO_BIND_PORT=8000
+VOLUME /data
+EXPOSE 8000
+
+CMD ["python", "-m", "kubeoperator_tpu", "serve"]

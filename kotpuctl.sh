@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Installer/operator wrapper (reference kubeopsctl.sh: install|uninstall|
+# start|stop|restart|status|upgrade around docker-compose).
+set -euo pipefail
+
+BASE_DIR="${KO_BASE:-/opt/kubeoperator-tpu}"
+COMPOSE="docker compose -f ${BASE_DIR}/docker-compose.yml"
+
+usage() {
+  echo "Usage: kotpuctl {install|uninstall|start|stop|restart|status|upgrade|logs}"
+  exit 1
+}
+
+need_env() {
+  if [ ! -f "${BASE_DIR}/.env" ]; then
+    echo ">> creating ${BASE_DIR}/.env"
+    {
+      echo "KO_SECRET_KEY=$(head -c 32 /dev/urandom | base64 | tr -d '=+/')"
+      echo "KO_REPO_HOST=$(hostname -I 2>/dev/null | awk '{print $1}')"
+    } > "${BASE_DIR}/.env"
+  fi
+}
+
+preflight() {
+  # reference scripts/8_check_install_env.sh: root, arch, cores, memory
+  [ "$(id -u)" = 0 ] || { echo "!! run as root"; exit 1; }
+  command -v docker >/dev/null || { echo "!! docker is required"; exit 1; }
+  cores=$(nproc)
+  [ "$cores" -ge 2 ] || echo "?? fewer than 2 cores (${cores}); continuing"
+  mem_kb=$(awk '/MemTotal/{print $2}' /proc/meminfo)
+  [ "$mem_kb" -ge 4000000 ] || echo "?? less than 4 GB RAM; continuing"
+}
+
+case "${1:-}" in
+  install)
+    preflight
+    mkdir -p "${BASE_DIR}" "${BASE_DIR}/data/packages"
+    if [ "$(pwd)" != "${BASE_DIR}" ]; then
+      cp -r kubeoperator_tpu native pyproject.toml README.md \
+            Dockerfile docker-compose.yml "${BASE_DIR}/"
+    fi
+    need_env
+    (cd "${BASE_DIR}" && ${COMPOSE} up -d --build)
+    echo ">> portal: http://$(hostname -I 2>/dev/null | awk '{print $1}'):8000/ui/"
+    echo ">> default login admin / KubeOperator@tpu1 — change it immediately"
+    ;;
+  uninstall)
+    (cd "${BASE_DIR}" && ${COMPOSE} down -v) || true
+    echo ">> removed services; ${BASE_DIR} left on disk (delete manually)"
+    ;;
+  start)    (cd "${BASE_DIR}" && ${COMPOSE} up -d) ;;
+  stop)     (cd "${BASE_DIR}" && ${COMPOSE} stop) ;;
+  restart)  (cd "${BASE_DIR}" && ${COMPOSE} restart) ;;
+  status)   (cd "${BASE_DIR}" && ${COMPOSE} ps) ;;
+  upgrade)  (cd "${BASE_DIR}" && ${COMPOSE} up -d --build) ;;
+  logs)     (cd "${BASE_DIR}" && ${COMPOSE} logs -f --tail 200) ;;
+  *) usage ;;
+esac

@@ -15,7 +15,6 @@ import csv
 import io
 import json
 import os
-import secrets
 from dataclasses import asdict
 from functools import partial
 from typing import Any, Callable
@@ -58,6 +57,20 @@ def dump(entity: Any) -> dict:
 
 def json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+SECRET_SETTING_RE = ("password", "secret", "_key", "token")
+
+
+def setting_dump(entity: Any) -> dict:
+    """Settings carry credentials (ldap_bind_password, smtp_password…) that
+    must never be served back, to admins included — the UI writes them
+    blind and skips '***' on save."""
+    d = dump(entity)
+    name = d.get("name", "")
+    if d.get("value") and any(s in name for s in SECRET_SETTING_RE):
+        d["value"] = "***"
+    return d
 
 
 async def _sync(request_or_app, fn: Callable, *args, **kwargs):
@@ -202,10 +215,13 @@ async def healthz(request: web.Request) -> web.Response:
 
 def register_crud(app: web.Application, path: str, cls: type,
                   create: Callable[[Platform, dict], Any] | None = None,
-                  admin_write: bool = True) -> None:
+                  admin_write: bool = True,
+                  serialize: Callable[[Any], dict] | None = None) -> None:
+    ser = serialize or dump
+
     async def list_(request: web.Request) -> web.Response:
         items = await _sync(request, request.app["platform"].store.find, cls, scoped=False)
-        return web.json_response([dump(i) for i in items])
+        return web.json_response([ser(i) for i in items])
 
     async def get_(request: web.Request) -> web.Response:
         name = request.match_info["name"]
@@ -213,7 +229,7 @@ def register_crud(app: web.Application, path: str, cls: type,
                            cls, name, scoped=False)
         if item is None:
             return json_error(404, f"{cls.KIND} {name!r} not found")
-        return web.json_response(dump(item))
+        return web.json_response(ser(item))
 
     async def post_(request: web.Request) -> web.Response:
         if admin_write:
@@ -536,7 +552,9 @@ async def upsert_setting(request: web.Request) -> web.Response:
         s = platform.store.get_by_name(Setting, body["name"], scoped=False)
         if s is None:
             s = Setting(name=body["name"])
-        s.value = body.get("value", "")
+        value = body.get("value", "")
+        if value != "***":        # masked read-back must not clobber secrets
+            s.value = value
         s.tab = body.get("tab", s.tab)
         platform.store.save(s)
         return s
@@ -747,7 +765,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_post("/api/v1/storage-backends/{name}/deploy", deploy_storage_backend)
     register_crud(app, "/api/v1/backup-storages", BackupStorage)
     register_crud(app, "/api/v1/backup-strategies", BackupStrategy)
-    register_crud(app, "/api/v1/settings", Setting)
+    register_crud(app, "/api/v1/settings", Setting, serialize=setting_dump)
     r.add_put("/api/v1/settings", upsert_setting)
     r.add_get("/api/v1/messages", list_messages)
     r.add_post("/api/v1/messages/{id}/read", mark_message_read)
@@ -770,6 +788,7 @@ def create_app(platform: Platform) -> web.Application:
 
     r.add_get("/", root_redirect)
     r.add_get("/ui/", ui_index)
+    r.add_static("/ui", os.path.abspath(ui_dir))   # app.js + any assets
     return app
 
 

@@ -67,8 +67,9 @@ def search_logs(platform, query: str = "", level: str = "", task_id: str = "",
                     and needle not in rec["logger"].lower():
                 continue
             out.append(rec)
-        if len(out) >= limit * 4:       # enough to sort+cut without full scan
-            break
+    # all files are scanned before sorting: file mtime says nothing about
+    # how old individual lines are, so an early cut-off could drop the
+    # newest matches while returning stale ones
     out.sort(key=lambda r: r["ts"], reverse=True)
     return out[:limit]
 

@@ -1,0 +1,659 @@
+"use strict";
+/* Portal logic. Hash routes:
+   #/dashboard #/clusters #/cluster/<name>/<tab> #/hosts #/packages
+   #/storage #/items #/users #/settings #/logs #/messages
+   Reference parity map: ui/src/app feature modules (cluster wizard, deploy
+   progress + xterm log, overview + webkubectl, cluster-health/-event/
+   -backup, storage, item/member, user/setting, message-center, system-log,
+   dashboard). */
+
+const $ = (s, el = document) => el.querySelector(s);
+const state = { token: sessionStorage.getItem("token") || "", user: null,
+                ws: null, term: null };
+const PAGES = ["dashboard", "clusters", "hosts", "packages", "storage",
+               "items", "users", "settings", "logs", "messages"];
+
+async function api(path, opts = {}) {
+  const r = await fetch("/api/v1" + path, {...opts, headers: {
+    "Authorization": "Bearer " + state.token,
+    "Content-Type": "application/json", ...(opts.headers || {})}});
+  if (r.status === 401) { logout(); throw new Error("unauthorized"); }
+  const body = await r.json().catch(() => ({}));
+  if (!r.ok) throw new Error(body.error || r.status);
+  return body;
+}
+const esc = s => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;",
+         "'": "&#39;"}[c]));
+function logout() { sessionStorage.removeItem("token"); state.token = ""; render(); }
+function tag(s) { return `<span class="tag ${esc(s)}">${esc(s)}</span>`; }
+function nav(page) { location.hash = "#/" + page; }
+const when = s => esc((s || "").slice(0, 19).replace("T", " "));
+function closeWs() {
+  (state.ws || []).forEach(w => w.close()); state.ws = null;
+  if (state.term) { state.term.close(); state.term = null; }
+}
+function wsUrl(path) {
+  const proto = location.protocol === "https:" ? "wss" : "ws";
+  return `${proto}://${location.host}${path}`;
+}
+
+function render() {
+  closeWs();
+  if (!state.token) { $("#top").style.display = "none"; return renderLogin(); }
+  $("#top").style.display = "flex";
+  const h = location.hash.replace("#/", "") || "dashboard";
+  const [page, ...rest] = h.split("/");
+  $("#nav").innerHTML = PAGES.map(p =>
+    `<a class="${page === p || (page === "cluster" && p === "clusters") ? "on" : ""}"
+        onclick="nav('${p}')">${p}</a>`).join("") +
+    `<a onclick="logout()">logout</a>`;
+  const table = {dashboard: renderDashboard, clusters: renderClusters,
+                 cluster: renderCluster, hosts: renderHosts,
+                 packages: renderPackages, storage: renderStorage,
+                 items: renderItems, users: renderUsers,
+                 settings: renderSettings, logs: renderLogs,
+                 messages: renderMessages};
+  (table[page] || renderDashboard)(...rest).catch(e =>
+    $("#view").innerHTML = `<div class="card" style="color:var(--err)">${esc(e.message)}</div>`);
+}
+
+function renderLogin() {
+  $("#view").innerHTML = `<div class="card" id="login">
+    <h2 style="margin-bottom:12px">Sign in</h2>
+    <input id="u" placeholder="username" value="admin">
+    <input id="p" placeholder="password" type="password">
+    <button onclick="doLogin()">Login</button>
+    <div id="lerr" style="color:var(--err)"></div></div>`;
+  $("#p").addEventListener("keydown", e => e.key === "Enter" && doLogin());
+}
+async function doLogin() {
+  try {
+    const r = await fetch("/api/v1/auth/login", {method: "POST",
+      body: JSON.stringify({username: $("#u").value, password: $("#p").value})});
+    if (!r.ok) throw new Error((await r.json()).error);
+    const body = await r.json();
+    state.token = body.token; state.user = body.user;
+    sessionStorage.setItem("token", body.token);
+    $("#who").textContent = body.user.name;
+    nav("dashboard"); render();
+  } catch (e) { $("#lerr").textContent = e.message; }
+}
+
+/* ---------------- dashboard ---------------- */
+
+async function renderDashboard() {
+  const d = await api("/dashboard/all");
+  $("#view").innerHTML = `<div class="card"><div class="grid">
+    ${[["clusters", d.cluster_count], ["running", d.running], ["error", d.error],
+       ["nodes", d.node_count], ["pods", d.pod_count],
+       ["deployments", d.deployment_count]]
+      .map(([k, v]) => `<div class="stat"><b>${v}</b><span>${k}</span></div>`).join("")}
+    </div></div>
+    ${(d.degraded_slices || []).length ? `<div class="card">
+      <h3 style="color:var(--err)">Degraded TPU slices</h3>
+      <table><tr><th>cluster</th><th>slice</th><th>members</th><th>down</th></tr>
+      ${d.degraded_slices.map(s => `<tr><td>${esc(s.cluster)}</td><td>${esc(s.slice)}</td>
+        <td>${s.members}</td><td style="color:var(--err)">${esc((s.down || []).join(", "))}</td></tr>`).join("")}
+      </table></div>` : ""}
+    <div class="row">
+    <div class="card"><h3>Problem pods</h3><table><tr><th>pod</th><th>ns</th><th>why</th></tr>
+      ${(d.restart_pods || []).map(p => `<tr><td>${esc(p.name)}</td><td>${esc(p.namespace)}</td><td>${p.restarts} restarts</td></tr>`).join("")}
+      ${(d.error_pods || []).map(p => `<tr><td>${esc(p.name)}</td><td>${esc(p.namespace)}</td><td>${esc(p.phase)}</td></tr>`).join("")}
+    </table></div>
+    <div class="card"><h3>Clusters</h3><table><tr><th>name</th><th>status</th><th>nodes</th><th>TPU util</th></tr>
+      ${(d.clusters || []).map(c => `<tr><td><a data-go="cluster/${esc(c.cluster)}">${esc(c.cluster)}</a></td>
+        <td>${tag(c.status)}</td><td>${c.nodes_ready ?? "-"}/${c.node_count ?? "-"}</td>
+        <td>${c.tpu_utilization >= 0 ? (100 * c.tpu_utilization).toFixed(0) + "%" : "–"}</td></tr>`).join("")}
+    </table></div></div>
+    ${(d.error_logs || []).length ? `<div class="card"><h3>Recent error logs (Loki)</h3>
+      <table><tr><th>cluster</th><th>ns/pod</th><th>line</th></tr>
+      ${d.error_logs.map(e => `<tr><td>${esc(e.cluster)}</td>
+        <td class="dim">${esc(e.namespace)}/${esc(e.pod)}</td>
+        <td class="small">${esc(e.line)}</td></tr>`).join("")}</table></div>` : ""}`;
+}
+
+/* ---------------- clusters + wizard ---------------- */
+
+async function renderClusters() {
+  const [cs, pkgs, backends, items] = await Promise.all([
+    api("/clusters"), api("/packages").catch(() => []),
+    api("/storage-backends").catch(() => []), api("/items").catch(() => [])]);
+  $("#view").innerHTML = `<div class="card"><h3>Clusters</h3>
+    <table><tr><th>name</th><th>status</th><th>template</th><th>network</th><th>mode</th><th></th></tr>
+    ${cs.map(c => `<tr><td><a data-go="cluster/${esc(c.name)}">${esc(c.name)}</a></td>
+      <td>${tag(c.status)}</td><td>${esc(c.template)}</td><td>${esc(c.network_plugin)}</td>
+      <td>${esc(c.deploy_type)}</td>
+      <td><button class="danger" data-act="delCluster" data-n="${esc(c.name)}">delete</button></td></tr>`).join("")}
+    </table></div>
+    <div class="card"><h3>New cluster</h3><div class="row">
+      <div><input id="cname" placeholder="name">
+        <select id="ctpl"><option>SINGLE</option><option>MULTIPLE</option></select>
+        <select id="cnet"><option>calico</option><option>flannel</option></select>
+        <select id="cmode"><option>MANUAL</option><option>AUTOMATIC</option></select></div>
+      <div><select id="cstore"><option>local-volume</option><option>nfs</option>
+            <option>rook-ceph</option><option>external-ceph</option><option>gcp-pd</option></select>
+        <select id="cbackend"><option value="">no storage backend</option>
+          ${backends.map(b => `<option>${esc(b.name)}</option>`).join("")}</select>
+        <select id="cpkg"><option value="">no offline package</option>
+          ${pkgs.map(p => `<option>${esc(p.name)}</option>`).join("")}</select>
+        <select id="citem"><option value="">no item (workspace)</option>
+          ${items.map(i => `<option>${esc(i.name)}</option>`).join("")}</select>
+        <button onclick="createCluster()">Create</button></div>
+    </div><div id="cerr" style="color:var(--err)"></div></div>`;
+}
+async function createCluster() {
+  try {
+    const body = {name: $("#cname").value, template: $("#ctpl").value,
+      network_plugin: $("#cnet").value, storage_provider: $("#cstore").value,
+      deploy_type: $("#cmode").value, package: $("#cpkg").value,
+      item: $("#citem").value};
+    if ($("#cbackend").value)
+      body.storage_config = {backend: $("#cbackend").value};
+    await api("/clusters", {method: "POST", body: JSON.stringify(body)});
+    renderClusters();
+  } catch (e) { $("#cerr").textContent = e.message; }
+}
+async function delCluster(name) {
+  if (!confirm("delete cluster " + name + "?")) return;
+  try { await api("/clusters/" + name, {method: "DELETE"}); renderClusters(); }
+  catch (e) { alert(e.message); }
+}
+
+/* ---------------- cluster detail (tabbed) ---------------- */
+
+const CLUSTER_TABS = ["overview", "nodes", "executions", "health", "events",
+                      "backups", "grade", "errorlogs", "kubectl"];
+
+async function renderCluster(name, tab = "overview") {
+  const c = await api("/clusters/" + name);
+  const tabs = CLUSTER_TABS.map(t =>
+    `<a class="${t === tab ? "on" : ""}"
+        data-go="cluster/${esc(name)}/${t}">${t}</a>`).join("");
+  const head = `<div class="card"><h3>${esc(c.name)} ${tag(c.status)}</h3>
+    <p class="dim">${esc(c.template)} · ${esc(c.network_plugin)} ·
+      ${esc(c.storage_provider)} · ${esc(c.deploy_type)}
+      ${c.package ? "· pkg " + esc(c.package) : ""}
+      ${c.item ? "· item " + esc(c.item) : ""}</p></div>
+    <div class="tabs">${tabs}</div>`;
+  const fn = {overview: clusterOverview, nodes: clusterNodes,
+              executions: clusterExecutions, health: clusterHealth,
+              events: clusterEvents, backups: clusterBackups,
+              grade: clusterGrade, errorlogs: clusterErrorLogs,
+              kubectl: clusterKubectl}[tab] || clusterOverview;
+  $("#view").innerHTML = head + `<div id="tabview"></div>`;
+  await fn(name, c);
+}
+
+async function clusterOverview(name, c) {
+  const ops = ["install", "uninstall", "upgrade", "scale", "add-worker",
+               "remove-worker", "backup", "restore"];
+  $("#tabview").innerHTML = `<div class="card"><h3>Operations</h3>
+    <div>${ops.map(o => `<button class="ghost" data-act="runOp" data-n="${esc(name)}" data-op="${o}">${o}</button>`).join("")}</div>
+    <p><a href="/api/v1/clusters/${esc(name)}/kubeconfig?token=${state.token}">kubeconfig ⭳</a></p>
+    </div>
+    <div class="card" id="progress" style="display:none"><h3>Progress</h3>
+      <div class="bar"><div id="pbar" style="width:0"></div></div>
+      <ul class="steps" id="psteps"></ul></div>
+    <div class="card" id="logcard" style="display:none"><h3>Log</h3>
+      <pre class="log" id="plog"></pre></div>`;
+}
+
+async function clusterNodes(name) {
+  const nodes = await api(`/clusters/${name}/nodes`);
+  $("#tabview").innerHTML = `<div class="card"><h3>Nodes</h3>
+    <table><tr><th>name</th><th>roles</th></tr>
+    ${nodes.map(n => `<tr><td>${esc(n.name)}</td>
+      <td>${esc((n.roles || []).join(", "))}</td></tr>`).join("")}
+    </table></div>`;
+}
+
+async function clusterExecutions(name) {
+  const exs = await api(`/clusters/${name}/executions`);
+  $("#tabview").innerHTML = `<div class="card"><h3>Executions</h3>
+    <table><tr><th>op</th><th>state</th><th>progress</th><th>started</th></tr>
+    ${exs.map(e => `<tr><td><a data-act="watch" data-n="${esc(e.id)}">${esc(e.operation)}</a></td>
+      <td>${tag(e.state)}</td><td>${Math.round((e.progress || 0) * 100)}%</td>
+      <td class="dim">${when(e.created_at)}</td></tr>`).join("")}
+    </table></div>
+    <div class="card" id="progress" style="display:none"><h3>Progress</h3>
+      <div class="bar"><div id="pbar" style="width:0"></div></div>
+      <ul class="steps" id="psteps"></ul></div>
+    <div class="card" id="logcard" style="display:none"><h3>Log</h3>
+      <pre class="log" id="plog"></pre></div>`;
+}
+
+async function clusterHealth(name) {
+  const recs = await api(`/clusters/${name}/health`);
+  const byKind = {};
+  recs.forEach(r => (byKind[r.kind] = byKind[r.kind] || []).push(r));
+  $("#tabview").innerHTML = ["slice", "host", "node", "component"].map(kind =>
+    byKind[kind] ? `<div class="card"><h3>${kind} health</h3>
+      <table><tr><th>target</th><th>state</th><th>hour</th><th>detail</th></tr>
+      ${byKind[kind].map(r => `<tr><td>${esc(r.target)}</td>
+        <td>${tag(r.healthy ? "healthy" : "unhealthy")}</td>
+        <td class="dim">${esc(r.hour)}</td>
+        <td class="small dim">${esc(JSON.stringify(r.detail || {}))}</td></tr>`).join("")}
+      </table></div>` : "").join("") ||
+    `<div class="card dim">No health records yet — the 5-minute beat populates them.</div>`;
+}
+
+async function clusterEvents(name) {
+  const r = await api(`/events?cluster=${encodeURIComponent(name)}`);
+  $("#tabview").innerHTML = `<div class="card"><h3>Events</h3>
+    <table><tr><th>type</th><th>reason</th><th>object</th><th>message</th><th>count</th></tr>
+    ${(r.events || []).map(e => `<tr><td>${tag(e.type)}</td><td>${esc(e.reason)}</td>
+      <td>${esc(e.namespace)}/${esc(e.object)}</td><td class="small">${esc(e.message)}</td>
+      <td>${e.count || 1}</td></tr>`).join("")}
+    </table></div>`;
+}
+
+async function clusterBackups(name) {
+  const [bs, storages, strategies] = await Promise.all([
+    api(`/clusters/${name}/backups`), api("/backup-storages").catch(() => []),
+    api("/backup-strategies").catch(() => [])]);
+  $("#tabview").innerHTML = `<div class="card"><h3>Backups</h3>
+    <button class="ghost" data-act="runOp" data-n="${esc(name)}" data-op="backup">backup now</button>
+    <button class="ghost" data-act="runOp" data-n="${esc(name)}" data-op="restore">restore latest</button>
+    <table><tr><th>name</th><th>size</th><th>created</th></tr>
+    ${bs.map(b => `<tr><td>${esc(b.name)}</td><td>${b.size_bytes ? (b.size_bytes / 1048576).toFixed(1) + " MB" : "–"}</td>
+      <td class="dim">${when(b.created_at)}</td></tr>`).join("")}
+    </table></div>
+    <div class="row"><div class="card"><h3>Backup storages</h3>
+      <table><tr><th>name</th><th>type</th></tr>
+      ${storages.map(s => `<tr><td>${esc(s.name)}</td><td>${esc(s.type)}</td></tr>`).join("")}</table>
+      <input id="bsname" placeholder="name"><select id="bstype">
+        <option>local</option><option>s3</option><option>oss</option><option>azure</option></select>
+      <button onclick="addBackupStorage()">Add</button></div>
+    <div class="card"><h3>Strategies</h3>
+      <table><tr><th>cluster</th><th>enabled</th><th>keep</th></tr>
+      ${strategies.map(s => `<tr><td>${esc(s.project)}</td><td>${s.enabled ? "yes" : "no"}</td>
+        <td>${s.save_num ?? "–"}</td></tr>`).join("")}</table>
+      <button class="ghost" data-act="addStrategy" data-n="${esc(name)}">enable daily backup for ${esc(name)}</button>
+    </div></div>`;
+}
+async function addBackupStorage() {
+  try {
+    await api("/backup-storages", {method: "POST", body: JSON.stringify(
+      {name: $("#bsname").value, type: $("#bstype").value})});
+    render();
+  } catch (e) { alert(e.message); }
+}
+async function addStrategy(cluster) {
+  try {
+    await api("/backup-strategies", {method: "POST", body: JSON.stringify(
+      {name: cluster + "-daily", project: cluster, enabled: true})});
+    render();
+  } catch (e) { alert(e.message); }
+}
+
+async function clusterGrade(name) {
+  const g = await api(`/clusters/${name}/grade`);
+  $("#tabview").innerHTML = `<div class="card">
+    <h3>Grade: ${esc(g.level || "?")} <span class="dim">(${g.score ?? "?"}/100)</span></h3>
+    <table><tr><th>check</th><th>weight</th><th>ok</th></tr>
+    ${(g.checks || []).map(c => `<tr><td>${esc(c.description || c.id)}</td>
+      <td class="dim">${c.weight}</td><td>${c.passed ? "✔" : "✘"}</td></tr>`).join("")}
+    </table></div>`;
+}
+
+async function clusterErrorLogs(name) {
+  const r = await api(`/clusters/${name}/errorlogs`);
+  $("#tabview").innerHTML = `<div class="card"><h3>Error logs (Loki, hourly scrape)</h3>
+    <table><tr><th>namespace</th><th>pod</th><th>line</th></tr>
+    ${(r.error_logs || []).map(e => `<tr><td>${esc(e.namespace)}</td>
+      <td>${esc(e.pod)}</td><td class="small">${esc(e.line)}</td></tr>`).join("")}
+    </table></div>`;
+}
+
+async function clusterKubectl(name) {
+  $("#tabview").innerHTML = `<div class="card"><h3>webkubectl</h3>
+    <pre class="term" id="term">connecting…</pre>
+    <input id="kcmd" placeholder="kubectl command, e.g. get pods -A">
+    </div>`;
+  const body = await api(`/clusters/${name}/webkubectl/token`);
+  const term = $("#term"); term.textContent = "";
+  const ws = new WebSocket(wsUrl(body.ws));
+  state.term = ws;
+  ws.onmessage = ev => {
+    const m = JSON.parse(ev.data);
+    term.textContent += (m.output ?? ("error: " + m.error)) + "\n";
+    term.scrollTop = term.scrollHeight;
+  };
+  ws.onclose = () => { term.textContent += "\n[session closed]\n"; };
+  $("#kcmd").addEventListener("keydown", e => {
+    if (e.key === "Enter" && ws.readyState === 1) {
+      term.textContent += "$ kubectl " + $("#kcmd").value + "\n";
+      ws.send($("#kcmd").value); $("#kcmd").value = "";
+    }
+  });
+}
+
+async function runOp(name, op) {
+  try {
+    const ex = await api(`/clusters/${name}/executions`, {method: "POST",
+      body: JSON.stringify({operation: op})});
+    watch(ex.id);
+  } catch (e) { alert(e.message); }
+}
+function watch(exId) {
+  const prog = $("#progress"), logc = $("#logcard");
+  if (!prog) return;
+  prog.style.display = "block"; logc.style.display = "block";
+  $("#plog").textContent = "";
+  if (state.ws) state.ws.forEach(w => w.close());
+  const pws = new WebSocket(wsUrl(`/ws/progress/${exId}?token=${state.token}`));
+  pws.onmessage = ev => {
+    const ex = JSON.parse(ev.data);
+    $("#pbar").style.width = Math.round((ex.progress || 0) * 100) + "%";
+    $("#psteps").innerHTML = (ex.steps || []).map(s =>
+      `<li>${{success: "✔", error: "✘", running: "▶"}[s.status] || "·"} ${esc(s.name)}
+       <span class="dim">${esc(s.message || "")}</span></li>`).join("");
+    if (ex.state === "SUCCESS" || ex.state === "FAILURE") pws.close();
+  };
+  const lws = new WebSocket(wsUrl(`/ws/tasks/${exId}/log?token=${state.token}`));
+  lws.onmessage = ev => { const el = $("#plog"); el.textContent += ev.data;
+                          el.scrollTop = el.scrollHeight; };
+  state.ws = [pws, lws];
+}
+
+/* ---------------- hosts + credentials ---------------- */
+
+async function renderHosts() {
+  const [hosts, creds] = await Promise.all([api("/hosts"), api("/credentials")]);
+  $("#view").innerHTML = `<div class="card"><h3>Hosts</h3>
+    <table><tr><th>name</th><th>ip</th><th>cpu</th><th>mem</th><th>accelerator</th><th>slice</th><th>cluster</th></tr>
+    ${hosts.map(h => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.cpu_core || "-"}</td>
+      <td>${h.memory_mb ? Math.round(h.memory_mb / 1024) + " GB" : "-"}</td>
+      <td>${h.tpu_type ? esc(h.tpu_type) : (h.gpu_num ? h.gpu_num + "×GPU" : "–")}</td>
+      <td class="dim">${esc(h.tpu_slice_id || "–")}</td>
+      <td>${esc(h.project || "–")}</td></tr>`).join("")}
+    </table></div>
+    <div class="row">
+    <div class="card"><h3>Register host</h3>
+      <input id="hname" placeholder="name"><input id="hip" placeholder="ip">
+      <select id="hcred"><option value="">no credential</option>
+        ${creds.map(c => `<option value="${esc(c.id)}">${esc(c.name)}</option>`).join("")}</select>
+      <button onclick="addHost()">Register</button>
+      <div id="herr" style="color:var(--err)"></div></div>
+    <div class="card"><h3>Bulk import (CSV)</h3>
+      <p class="dim small">columns: name,ip,port,credential</p>
+      <input type="file" id="hcsv" accept=".csv">
+      <button onclick="importHosts()">Import</button>
+      <div id="himp" class="dim"></div></div>
+    <div class="card"><h3>Credentials</h3>
+      <table><tr><th>name</th><th>user</th></tr>
+      ${creds.map(c => `<tr><td>${esc(c.name)}</td><td>${esc(c.username)}</td></tr>`).join("")}</table>
+      <input id="crname" placeholder="name"><input id="cruser" placeholder="username" value="root">
+      <input id="crpass" placeholder="password (or leave for key)" type="password">
+      <button onclick="addCred()">Add credential</button></div>
+    </div>`;
+}
+async function addHost() {
+  try {
+    await api("/hosts", {method: "POST", body: JSON.stringify({
+      name: $("#hname").value, ip: $("#hip").value,
+      credential_id: $("#hcred").value, gather: false})});
+    renderHosts();
+  } catch (e) { $("#herr").textContent = e.message; }
+}
+async function importHosts() {
+  const file = $("#hcsv").files[0];
+  if (!file) return;
+  const text = await file.text();
+  const r = await fetch("/api/v1/hosts/import", {method: "POST", body: text,
+    headers: {"Authorization": "Bearer " + state.token}});
+  const body = await r.json();
+  $("#himp").textContent = `created: ${(body.created || []).join(", ") || "none"}` +
+    (body.errors?.length ? ` · errors: ${body.errors.length}` : "");
+  renderHosts();
+}
+async function addCred() {
+  try {
+    await api("/credentials", {method: "POST", body: JSON.stringify({
+      name: $("#crname").value, username: $("#cruser").value,
+      password: $("#crpass").value})});
+    renderHosts();
+  } catch (e) { alert(e.message); }
+}
+
+/* ---------------- packages ---------------- */
+
+async function renderPackages() {
+  const pkgs = await api("/packages");
+  $("#view").innerHTML = `<div class="card"><h3>Offline packages</h3>
+    <button class="ghost" onclick="scanPackages()">rescan package dir</button>
+    <table><tr><th>name</th><th>k8s version</th><th>repo</th><th>vars</th></tr>
+    ${pkgs.map(p => `<tr><td>${esc(p.name)}</td>
+      <td>${esc(p.meta?.vars?.kube_version || "–")}</td>
+      <td><a href="/repo/${esc(p.name)}/" class="small">/repo/${esc(p.name)}/</a></td>
+      <td class="small dim">${esc(JSON.stringify(p.meta?.vars || {}))}</td></tr>`).join("")}
+    </table>
+    <p class="dim small">Packages are directories under &lt;data&gt;/packages with a
+    meta.yml; the controller serves them as the air-gapped binary repo.</p></div>`;
+}
+async function scanPackages() {
+  try { await api("/packages/scan", {method: "POST"}); renderPackages(); }
+  catch (e) { alert(e.message); }
+}
+
+/* ---------------- storage backends ---------------- */
+
+async function renderStorage() {
+  const [backends, hosts] = await Promise.all([
+    api("/storage-backends"), api("/hosts")]);
+  $("#view").innerHTML = `<div class="card"><h3>Storage backends</h3>
+    <table><tr><th>name</th><th>type</th><th>status</th><th>config</th><th></th></tr>
+    ${backends.map(b => `<tr><td>${esc(b.name)}</td><td>${esc(b.type)}</td>
+      <td>${tag(b.status)}</td>
+      <td class="small dim">${esc(JSON.stringify(b.config || {}))}</td>
+      <td><button class="ghost" data-act="deployBackend" data-n="${esc(b.name)}">deploy</button></td></tr>`).join("")}
+    </table></div>
+    <div class="row">
+    <div class="card"><h3>New NFS backend</h3>
+      <input id="nbname" placeholder="name">
+      <select id="nbhost">${hosts.map(h => `<option>${esc(h.name)}</option>`).join("")}</select>
+      <input id="nbpath" placeholder="export path" value="/export">
+      <button onclick="addNfsBackend()">Create</button></div>
+    <div class="card"><h3>New external Ceph</h3>
+      <input id="cbname" placeholder="name">
+      <input id="cbmon" placeholder="monitors (host:6789,…)">
+      <input id="cbuser" placeholder="user" value="admin">
+      <input id="cbkey" placeholder="key" type="password">
+      <button onclick="addCephBackend()">Create</button></div>
+    </div>`;
+}
+async function addNfsBackend() {
+  try {
+    await api("/storage-backends", {method: "POST", body: JSON.stringify({
+      name: $("#nbname").value, type: "nfs",
+      config: {host: $("#nbhost").value, export_path: $("#nbpath").value}})});
+    renderStorage();
+  } catch (e) { alert(e.message); }
+}
+async function addCephBackend() {
+  try {
+    await api("/storage-backends", {method: "POST", body: JSON.stringify({
+      name: $("#cbname").value, type: "external-ceph",
+      config: {monitors: $("#cbmon").value, user: $("#cbuser").value,
+               key: $("#cbkey").value}})});
+    renderStorage();
+  } catch (e) { alert(e.message); }
+}
+async function deployBackend(name) {
+  try { await api(`/storage-backends/${name}/deploy`, {method: "POST"}); renderStorage(); }
+  catch (e) { alert(e.message); }
+}
+
+/* ---------------- items (tenancy) ---------------- */
+
+async function renderItems() {
+  const [items, users, clusters] = await Promise.all([
+    api("/items"), api("/users").catch(() => []), api("/clusters")]);
+  const detail = await Promise.all(items.map(i =>
+    api(`/items/${i.name}/resources`).catch(() => [])));
+  $("#view").innerHTML = `<div class="card"><h3>Items (workspaces)</h3>
+    <table><tr><th>name</th><th>description</th><th>clusters</th></tr>
+    ${items.map((i, n) => `<tr><td>${esc(i.name)}</td><td class="dim">${esc(i.description)}</td>
+      <td>${esc(detail[n].map(r => r.name).join(", ") || "–")}</td></tr>`).join("")}
+    </table>
+    <input id="iname" placeholder="name"><input id="idesc" placeholder="description">
+    <button onclick="addItem()">Create item</button></div>
+    <div class="row">
+    <div class="card"><h3>Add member</h3>
+      <select id="mitem">${items.map(i => `<option>${esc(i.name)}</option>`).join("")}</select>
+      <select id="muser">${users.map(u => `<option>${esc(u.name)}</option>`).join("")}</select>
+      <select id="mrole"><option>VIEWER</option><option>MANAGER</option></select>
+      <button onclick="addMember()">Add</button></div>
+    <div class="card"><h3>Attach cluster</h3>
+      <select id="ritem">${items.map(i => `<option>${esc(i.name)}</option>`).join("")}</select>
+      <select id="rcluster">${clusters.map(c => `<option>${esc(c.name)}</option>`).join("")}</select>
+      <button onclick="addResource()">Attach</button></div>
+    </div>`;
+}
+async function addItem() {
+  try {
+    await api("/items", {method: "POST", body: JSON.stringify({
+      name: $("#iname").value, description: $("#idesc").value})});
+    renderItems();
+  } catch (e) { alert(e.message); }
+}
+async function addMember() {
+  try {
+    await api(`/items/${$("#mitem").value}/members`, {method: "POST",
+      body: JSON.stringify({user: $("#muser").value, role: $("#mrole").value})});
+    alert("member added");
+  } catch (e) { alert(e.message); }
+}
+async function addResource() {
+  try {
+    await api(`/items/${$("#ritem").value}/resources`, {method: "POST",
+      body: JSON.stringify({resource_type: "cluster", name: $("#rcluster").value})});
+    renderItems();
+  } catch (e) { alert(e.message); }
+}
+
+/* ---------------- users ---------------- */
+
+async function renderUsers() {
+  const users = await api("/users");
+  $("#view").innerHTML = `<div class="card"><h3>Users</h3>
+    <table><tr><th>name</th><th>email</th><th>source</th><th>admin</th><th>state</th></tr>
+    ${users.map(u => `<tr><td>${esc(u.name)}</td><td class="dim">${esc(u.email)}</td>
+      <td>${esc(u.source)}</td><td>${u.is_admin ? "✔" : ""}</td>
+      <td>${u.disabled ? tag("ERROR") : tag("READY")}</td></tr>`).join("")}
+    </table></div>
+    <div class="card"><h3>New user</h3><div class="row">
+      <div><input id="uname" placeholder="username">
+        <input id="uemail" placeholder="email"></div>
+      <div><input id="upass" placeholder="password" type="password">
+        <label class="dim"><input type="checkbox" id="uadmin" style="width:auto"> admin</label>
+        <button onclick="addUser()">Create</button></div>
+    </div><div id="uerr" style="color:var(--err)"></div></div>`;
+}
+async function addUser() {
+  try {
+    await api("/users", {method: "POST", body: JSON.stringify({
+      name: $("#uname").value, email: $("#uemail").value,
+      password: $("#upass").value, is_admin: $("#uadmin").checked})});
+    renderUsers();
+  } catch (e) { $("#uerr").textContent = e.message; }
+}
+
+/* ---------------- settings ---------------- */
+
+const SETTING_TABS = {
+  ldap: ["ldap_enabled", "ldap_host", "ldap_port", "ldap_user_dn_template",
+         "ldap_sync_enabled", "ldap_base_dn", "ldap_bind_dn",
+         "ldap_bind_password", "ldap_email_domain"],
+  notification: ["smtp_host", "smtp_port", "smtp_user", "smtp_password",
+                 "webhook_url", "notify_min_level"],
+  system: ["registry", "repo_url", "ntp_server"],
+};
+
+async function renderSettings() {
+  const settings = await api("/settings");
+  const val = name => esc((settings.find(s => s.name === name) || {}).value || "");
+  $("#view").innerHTML = Object.entries(SETTING_TABS).map(([tabName, keys]) =>
+    `<div class="card"><h3>${tabName}</h3>
+     ${keys.map(k => `<div class="row"><div class="dim" style="max-width:260px">${k}</div>
+       <div><input id="set-${k}" value="${val(k)}"
+            type="${k.includes("password") ? "password" : "text"}"></div></div>`).join("")}
+     <button onclick="saveSettings('${tabName}')">Save ${tabName}</button></div>`).join("") +
+    `<div id="serr" style="color:var(--err)"></div>`;
+}
+async function saveSettings(tabName) {
+  try {
+    for (const k of SETTING_TABS[tabName]) {
+      const v = $("#set-" + k).value;
+      if (v === "***") continue;   // masked secret, unchanged
+      await api("/settings", {method: "PUT", body: JSON.stringify({
+        name: k, value: v, tab: tabName})});
+    }
+    renderSettings();
+  } catch (e) { $("#serr").textContent = e.message; }
+}
+
+/* ---------------- system logs ---------------- */
+
+async function renderLogs() {
+  $("#view").innerHTML = `<div class="card"><h3>System log search</h3>
+    <div class="row"><div><input id="lq" placeholder="free text query"></div>
+    <div><select id="llevel"><option value="">any level</option>
+      <option>INFO</option><option>WARNING</option><option>ERROR</option></select></div>
+    <div><button onclick="searchLogs()">Search</button></div></div>
+    <div id="lres"></div></div>`;
+  await searchLogs();
+}
+async function searchLogs() {
+  const q = encodeURIComponent($("#lq")?.value || "");
+  const lv = encodeURIComponent($("#llevel")?.value || "");
+  const r = await api(`/logs?query=${q}&level=${lv}&limit=200`);
+  $("#lres").innerHTML = `<table><tr><th>time</th><th>level</th><th>task</th><th>message</th></tr>
+    ${(r.logs || []).map(l => `<tr><td class="dim small">${esc(l.ts)}</td>
+      <td>${tag(l.level)}</td><td class="dim small">${esc(l.task.slice(0, 8))}</td>
+      <td class="small">${esc(l.message.slice(0, 300))}</td></tr>`).join("")}</table>`;
+}
+
+/* ---------------- messages ---------------- */
+
+async function renderMessages() {
+  const ms = await api("/messages");
+  $("#view").innerHTML = `<div class="card"><h3>Messages</h3>
+    <table><tr><th>level</th><th>title</th><th>cluster</th><th>time</th><th></th></tr>
+    ${ms.map(m => `<tr><td>${tag(m.level)}</td><td>${esc(m.title)}</td>
+      <td>${esc(m.project || "–")}</td>
+      <td class="dim">${when(m.created_at)}</td>
+      <td>${(m.read_by || []).includes(state.user?.name) ? "" :
+            `<button class="ghost" data-act="markRead" data-n="${esc(m.id)}">mark read</button>`}</td>
+      </tr>`).join("")}
+    </table></div>`;
+}
+async function markRead(id) {
+  try { await api(`/messages/${id}/read`, {method: "POST"}); renderMessages(); }
+  catch (e) { alert(e.message); }
+}
+
+/* ---------------- boot ---------------- */
+
+// Entity names flow into the DOM only as escaped text/attributes; clicks
+// are delegated off data attributes so no name is ever spliced into JS.
+document.addEventListener("click", e => {
+  const go = e.target.closest("[data-go]");
+  if (go) return nav(go.dataset.go);
+  const act = e.target.closest("[data-act]");
+  if (!act) return;
+  const d = act.dataset;
+  ({delCluster: () => delCluster(d.n), runOp: () => runOp(d.n, d.op),
+    addStrategy: () => addStrategy(d.n), deployBackend: () => deployBackend(d.n),
+    watch: () => watch(d.n), markRead: () => markRead(d.n)}[d.act] || (() => {}))();
+});
+
+window.addEventListener("hashchange", render);
+window.addEventListener("load", async () => {
+  if (state.token) {
+    try { state.user = await api("/profile"); $("#who").textContent = state.user.name; }
+    catch (e) {}
+  }
+  render();
+});

@@ -73,3 +73,35 @@ def test_logs_api_routes(platform, with_task_logs):
         assert r.status == 403
 
     run_api(platform, scenario)
+
+
+def test_secret_settings_masked_on_read(platform):
+    """ldap/smtp credentials must never be served back (reference keeps
+    them server-side); a masked read-back must not clobber the secret."""
+    import asyncio
+    from kubeoperator_tpu.api.app import ensure_admin
+    from kubeoperator_tpu.resources.entities import Setting
+
+    ensure_admin(platform)
+
+    async def scenario(client):
+        hdrs = await login(client)
+        for name, value in (("ldap_bind_password", "hunter2"),
+                            ("smtp_password", "mailpw"),
+                            ("ldap_host", "ldap.corp")):
+            r = await client.put("/api/v1/settings", headers=hdrs,
+                                 json={"name": name, "value": value})
+            assert r.status == 200
+        r = await client.get("/api/v1/settings", headers=hdrs)
+        vals = {s["name"]: s["value"] for s in await r.json()}
+        assert vals["ldap_bind_password"] == "***"
+        assert vals["smtp_password"] == "***"
+        assert vals["ldap_host"] == "ldap.corp"      # non-secret: served
+        # writing the mask back must keep the stored secret intact
+        r = await client.put("/api/v1/settings", headers=hdrs,
+                             json={"name": "ldap_bind_password", "value": "***"})
+        assert r.status == 200
+
+    run_api(platform, scenario)
+    stored = platform.store.get_by_name(Setting, "ldap_bind_password", scoped=False)
+    assert stored.value == "hunter2"

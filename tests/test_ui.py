@@ -1,0 +1,88 @@
+"""UI ↔ API contract: the portal is served, and every endpoint app.js
+drives resolves to a registered route (no phantom calls — the UI analogue
+of the manifests-command check in test_jobs.py)."""
+
+import re
+
+import pytest
+
+from kubeoperator_tpu.api.app import create_app, ensure_admin
+from tests.test_api import login, run_api
+
+UI_DIR = "kubeoperator_tpu/ui"
+
+
+def read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_shell_references_app_js():
+    html = read(f"{UI_DIR}/index.html")
+    assert '<script src="/ui/app.js">' in html
+
+
+def test_app_js_brace_balance():
+    js = read(f"{UI_DIR}/app.js")
+    # crude but effective syntax guard without a JS engine in the image:
+    # template literals keep braces paired, so totals must match
+    for open_c, close_c in ("{}", "()", "[]"):
+        assert js.count(open_c) == js.count(close_c), f"unbalanced {open_c}{close_c}"
+
+
+def ui_api_paths():
+    js = read(f"{UI_DIR}/app.js")
+    paths = set()
+    for m in re.finditer(r'api\(\s*[`"]([^`"]+)[`"]', js):
+        paths.add(m.group(1))
+    for m in re.finditer(r'fetch\("(/api/v1[^"]+)"', js):
+        paths.add(m.group(1)[len("/api/v1"):])
+    # normalize JS interpolations + query strings into route placeholders
+    out = set()
+    for p in paths:
+        p = p.split("?")[0]
+        p = re.sub(r"\$\{(?:[^{}]|\{[^{}]*\})*\}", "X", p)   # ${$("#x").value}
+        if p.endswith("/"):
+            p += "X"                  # api("/clusters/" + name) concat form
+        out.add(p)
+    return sorted(out)
+
+
+def _matches(call: str, route: str) -> bool:
+    """Segment-wise match: a route {param} (normalized to X) accepts any
+    call segment; literal segments must equal."""
+    cs, rs = call.strip("/").split("/"), route.strip("/").split("/")
+    if len(cs) != len(rs):
+        return False
+    return all(r == "X" or c in ("X", r) for c, r in zip(cs, rs))
+
+
+def test_every_ui_call_has_a_route(platform):
+    app = create_app(platform)
+    route_paths = set()
+    for r in app.router.routes():
+        info = r.resource.get_info() if r.resource else {}
+        pattern = info.get("formatter") or info.get("path") or ""
+        if pattern.startswith("/api/v1"):
+            route_paths.add(re.sub(r"\{[^}]+\}", "X", pattern[len("/api/v1"):]))
+    missing = [p for p in ui_api_paths()
+               if not any(_matches(p, rp) for rp in route_paths)]
+    assert not missing, f"UI calls endpoints with no route: {missing}"
+
+
+def test_ui_served_with_assets(platform):
+    ensure_admin(platform)
+
+    async def scenario(client):
+        r = await client.get("/ui/")
+        assert r.status == 200
+        assert "KubeOperator" in await r.text()
+        r = await client.get("/ui/app.js")
+        assert r.status == 200
+        body = await r.text()
+        assert "renderDashboard" in body and "clusterKubectl" in body
+        # the root redirects into the portal
+        r = await client.get("/", allow_redirects=False)
+        assert r.status == 302 and r.headers["Location"] == "/ui/"
+
+    run_api(platform, scenario)
