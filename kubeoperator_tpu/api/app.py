@@ -241,7 +241,7 @@ def register_crud(app: web.Application, path: str, cls: type,
         else:
             entity = cls(**body)
             await _sync(request, platform.store.save, entity)
-        return web.json_response(dump(entity), status=201)
+        return web.json_response(ser(entity), status=201)
 
     async def delete_(request: web.Request) -> web.Response:
         if admin_write:
@@ -559,7 +559,7 @@ async def upsert_setting(request: web.Request) -> web.Response:
         platform.store.save(s)
         return s
 
-    return web.json_response(dump(await _sync(request, _up)))
+    return web.json_response(setting_dump(await _sync(request, _up)))
 
 async def list_messages(request: web.Request) -> web.Response:
     platform: Platform = request.app["platform"]

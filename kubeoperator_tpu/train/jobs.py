@@ -76,8 +76,8 @@ def parse_mesh(arg: str | None, n_devices: int):
     auto_axis = None
     for part in arg.split(","):
         name, _, val = part.strip().partition(":")
-        if name not in ("dp", "fsdp", "tp", "sp"):
-            raise SystemExit(f"unknown mesh axis {name!r} (want dp/fsdp/tp/sp)")
+        if name not in ("dp", "fsdp", "ep", "tp", "sp"):
+            raise SystemExit(f"unknown mesh axis {name!r} (want dp/fsdp/ep/tp/sp)")
         if val == "auto":
             if auto_axis:
                 raise SystemExit("only one mesh axis may be 'auto'")
@@ -229,6 +229,7 @@ def cmd_llm(args: argparse.Namespace) -> int:
                             n_heads=args.heads, n_layers=args.layers,
                             d_ff=args.d_ff or int(args.d_model * 8 / 3 / 32) * 32,
                             max_seq_len=args.seq_len,
+                            moe_experts=args.experts,
                             dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     lt = LMTrainer(cfg, spec, devices=devices)
     state = lt.init_state()
@@ -295,6 +296,8 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--heads", type=int, default=8)
     lm.add_argument("--layers", type=int, default=4)
     lm.add_argument("--d-ff", type=int, default=None)
+    lm.add_argument("--experts", type=int, default=0,
+                    help=">0 enables MoE FFNs (shard experts with --mesh ep:N)")
     lm.add_argument("--bf16", action="store_true", default=True)
     lm.add_argument("--no-bf16", dest="bf16", action="store_false")
     lm.add_argument("--mesh", type=str, default=None,

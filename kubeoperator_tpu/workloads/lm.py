@@ -81,11 +81,22 @@ class LMTrainer:
             targets = jnp.roll(tokens, -1, axis=1)
             mask = (jnp.arange(t) < t - 1).astype(jnp.float32)[None, :]
 
+            moe = self.cfg.moe_experts > 0
+
             def loss_fn(params):
-                logits = model.apply({"params": params}, tokens)
+                if moe:
+                    # sown MoE aux losses (load balancing) join the objective
+                    logits, inter = model.apply(
+                        {"params": params}, tokens, mutable=["intermediates"])
+                    aux = sum(jnp.sum(jnp.stack(v)) for v in
+                              jax.tree.leaves(inter.get("intermediates", {}),
+                                              is_leaf=lambda x: isinstance(x, tuple)))
+                else:
+                    logits = model.apply({"params": params}, tokens)
+                    aux = 0.0
                 losses = optax.softmax_cross_entropy_with_integer_labels(
                     logits, targets)
-                return (losses * mask).sum() / mask.sum()
+                return (losses * mask).sum() / mask.sum() + aux
 
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             updates, opt_state = tx.update(grads, state["opt_state"], state["params"])

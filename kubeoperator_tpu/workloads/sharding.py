@@ -14,6 +14,9 @@ Axes (any may be size 1 and is then omitted from the mesh):
              highest-traffic axis, innermost so it maps to the torus.
 * ``sp``   — sequence/context parallel for long-context attention (ring
              attention over ``ppermute``); shares traffic profile with tp.
+* ``ep``   — expert parallel for MoE layers: experts shard over ``ep`` and
+             token dispatch/combine is an all-to-all GSPMD derives from the
+             expert-weight shardings, so it belongs on ICI like tp/sp.
 
 There is no ``pp`` mesh axis: pipeline parallelism on TPU is expressed as a
 ``jax.lax.scan`` over stacked layer params inside the fsdp/tp mesh (see
@@ -35,6 +38,7 @@ class MeshSpec:
     """Parallelism degrees. Product must equal the device count."""
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
@@ -43,11 +47,12 @@ class MeshSpec:
         return tuple(n for n, s in self.sizes() if s > 1) or ("dp",)
 
     def sizes(self) -> tuple[tuple[str, int], ...]:
-        return (("dp", self.dp), ("fsdp", self.fsdp), ("tp", self.tp), ("sp", self.sp))
+        return (("dp", self.dp), ("fsdp", self.fsdp), ("ep", self.ep),
+                ("tp", self.tp), ("sp", self.sp))
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.ep * self.tp * self.sp
 
     @property
     def data_axes(self) -> tuple[str, ...]:
@@ -56,13 +61,17 @@ class MeshSpec:
 
     @staticmethod
     def for_devices(n: int, *, model_parallel: int = 1,
-                    sequence_parallel: int = 1, zero3: bool = True) -> "MeshSpec":
+                    sequence_parallel: int = 1, expert_parallel: int = 1,
+                    zero3: bool = True) -> "MeshSpec":
         """Fill the data axes with whatever devices remain after model axes."""
-        if n % (model_parallel * sequence_parallel):
-            raise ValueError(f"{n} devices not divisible by tp={model_parallel} × sp={sequence_parallel}")
-        data = n // (model_parallel * sequence_parallel)
+        model = model_parallel * sequence_parallel * expert_parallel
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by tp={model_parallel} × "
+                             f"sp={sequence_parallel} × ep={expert_parallel}")
+        data = n // model
         return MeshSpec(dp=1 if zero3 else data, fsdp=data if zero3 else 1,
-                        tp=model_parallel, sp=sequence_parallel)
+                        ep=expert_parallel, tp=model_parallel,
+                        sp=sequence_parallel)
 
 
 def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
@@ -115,6 +124,7 @@ def logical_axis_rules(spec: MeshSpec) -> tuple[tuple[str, str | None], ...]:
         ("kv", None),
         ("seq", pick("sp")),           # ring-attention sequence shards
         ("vocab", pick("tp")),
+        ("expert", pick("ep")),        # MoE experts shard over ep
     )
 
 

@@ -40,8 +40,13 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     ring: bool = False          # use ring attention (sequence sharded on 'sp')
+    moe_experts: int = 0        # >0: every block's FFN is a routed MoE
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     attention: str = "auto"     # auto | flash | dense — auto picks the pallas
-                                # flash kernel on TPU, dense elsewhere
+                                # flash kernel on TPU for long sequences
+                                # (≥8k; below that XLA's fused attention is
+                                # faster on v5e, PERF.md), dense elsewhere
 
     @property
     def head_dim(self) -> int:
@@ -96,7 +101,11 @@ class Attention(nn.Module):
         elif (cfg.attention == "flash" and q.shape[1] % 128 == 0) or (
                 cfg.attention == "auto"
                 and jax.default_backend() in ("tpu", "axon")
-                and q.shape[1] % 128 == 0):
+                and q.shape[1] % 128 == 0
+                # measured on v5e (PERF.md): XLA's fused attention beats the
+                # pallas kernel below ~8k sequence; flash pays off once the
+                # S×S intermediate dominates HBM
+                and q.shape[1] >= 8192):
             from kubeoperator_tpu.workloads.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
         else:
@@ -130,8 +139,17 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        x = x + Attention(self.cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x), positions)
-        x = x + Mlp(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        cfg = self.cfg
+        x = x + Attention(cfg, self.mesh, name="attn")(RMSNorm(name="ln1")(x), positions)
+        if cfg.moe_experts > 0:
+            from kubeoperator_tpu.workloads.moe import MoEMlp
+            ffn = MoEMlp(cfg.d_model, cfg.d_ff, cfg.moe_experts,
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, name="moe")
+        else:
+            ffn = Mlp(cfg, name="mlp")
+        x = x + ffn(RMSNorm(name="ln2")(x))
         return x, None
 
 
@@ -170,9 +188,14 @@ class Transformer(nn.Module):
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
-    """Forward FLOPs/token: 6·N_params-ish matmul term + attention term."""
+    """Forward FLOPs/token: 6·N_params-ish matmul term + attention term.
+    MoE configs count top_k SwiGLUs per token plus the router matmul."""
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
-    per_layer = 2 * (4 * d * d + 3 * d * f)           # qkvo + swiglu matmuls
+    if cfg.moe_experts > 0:
+        ffn = cfg.moe_top_k * (2 * 3 * d * f) + 2 * d * cfg.moe_experts
+    else:
+        ffn = 2 * 3 * d * f                           # dense swiglu
+    per_layer = 2 * 4 * d * d + ffn                   # qkvo + ffn matmuls
     attn = 2 * 2 * seq_len * d                        # qk^T + pv, per token
     embed = 2 * d * cfg.vocab_size                    # logits matmul
     return l * (per_layer + attn) + embed

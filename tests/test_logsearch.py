@@ -97,10 +97,12 @@ def test_secret_settings_masked_on_read(platform):
         assert vals["ldap_bind_password"] == "***"
         assert vals["smtp_password"] == "***"
         assert vals["ldap_host"] == "ldap.corp"      # non-secret: served
-        # writing the mask back must keep the stored secret intact
+        # writing the mask back must keep the stored secret intact — and
+        # the write response must not echo the plaintext either
         r = await client.put("/api/v1/settings", headers=hdrs,
                              json={"name": "ldap_bind_password", "value": "***"})
         assert r.status == 200
+        assert (await r.json())["value"] == "***"
 
     run_api(platform, scenario)
     stored = platform.store.get_by_name(Setting, "ldap_bind_password", scoped=False)

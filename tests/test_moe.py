@@ -1,0 +1,110 @@
+"""MoE + expert parallelism on the virtual mesh (workloads/moe.py, the ep
+mesh axis)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.workloads.lm import LMTrainer
+from kubeoperator_tpu.workloads.moe import MoEMlp
+from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh
+from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+
+def test_moe_layer_forward_and_capacity():
+    layer = MoEMlp(d_model=16, d_ff=32, n_experts=4, top_k=2,
+                   dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16))
+    vars_ = layer.init(jax.random.key(1), x)
+    y, inter = layer.apply(vars_, x, mutable=["intermediates"])
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    aux = inter["intermediates"]["moe_aux"][0]
+    assert float(aux) > 0                       # balance loss is live
+    # expert weights carry the expert logical axis
+    from flax import linen as nn
+    spec = nn.get_partition_spec(vars_)["params"]["w_gate"]
+    assert tuple(spec)[0] == "expert"
+
+
+def test_moe_matches_per_token_reference():
+    """With non-binding capacity, the dense dispatch must equal routing
+    each token through its top-k experts individually — this is exactly
+    the slot-collision case (two tokens reaching one expert via different
+    top-k slots must occupy different capacity slots)."""
+    E, K, D, F = 2, 2, 8, 16
+    layer = MoEMlp(d_model=D, d_ff=F, n_experts=E, top_k=K,
+                   capacity_factor=8.0, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 8, D))
+    vars_ = layer.init(jax.random.key(1), x)
+    got = layer.apply(vars_, x)
+
+    from flax import linen as nn
+    p = nn.unbox(vars_["params"])
+    logits = x @ p["router"]["kernel"]
+    probs = jax.nn.softmax(logits, -1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)
+    gate_vals = gate_vals / gate_vals.sum(-1, keepdims=True)
+
+    def expert(e, v):
+        h = jax.nn.silu(v @ p["w_gate"][e]) * (v @ p["w_up"][e])
+        return h @ p["w_down"][e]
+
+    want = np.zeros_like(np.asarray(x))
+    for b in range(x.shape[0]):
+        for t in range(x.shape[1]):
+            for k in range(K):
+                e = int(gate_idx[b, t, k])
+                want[b, t] += float(gate_vals[b, t, k]) * np.asarray(
+                    expert(e, x[b, t]))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5, rtol=1e-4)
+
+
+def test_moe_gradients_flow_to_all_expert_weights():
+    layer = MoEMlp(d_model=8, d_ff=16, n_experts=2, top_k=2, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 8))
+    vars_ = layer.init(jax.random.key(1), x)
+    from flax import linen as nn
+    params = nn.unbox(vars_["params"])
+
+    def loss(params):
+        y = layer.apply({"params": params}, x)
+        return (y ** 2).mean()
+
+    g = jax.grad(loss)(params)
+    for name in ("w_gate", "w_up", "w_down", "router"):
+        leaf = g[name] if name != "router" else g["router"]["kernel"]
+        assert float(jnp.abs(jnp.asarray(jax.tree.leaves(leaf)[0])).sum()) > 0, name
+
+
+def test_moe_lm_trains_under_ep_mesh():
+    """dp×ep×tp on the 8-device mesh: expert weights shard over ep and a
+    train step executes (the all-to-all compiles and runs)."""
+    spec = MeshSpec(dp=2, ep=2, tp=2)
+    cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=128, max_seq_len=64, dtype=jnp.float32,
+                            remat=True, moe_experts=4)
+    lt = LMTrainer(cfg, spec)
+    state = lt.init_state()
+    # stacked expert weights: [layers, E, D, F] with E sharded on ep
+    w_gate = state["params"]["layers"]["moe"]["w_gate"]
+    assert "ep" in str(w_gate.sharding.spec), w_gate.sharding.spec
+    tokens = lt.synthetic_batch(batch=4, seq_len=32)
+    state, metrics = lt.train_step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state["step"]) == 1
+
+
+def test_moe_loss_decreases():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+                            moe_experts=2, remat=False)
+    lt = LMTrainer(cfg, MeshSpec(dp=8), learning_rate=1e-2)
+    state = lt.init_state()
+    tokens = lt.synthetic_batch(batch=8, seq_len=32)
+    first = None
+    for _ in range(8):
+        state, m = lt.train_step(state, tokens)
+        first = first if first is not None else float(m["loss"])
+    assert float(m["loss"]) < first
