@@ -9,9 +9,13 @@ whose worker pools are **TPU pod slices**: one slice = ``hosts(type)`` VMs
 
 from kubeoperator_tpu.providers.base import CloudProvider, allocate_ip, recover_ip
 from kubeoperator_tpu.providers.gce_tpu import GceTpuProvider
+from kubeoperator_tpu.providers.openstack import OpenstackProvider
 from kubeoperator_tpu.providers.terraform import TerraformDriver
+from kubeoperator_tpu.providers.vsphere import VsphereProvider
 
-PROVIDERS = {"gce": GceTpuProvider}
+PROVIDERS = {"gce": GceTpuProvider, "vsphere": VsphereProvider,
+             "openstack": OpenstackProvider}
 
-__all__ = ["CloudProvider", "GceTpuProvider", "TerraformDriver", "PROVIDERS",
+__all__ = ["CloudProvider", "GceTpuProvider", "VsphereProvider",
+           "OpenstackProvider", "TerraformDriver", "PROVIDERS",
            "allocate_ip", "recover_ip"]

@@ -76,24 +76,44 @@ class MessageCenter:
         return self._setting("notify_min_level", "INFO").upper()
 
     # -- dispatch ----------------------------------------------------------
+    def _channel_payload(self, channel: str, message: Message) -> dict:
+        """Native payload shapes per channel (reference ko_notification_utils
+        formats DingTalk and WorkWeixin messages distinctly)."""
+        text = f"[{message.level}] {message.title}"
+        if channel == "DINGTALK":
+            detail = "\n".join(f"- {k}: {v}" for k, v in message.content.items())
+            return {"msgtype": "markdown",
+                    "markdown": {"title": text,
+                                 "text": f"### {text}\n{detail}"}}
+        if channel == "WORKWEIXIN":
+            return {"msgtype": "markdown",
+                    "markdown": {"content": f"**{text}**\n"
+                                 + "\n".join(f"> {k}: {v}"
+                                             for k, v in message.content.items())}}
+        return {"msgtype": "text", "text": {"content": text},
+                "detail": message.content}
+
+    WEBHOOK_CHANNELS = {"WEBHOOK": "webhook_url",
+                        "DINGTALK": "dingtalk_webhook_url",
+                        "WORKWEIXIN": "workweixin_webhook_url"}
+
     def dispatch(self, message: Message) -> dict[str, list[str]]:
         """Fan out one stored message. Returns {channel: [recipients]} for
         observability/tests. LOCAL needs no work: the Message row IS the
         in-app notification."""
-        sent: dict[str, list[str]] = {"LOCAL": [], "EMAIL": [], "WEBHOOK": []}
+        sent: dict[str, list[str]] = {"LOCAL": [], "EMAIL": [], "WEBHOOK": [],
+                                      "DINGTALK": [], "WORKWEIXIN": []}
         if LEVEL_RANK.get(message.level, 0) < LEVEL_RANK.get(self.min_level(), 0):
             return sent
         smtp = self.smtp_config()
-        webhook_url = self._setting("webhook_url")
         body = json.dumps({"title": message.title, "level": message.level,
                            "project": message.project, **message.content})
-        webhook_subscribed = False
+        hook_subscribed: set[str] = set()
         for user in self.platform.store.find(User, scoped=False):
             channels = self.user_channels(user)
             if "LOCAL" in channels:
                 sent["LOCAL"].append(user.name)
-            if "WEBHOOK" in channels:
-                webhook_subscribed = True
+            hook_subscribed.update(c for c in channels if c in self.WEBHOOK_CHANNELS)
             if "EMAIL" in channels and smtp and user.email:
                 try:
                     self.email_sender(smtp, user.email,
@@ -101,15 +121,15 @@ class MessageCenter:
                     sent["EMAIL"].append(user.email)
                 except Exception as e:  # noqa: BLE001 — channel boundary
                     log.warning("email to %s failed: %s", user.email, e)
-        if webhook_url and webhook_subscribed:
+        for channel in sorted(hook_subscribed):
+            url = self._setting(self.WEBHOOK_CHANNELS[channel])
+            if not url:
+                continue
             try:
-                self.webhook_sender(webhook_url, {
-                    "msgtype": "text",
-                    "text": {"content": f"[{message.level}] {message.title}"},
-                    "detail": message.content})
-                sent["WEBHOOK"].append(webhook_url)
+                self.webhook_sender(url, self._channel_payload(channel, message))
+                sent[channel].append(url)
             except Exception as e:  # noqa: BLE001
-                log.warning("webhook failed: %s", e)
+                log.warning("%s webhook failed: %s", channel, e)
         return sent
 
     def mark_read(self, message_id: str, username: str) -> None:

@@ -16,7 +16,6 @@ for the trainer to add (lm.py picks it up when moe is enabled).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import flax.linen as nn

@@ -61,3 +61,35 @@ def test_host_grading():
     assert cat.grade_host("SINGLE", "master", 2, 4) == "minimal"
     assert cat.grade_host("SINGLE", "worker", 1, 2) == "unfit"
     assert cat.grade_host("SINGLE", "worker", 8, 32, disk_gb=10) == "unfit"
+
+
+def test_manifests_match_monitor_routing_contract():
+    """The monitor reaches Prometheus/Loki via master:30910 with Host
+    headers (PromClient/LokiClient); the bundled manifests must deploy
+    exactly that route."""
+    import yaml
+    from kubeoperator_tpu.apps import manifests
+    from kubeoperator_tpu.services.monitor import LokiClient, PromClient
+
+    ingress = manifests.render_app("ingress-nginx", "r:5000")
+    svc = next(d for d in yaml.safe_load_all(ingress) if d["kind"] == "Service")
+    node_port = svc["spec"]["ports"][0]["nodePort"]
+    assert f":{node_port}" in PromClient("1.2.3.4").base
+    assert f":{node_port}" in LokiClient("1.2.3.4").base
+
+    for app, client_cls in (("prometheus", PromClient), ("loki", LokiClient)):
+        text = manifests.render_app(app, "r:5000")
+        ing = next(d for d in yaml.safe_load_all(text) if d["kind"] == "Ingress")
+        host = ing["spec"]["rules"][0]["host"]
+        assert client_cls("1.2.3.4").headers["Host"] == host
+
+
+def test_all_manifests_are_valid_yaml():
+    import yaml
+    from kubeoperator_tpu.apps import manifests
+
+    for name in manifests.list_apps():
+        text = manifests.render_app(name, "reg.local:8082",
+                                    {"slice_hosts": 2, "slice_id": "s1"})
+        docs = list(yaml.safe_load_all(text))
+        assert docs and all(isinstance(d, dict) and d.get("kind") for d in docs), name

@@ -1,0 +1,111 @@
+"""vSphere/OpenStack providers (reference clouds): terraform-JSON shape,
+static-IP plumbing, flavor/model sizing, and TPU-pool rejection on
+non-GCE providers."""
+
+import json
+import os
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    DeployType, ExecutionState, Host, Plan, Region, Zone,
+)
+
+
+def make_plan(platform, provider, region_vars, zone_vars, pools=None):
+    region = Region(name=f"{provider}-dc", provider=provider, vars=region_vars)
+    platform.store.save(region)
+    zone = Zone(name=f"{provider}-az1", region_id=region.id, vars=zone_vars,
+                ip_pool=[f"10.4.0.{i}" for i in range(10, 40)])
+    platform.store.save(zone)
+    plan = Plan(name=f"{provider}-plan", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=2, tpu_pools=pools or [])
+    platform.store.save(plan)
+    return plan
+
+
+def install_auto(platform, name, plan):
+    platform.create_cluster(name, template="SINGLE",
+                            deploy_type=DeployType.AUTOMATIC, plan_id=plan.id,
+                            configs={"registry": "reg.local:8082"})
+    return platform.run_operation(name, "install")
+
+
+def read_tf(platform, name):
+    with open(os.path.join(platform.config.terraform, name, "main.tf.json")) as f:
+        return json.load(f)
+
+
+def test_vsphere_provisions_cloned_vms(platform, fake_executor):
+    plan = make_plan(platform, "vsphere",
+                     {"vcenter": "vc.corp", "username": "ops", "password": "pw",
+                      "datacenter": "DC1", "template": "ubuntu-tpl"},
+                     {"cluster": "Cluster1", "network": "VM Network",
+                      "datastore": "ds1", "gateway": "10.4.0.1",
+                      "netmask_prefix": 24})
+    ex = install_auto(platform, "vsp", plan)
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    hosts = platform.store.find(Host, scoped=False, project="vsp")
+    assert len(hosts) == 3                      # 1 master + 2 workers
+    tf = read_tf(platform, "vsp")
+    vms = tf["resource"]["vsphere_virtual_machine"]
+    assert len(vms) == 3
+    vm = vms["vsp-master-1"]
+    assert vm["clone"]["customize"]["network_interface"]["ipv4_address"].startswith("10.4.0.")
+    assert vm["clone"]["customize"]["ipv4_gateway"] == "10.4.0.1"
+    assert tf["provider"]["vsphere"]["vsphere_server"] == "vc.corp"
+    # per-zone data sources exist
+    assert "vsphere_compute_cluster" in tf["data"]
+
+
+def test_vsphere_rejects_tpu_pools(platform, fake_executor):
+    plan = make_plan(platform, "vsphere", {"vcenter": "vc"}, {},
+                     pools=[{"slice_type": "v5e-8", "count": 1}])
+    ex = install_auto(platform, "vsp2", plan)
+    assert ex.state == ExecutionState.FAILURE
+    assert "cannot provision TPU pools" in str(ex.result)
+
+
+def test_openstack_ports_and_instances(platform, fake_executor):
+    plan = make_plan(platform, "openstack",
+                     {"auth_url": "https://keystone:5000/v3", "username": "ops",
+                      "password": "pw", "project": "infra", "image": "jammy"},
+                     {"network_id": "net-1", "subnet_id": "sub-1",
+                      "availability_zone": "az1",
+                      "floating_network_id": "public"})
+    ex = install_auto(platform, "osp", plan)
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    tf = read_tf(platform, "osp")
+    ports = tf["resource"]["openstack_networking_port_v2"]
+    instances = tf["resource"]["openstack_compute_instance_v2"]
+    assert len(ports) == 3 and len(instances) == 3
+    port = ports["osp-worker-1"]
+    assert port["fixed_ip"]["ip_address"].startswith("10.4.0.")
+    inst = instances["osp-worker-1"]
+    assert inst["network"]["port"].startswith("${openstack_networking_port_v2.")
+    # floating IPs requested for the public network
+    assert len(tf["resource"]["openstack_networking_floatingip_v2"]) == 3
+    assert tf["provider"]["openstack"]["auth_url"].startswith("https://keystone")
+
+
+def test_openstack_without_floating_ips(platform, fake_executor):
+    plan = make_plan(platform, "openstack", {"auth_url": "x"},
+                     {"network_id": "net-1", "subnet_id": "sub-1"})
+    ex = install_auto(platform, "osp2", plan)
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    tf = read_tf(platform, "osp2")
+    assert "openstack_networking_floatingip_v2" not in tf["resource"]
+
+
+def test_uninstall_recovers_provider_hosts(platform, fake_executor):
+    plan = make_plan(platform, "vsphere", {"vcenter": "vc"}, {})
+    ex = install_auto(platform, "vsp3", plan)
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    zone = platform.store.find(Zone, scoped=False)
+    used_before = sum(len(z.ip_used) for z in zone)
+    assert used_before == 3
+    ex = platform.run_operation("vsp3", "uninstall")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    zones = platform.store.find(Zone, scoped=False)
+    assert sum(len(z.ip_used) for z in zones) == 0
+    assert platform.store.find(Host, scoped=False, project="vsp3") == []

@@ -46,7 +46,8 @@ def test_message_min_level_filter(platform):
     put_setting(platform, "notify_min_level", "ERROR")
     mc = MessageCenter(platform)
     info = platform.notify("routine", level="INFO")
-    assert mc.dispatch(info) == {"LOCAL": [], "EMAIL": [], "WEBHOOK": []}
+    assert mc.dispatch(info) == {"LOCAL": [], "EMAIL": [], "WEBHOOK": [],
+                                 "DINGTALK": [], "WORKWEIXIN": []}
 
 
 def test_mark_read(platform):
@@ -256,3 +257,22 @@ def test_disabled_ldap_user_cannot_authenticate(platform):
     server.start()
     auth = _ldap_platform(platform, server.port)
     assert auth.authenticate("gone", "letmein") is None
+
+
+def test_dingtalk_and_workweixin_channels(platform):
+    platform.create_user("ops", "pw", is_admin=True)
+    put_setting(platform, "notify.ops", "DINGTALK,WORKWEIXIN")
+    put_setting(platform, "dingtalk_webhook_url", "http://ding.local/hook")
+    put_setting(platform, "workweixin_webhook_url", "http://wecom.local/hook")
+    calls = []
+    mc = MessageCenter(platform,
+                       webhook_sender=lambda url, payload: calls.append((url, payload)))
+    msg = platform.notify("cluster demo degraded", level="WARNING",
+                          content={"cluster": "demo"})
+    sent = mc.dispatch(msg)
+    assert sent["DINGTALK"] == ["http://ding.local/hook"]
+    assert sent["WORKWEIXIN"] == ["http://wecom.local/hook"]
+    by_url = dict(calls)
+    assert by_url["http://ding.local/hook"]["msgtype"] == "markdown"
+    assert "cluster demo degraded" in by_url["http://ding.local/hook"]["markdown"]["title"]
+    assert "demo" in by_url["http://wecom.local/hook"]["markdown"]["content"]
