@@ -10,8 +10,8 @@
 const $ = (s, el = document) => el.querySelector(s);
 const state = { token: sessionStorage.getItem("token") || "", user: null,
                 ws: null, term: null };
-const PAGES = ["dashboard", "clusters", "hosts", "packages", "storage",
-               "items", "users", "settings", "logs", "messages"];
+const PAGES = ["dashboard", "clusters", "planning", "hosts", "packages",
+               "storage", "items", "users", "settings", "logs", "messages"];
 
 async function api(path, opts = {}) {
   const r = await fetch("/api/v1" + path, {...opts, headers: {
@@ -49,7 +49,8 @@ function render() {
         onclick="nav('${p}')">${p}</a>`).join("") +
     `<a onclick="logout()">logout</a>`;
   const table = {dashboard: renderDashboard, clusters: renderClusters,
-                 cluster: renderCluster, hosts: renderHosts,
+                 cluster: renderCluster, planning: renderPlanning,
+                 hosts: renderHosts,
                  packages: renderPackages, storage: renderStorage,
                  items: renderItems, users: renderUsers,
                  settings: renderSettings, logs: renderLogs,
@@ -116,9 +117,10 @@ async function renderDashboard() {
 /* ---------------- clusters + wizard ---------------- */
 
 async function renderClusters() {
-  const [cs, pkgs, backends, items] = await Promise.all([
+  const [cs, pkgs, backends, items, plans] = await Promise.all([
     api("/clusters"), api("/packages").catch(() => []),
-    api("/storage-backends").catch(() => []), api("/items").catch(() => [])]);
+    api("/storage-backends").catch(() => []), api("/items").catch(() => []),
+    api("/plans").catch(() => [])]);
   $("#view").innerHTML = `<div class="card"><h3>Clusters</h3>
     <table><tr><th>name</th><th>status</th><th>template</th><th>network</th><th>mode</th><th></th></tr>
     ${cs.map(c => `<tr><td><a data-go="cluster/${esc(c.name)}">${esc(c.name)}</a></td>
@@ -139,6 +141,8 @@ async function renderClusters() {
           ${pkgs.map(p => `<option>${esc(p.name)}</option>`).join("")}</select>
         <select id="citem"><option value="">no item (workspace)</option>
           ${items.map(i => `<option>${esc(i.name)}</option>`).join("")}</select>
+        <select id="cplan"><option value="">no plan (MANUAL hosts)</option>
+          ${plans.map(p => `<option value="${esc(p.id)}">${esc(p.name)}</option>`).join("")}</select>
         <button onclick="createCluster()">Create</button></div>
     </div><div id="cerr" style="color:var(--err)"></div></div>`;
 }
@@ -147,7 +151,7 @@ async function createCluster() {
     const body = {name: $("#cname").value, template: $("#ctpl").value,
       network_plugin: $("#cnet").value, storage_provider: $("#cstore").value,
       deploy_type: $("#cmode").value, package: $("#cpkg").value,
-      item: $("#citem").value};
+      item: $("#citem").value, plan_id: $("#cplan").value};
     if ($("#cbackend").value)
       body.storage_config = {backend: $("#cbackend").value};
     await api("/clusters", {method: "POST", body: JSON.stringify(body)});
@@ -355,6 +359,92 @@ function watch(exId) {
   lws.onmessage = ev => { const el = $("#plog"); el.textContent += ev.data;
                           el.scrollTop = el.scrollHeight; };
   state.ws = [pws, lws];
+}
+
+
+/* ---------------- Day-0 planning: regions / zones / plans ---------------- */
+
+async function renderPlanning() {
+  const [regions, zones, plans] = await Promise.all([
+    api("/regions"), api("/zones"), api("/plans")]);
+  const regionName = id => (regions.find(r => r.id === id) || {}).name || "?";
+  $("#view").innerHTML = `<div class="row">
+    <div class="card"><h3>Regions</h3>
+      <table><tr><th>name</th><th>provider</th></tr>
+      ${regions.map(r => `<tr><td>${esc(r.name)}</td><td>${esc(r.provider)}</td></tr>`).join("")}
+      </table>
+      <input id="rgname" placeholder="name">
+      <select id="rgprov"><option>gce</option><option>vsphere</option><option>openstack</option></select>
+      <input id="rgvars" placeholder='vars JSON, e.g. {"project":"my-proj"}'>
+      <button onclick="addRegion()">Add region</button></div>
+    <div class="card"><h3>Zones</h3>
+      <table><tr><th>name</th><th>region</th><th>IPs free/total</th></tr>
+      ${zones.map(z => `<tr><td>${esc(z.name)}</td><td class="dim">${esc(regionName(z.region_id))}</td>
+        <td>${(z.ip_pool || []).length - (z.ip_used || []).length}/${(z.ip_pool || []).length}</td></tr>`).join("")}
+      </table>
+      <input id="zname" placeholder="name">
+      <select id="zregion">${regions.map(r => `<option value="${esc(r.id)}">${esc(r.name)}</option>`).join("")}</select>
+      <input id="zcidr" placeholder="IP range, e.g. 10.1.0.10-10.1.0.40">
+      <input id="zvars" placeholder='vars JSON, e.g. {"gce_zone":"us-central2-b"}'>
+      <button onclick="addZone()">Add zone</button></div>
+    </div>
+    <div class="card"><h3>Plans</h3>
+      <table><tr><th>name</th><th>region</th><th>template</th><th>workers</th><th>TPU pools</th></tr>
+      ${plans.map(p => `<tr><td>${esc(p.name)}</td><td class="dim">${esc(regionName(p.region_id))}</td>
+        <td>${esc(p.template)}</td><td>${p.worker_size}</td>
+        <td>${esc((p.tpu_pools || []).map(t => `${t.count}×${t.slice_type}`).join(", ") || "–")}</td></tr>`).join("")}
+      </table>
+      <div class="row"><div>
+        <input id="pname" placeholder="name">
+        <select id="pregion">${regions.map(r => `<option value="${esc(r.id)}">${esc(r.name)}</option>`).join("")}</select>
+        <select id="ptpl"><option>SINGLE</option><option>MULTIPLE</option></select>
+        <input id="pworkers" placeholder="worker count" value="1"></div>
+      <div>
+        <select id="pslice"><option value="">no TPU pool</option>
+          <option>v4-8</option><option>v5e-8</option><option>v5e-16</option><option>v5p-64</option></select>
+        <input id="pslices" placeholder="slice count" value="1">
+        <button onclick="addPlan()">Create plan</button></div></div>
+      <div id="perr" style="color:var(--err)"></div></div>`;
+}
+async function addRegion() {
+  try {
+    await api("/regions", {method: "POST", body: JSON.stringify({
+      name: $("#rgname").value, provider: $("#rgprov").value,
+      vars: JSON.parse($("#rgvars").value || "{}")})});
+    renderPlanning();
+  } catch (e) { alert(e.message); }
+}
+function expandIpRange(range) {
+  const m = range.match(/^(\d+\.\d+\.\d+\.)(\d+)\s*-\s*(?:\d+\.\d+\.\d+\.)?(\d+)$/);
+  if (!m) return [];
+  const out = [];
+  for (let i = +m[2]; i <= +m[3]; i++) out.push(m[1] + i);
+  return out;
+}
+async function addZone() {
+  try {
+    const pool = expandIpRange($("#zcidr").value);
+    if (!pool.length) throw new Error("IP range must look like 10.1.0.10-10.1.0.40");
+    await api("/zones", {method: "POST", body: JSON.stringify({
+      name: $("#zname").value, region_id: $("#zregion").value,
+      ip_pool: pool, vars: JSON.parse($("#zvars").value || "{}")})});
+    renderPlanning();
+  } catch (e) { alert(e.message); }
+}
+async function addPlan() {
+  try {
+    const regionId = $("#pregion").value;
+    const zones = await api("/zones");
+    const zoneIds = zones.filter(z => z.region_id === regionId).map(z => z.id);
+    if (!zoneIds.length) throw new Error("region has no zones yet");
+    const pools = $("#pslice").value ?
+      [{slice_type: $("#pslice").value, count: +$("#pslices").value || 1}] : [];
+    await api("/plans", {method: "POST", body: JSON.stringify({
+      name: $("#pname").value, region_id: regionId, zone_ids: zoneIds,
+      template: $("#ptpl").value, worker_size: +$("#pworkers").value || 1,
+      tpu_pools: pools})});
+    renderPlanning();
+  } catch (e) { $("#perr").textContent = e.message; }
 }
 
 /* ---------------- hosts + credentials ---------------- */
