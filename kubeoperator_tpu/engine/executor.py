@@ -367,7 +367,10 @@ class FakeExecutor(Executor):
         if "hostname" in command and "-I" not in command:
             return ExecResult(0, facts.get("hostname", "fake-host"))
         if command.strip().startswith("date"):
-            return ExecResult(0, "2026-07-29T00:00:00+00:00")
+            # a healthy fake host's clock matches the controller's (the
+            # monitor derives NTP drift from this probe)
+            from datetime import datetime, timezone
+            return ExecResult(0, datetime.now(timezone.utc).isoformat())
         return ExecResult(0)
 
     # -- assertions for tests ---------------------------------------------

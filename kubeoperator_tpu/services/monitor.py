@@ -293,10 +293,15 @@ class ClusterMonitor:
         return entries
 
     # -- health (reference models/health/*, 5-min beat) --------------------
+    MAX_CLOCK_DRIFT_S = 30.0      # reference syncs NTP when nodes drift
+                                  # (cluster_monitor.py:600 get_host_time)
+
     def host_health(self) -> list[HealthRecord]:
-        """SSH ping every cluster host (reference ``host_health.py:9-43``),
+        """SSH every cluster host (reference ``host_health.py:9-43``),
         batched through Executor.run_many — one C++ fan-out instead of a
-        serial ssh per host."""
+        serial ssh per host. The probe command is ``date -Is`` so the same
+        round trip yields liveness AND clock drift (reference runs a
+        separate get_host_time pass, ``adhoc.py:78-91``)."""
         from kubeoperator_tpu.engine.executor import Conn
 
         hour = iso_now()[:13]
@@ -312,19 +317,36 @@ class ClusterMonitor:
                 targets.append((host, Conn.from_host(host, cred)))
             except Exception as e:  # noqa: BLE001 — bad credential = that host unhealthy
                 conn_errors[host.name] = str(e)[:200]
+        from datetime import datetime, timezone
+
+        t0 = datetime.now(timezone.utc)
         try:
             results = self.platform.executor.run_many(
-                [(conn, "true") for _, conn in targets], timeout=10)
+                [(conn, "date -Is") for _, conn in targets], timeout=10)
         except Exception as e:  # noqa: BLE001 — transport down = all unhealthy
             results = None
             err = str(e)[:200]
+        t1 = datetime.now(timezone.utc)
         by_name = {}
         for i, (host, _) in enumerate(targets):
             if results is None:
                 by_name[host.name] = (False, {"error": err})
+            elif not results[i].ok:
+                by_name[host.name] = (False, {"error": results[i].stderr[:200]})
             else:
-                by_name[host.name] = (results[i].ok, {} if results[i].ok
-                                      else {"error": results[i].stderr[:200]})
+                # the probe ran somewhere inside [t0, t1] (slow peers in the
+                # fan-out delay the return): true drift lies in
+                # [remote - t1, remote - t0]; only flag when the WHOLE
+                # interval is outside the limit, so fan-out wall time can't
+                # read as clock skew
+                drift = _clock_drift_interval(results[i].stdout.strip(), t0, t1)
+                if drift is not None and (
+                        drift[0] > self.MAX_CLOCK_DRIFT_S
+                        or drift[1] < -self.MAX_CLOCK_DRIFT_S):
+                    worst = drift[0] if drift[0] > 0 else drift[1]
+                    by_name[host.name] = (False, {"clock_drift_s": round(worst, 1)})
+                else:
+                    by_name[host.name] = (True, {})
         records = []
         host_ok: dict[str, bool] = {}
         for host in hosts:
@@ -389,6 +411,21 @@ class ClusterMonitor:
         rec.detail = detail
         store.save(rec)
         return rec
+
+
+def _clock_drift_interval(remote_iso: str, t0, t1) -> tuple[float, float] | None:
+    """(min, max) seconds the remote clock may be ahead of the controller,
+    given the probe executed somewhere in [t0, t1]; None when the output
+    isn't a timestamp (e.g. a fake executor's empty reply)."""
+    from datetime import datetime, timezone
+
+    try:
+        remote = datetime.fromisoformat(remote_iso)
+    except ValueError:
+        return None
+    if remote.tzinfo is None:
+        remote = remote.replace(tzinfo=timezone.utc)
+    return ((remote - t1).total_seconds(), (remote - t0).total_seconds())
 
 
 def _node_ready(node: dict) -> bool:

@@ -190,9 +190,25 @@ def cmd_resnet50(args: argparse.Namespace) -> int:
             state = ckpt.restore(_abstract_like(state, tr.state_shardings))
             emit({"job": "resnet50", "resumed_at": int(state.step), **dist})
 
-    images, labels = tr.synthetic_batch()
+    from kubeoperator_tpu.workloads import data as data_pipe
+
+    remaining = args.steps - int(state.step)
+    # each process loads its shard of the global batch; device_put_batch
+    # assembles the global array from process-local data on multi-host
+    local_batch = cfg.batch_size // jax.process_count()
+    if args.data_dir:
+        source = data_pipe.NpyDataset(args.data_dir).batches(
+            local_batch, seed=0, shard_id=dist["process_id"],
+            num_shards=dist["num_processes"])
+    else:
+        source = data_pipe.synthetic_image_batches(
+            local_batch, cfg.image_size, cfg.num_classes,
+            seed=dist["process_id"], steps=remaining)
+    stream = data_pipe.prefetch_to_device(source, tr.batch_shd)
     t0, t0_step = time.perf_counter(), int(state.step)
-    while int(state.step) < args.steps:
+    for images, labels in stream:
+        if int(state.step) >= args.steps:
+            break
         state, metrics = tr.train_step(state, images, labels)
         step = int(state.step)
         if ckpt and args.ckpt_every and step % args.ckpt_every == 0:
@@ -286,6 +302,9 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--ckpt-dir", type=str, default=None)
     rn.add_argument("--ckpt-every", type=int, default=50)
     rn.add_argument("--ckpt-keep", type=int, default=3)
+    rn.add_argument("--data-dir", type=str, default=None,
+                    help="npy dataset dir (images.npy+labels.npy); "
+                         "default: synthetic stream")
 
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
     lm.add_argument("--steps", type=int, default=100)

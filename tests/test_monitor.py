@@ -142,7 +142,7 @@ def test_slice_health_degrades_with_member(platform, installed, fake_executor):
     assert recs and recs[0].target == "tpu-a"
     assert recs[0].healthy is True
 
-    fake_executor.fail_on("10.0.0.3", "true")            # TPU host dies
+    fake_executor.fail_on("10.0.0.3", "date")            # TPU host dies
     mon.health_tick(platform, transport=t)
     recs = platform.store.find(HealthRecord, scoped=False, project="demo",
                                kind="slice")
@@ -185,3 +185,20 @@ def test_dashboard_item_scoped(platform, installed):
     assert scoped["cluster_count"] == 1
     all_data = mon.dashboard_data(platform)
     assert all_data["cluster_count"] == 2
+
+
+def test_host_health_detects_clock_drift(platform, installed, fake_executor):
+    """Same SSH round yields liveness + NTP drift (reference get_host_time,
+    adhoc.py:78-91): a host 5 min ahead goes unhealthy with the drift in
+    the detail."""
+    from datetime import datetime, timedelta, timezone
+
+    ahead = (datetime.now(timezone.utc) + timedelta(minutes=5)).isoformat()
+    fake_executor.host("10.0.0.2").respond(r"^date -Is$", ahead + "\n")
+    mon.health_tick(platform, transport=FakeTransport())
+    recs = {r.target: r for r in platform.store.find(
+        HealthRecord, scoped=False, project="demo", kind="host")}
+    assert recs["demo-worker-1"].healthy is False
+    assert recs["demo-worker-1"].detail["clock_drift_s"] > 250
+    # hosts whose probe returns no timestamp (fake default) stay healthy
+    assert recs["demo-master-1"].healthy is True
