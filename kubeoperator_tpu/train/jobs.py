@@ -203,7 +203,7 @@ def cmd_resnet50(args: argparse.Namespace) -> int:
     else:
         source = data_pipe.synthetic_image_batches(
             local_batch, cfg.image_size, cfg.num_classes,
-            seed=dist["process_id"], steps=remaining)
+            seed=dist["process_id"], steps=remaining, start=int(state.step))
     stream = data_pipe.prefetch_to_device(source, tr.batch_shd)
     t0, t0_step = time.perf_counter(), int(state.step)
     for images, labels in stream:
@@ -246,6 +246,7 @@ def cmd_llm(args: argparse.Namespace) -> int:
                             d_ff=args.d_ff or int(args.d_model * 8 / 3 / 32) * 32,
                             max_seq_len=args.seq_len,
                             moe_experts=args.experts,
+                            sp_attention=args.sp_attention,
                             dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     lt = LMTrainer(cfg, spec, devices=devices)
     state = lt.init_state()
@@ -317,6 +318,10 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--d-ff", type=int, default=None)
     lm.add_argument("--experts", type=int, default=0,
                     help=">0 enables MoE FFNs (shard experts with --mesh ep:N)")
+    lm.add_argument("--sp-attention", choices=("ring", "ulysses"),
+                    default="ring",
+                    help="sequence-parallel attention: ring (ppermute K/V) "
+                         "or ulysses (all-to-all seq<->heads)")
     lm.add_argument("--bf16", action="store_true", default=True)
     lm.add_argument("--no-bf16", dest="bf16", action="store_false")
     lm.add_argument("--mesh", type=str, default=None,

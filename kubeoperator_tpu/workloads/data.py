@@ -26,12 +26,14 @@ import numpy as np
 
 def synthetic_image_batches(batch: int, image_size: int, num_classes: int,
                             seed: int = 0, dtype: Any = np.float32,
-                            steps: int | None = None) -> Iterator[tuple]:
-    """Deterministic fake ImageNet-shaped stream (one RNG stream per epoch
-    position, so step N's batch is reproducible across restarts)."""
-    rng = np.random.default_rng(seed)
-    i = 0
-    while steps is None or i < steps:
+                            steps: int | None = None,
+                            start: int = 0) -> Iterator[tuple]:
+    """Deterministic fake ImageNet-shaped stream. Step N's batch is keyed
+    by ``(seed, N)``, so a checkpoint-resumed run passing ``start=N``
+    continues the stream instead of replaying it from the beginning."""
+    i = start
+    while steps is None or i < start + steps:
+        rng = np.random.default_rng((seed, i))
         images = rng.standard_normal((batch, image_size, image_size, 3),
                                      dtype=np.float32).astype(dtype)
         labels = rng.integers(0, num_classes, (batch,), dtype=np.int32)
@@ -72,7 +74,10 @@ class NpyDataset:
         per-epoch permutation and take disjoint strided slices of it, so
         the global batch has no duplicated examples."""
         n = len(self)
-        shard_len = len(range(shard_id, n, num_shards))
+        # every shard uses the same truncated length: uneven shards would
+        # desync multi-process epochs (one process exhausting first hangs
+        # the SPMD collectives; infinite epochs would drift and duplicate)
+        shard_len = n // num_shards
         if batch > shard_len:
             raise ValueError(
                 f"batch {batch} exceeds shard size {shard_len} "
@@ -81,7 +86,7 @@ class NpyDataset:
         epoch = 0
         while epochs is None or epoch < epochs:
             order = np.random.default_rng(seed + epoch).permutation(n)
-            shard = order[shard_id::num_shards]
+            shard = order[shard_id::num_shards][:shard_len]
             for start in range(0, shard_len - batch + 1, batch):
                 idx = np.sort(shard[start:start + batch])
                 yield (np.asarray(self.images[idx]),

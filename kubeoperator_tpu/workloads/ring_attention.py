@@ -106,6 +106,51 @@ def sharded_ring_attention(mesh: Mesh, q, k, v, causal: bool = True):
     return fn(q, k, v)
 
 
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: instead of rotating
+    K/V around a ring, two ``all_to_all``s re-partition [seq-sharded, all
+    heads] → [full seq, head-sharded], run ordinary local attention per
+    head group, and re-partition back.
+
+    Trade-off vs the ring: 2 all-to-alls of the full activations instead
+    of sp ppermute hops of K/V — fewer, larger collectives (better when sp
+    is small and heads ≥ sp), but heads must divide by sp. Per-device
+    shards inside shard_map: q/k/v [B, T/sp, H, D] → out [B, T/sp, H, D].
+    """
+    sp = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if h % sp:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by sp ({sp})")
+
+    def seq_to_heads(x):
+        # [B, T/sp, H, D] → [B, T, H/sp, D]: tiled all-to-all splits the
+        # head dim into sp chunks and concatenates the received sequence
+        # chunks in device order (= global order; the sequence is sharded
+        # contiguously)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # [B, T, H/sp, D] → [B, T/sp, H, D]: the inverse regroup
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = reference_attention(seq_to_heads(q), seq_to_heads(k),
+                              seq_to_heads(v), causal=causal)
+    return heads_to_seq(out)
+
+
+def sharded_ulysses_attention(mesh: Mesh, q, k, v, causal: bool = True):
+    """shard_map wrapper mirroring sharded_ring_attention."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    sp = "sp" if "sp" in mesh.axis_names else None
+    spec = P(data_axes, sp, "tp" if "tp" in mesh.axis_names else None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=sp, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
 def reference_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
     """Unsharded O(S²)-memory attention, for tests and single-chip paths."""
     scale = 1.0 / (q.shape[-1] ** 0.5)

@@ -75,13 +75,18 @@ class MeshSpec:
 
 
 def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
-    """Build a Mesh with axes ordered outer→inner as (dp, fsdp, tp, sp).
+    """Build a Mesh with axes ordered outer→inner as (dp, fsdp, ep, tp, sp).
 
     ``create_device_mesh`` lays contiguous inner axes onto the ICI torus, so
     tp/sp (highest traffic) get nearest-neighbour links while dp (lowest
     traffic, gradient all-reduce once per step) spans DCN on multi-slice
     topologies. Size-1 axes are kept out of the mesh entirely — GSPMD then
     never materialises collectives for them.
+
+    Multi-slice pods (devices spanning >1 ``slice_index``): the hybrid mesh
+    puts ONLY the outermost data axis on DCN — model-parallel collectives
+    must never cross the inter-slice network — and requires dp (or fsdp
+    when dp==1) to be a multiple of the slice count.
     """
     devices = list(devices if devices is not None else jax.devices())
     if spec.n_devices != len(devices):
@@ -90,9 +95,29 @@ def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
     shape = [s for _, s in spec.sizes() if s > 1]
     if not names:                       # single device
         names, shape = ["dp"], [1]
+    slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    n_slices = len(slices)
+    if n_slices > 1:
+        # config errors raise OUTSIDE the try: the reshape fallback below
+        # must never paper over a layout that puts model axes on DCN
+        if names[0] not in ("dp", "fsdp"):
+            raise ValueError(
+                f"multi-slice mesh: outermost axis is {names[0]!r} but only a "
+                "data axis (dp/fsdp) may span slices — model-parallel "
+                "collectives must stay on ICI")
+        if shape[0] % n_slices:
+            raise ValueError(
+                f"multi-slice mesh: outermost axis {names[0]}={shape[0]} "
+                f"must be a multiple of the slice count {n_slices}")
     try:
         from jax.experimental import mesh_utils
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        if n_slices > 1:
+            dcn_shape = [n_slices] + [1] * (len(shape) - 1)
+            ici_shape = [shape[0] // n_slices] + shape[1:]
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        else:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:                   # virtual/CPU devices with no topology info
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names=tuple(names))

@@ -39,7 +39,9 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    ring: bool = False          # use ring attention (sequence sharded on 'sp')
+    ring: bool = False          # sequence sharded on 'sp' (ring/ulysses)
+    sp_attention: str = "ring"  # ring (ppermute K/V hops) | ulysses
+                                # (two all-to-alls; needs heads % sp == 0)
     moe_experts: int = 0        # >0: every block's FFN is a routed MoE
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -96,8 +98,12 @@ class Attention(nn.Module):
         q, k = rope(q, positions), rope(k, positions)
         if cfg.ring and self.mesh is not None and "sp" in self.mesh.axis_names:
             # GSPMD outside, manual collectives inside: shard_map hands each
-            # device its [B, T/sp, H/tp, D] block; K/V ride the ring.
-            out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
+            # device its [B, T/sp, H/tp, D] block; K/V ride the ring, or two
+            # all-to-alls regroup seq<->heads (Ulysses).
+            if cfg.sp_attention == "ulysses":
+                out = ra.sharded_ulysses_attention(self.mesh, q, k, v, causal=True)
+            else:
+                out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
         elif (cfg.attention == "flash" and q.shape[1] % 128 == 0) or (
                 cfg.attention == "auto"
                 and jax.default_backend() in ("tpu", "axon")
