@@ -30,7 +30,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from kubeoperator_tpu.api.app import ensure_admin, run_server
-    from kubeoperator_tpu.services import backups, ldap_auth, monitor
+    from kubeoperator_tpu.services import backups, healing, ldap_auth, monitor
     from kubeoperator_tpu.services.platform import Platform
 
     platform = Platform()
@@ -39,6 +39,7 @@ def main(argv: list[str] | None = None) -> int:
         monitor.schedule(platform)
         backups.schedule(platform)
         ldap_auth.schedule(platform)
+        healing.schedule(platform)
     try:
         run_server(platform, host=args.host, port=args.port)
     finally:

@@ -328,6 +328,21 @@ async def create_execution(request: web.Request) -> web.Response:
     await _sync(request, platform.start_execution, execution)
     return web.json_response(dump(execution), status=201)
 
+async def retry_execution(request: web.Request) -> web.Response:
+    """Resume a failed execution from its failed step (steps before it are
+    skipped — operation-level resume the reference lacks)."""
+    platform: Platform = request.app["platform"]
+    ex = await _sync(request, platform.store.get, DeployExecution,
+                     request.match_info["id"], scoped=False)
+    if ex is None:
+        return json_error(404, "execution not found")
+    check_cluster_access(request, ex.project, write=True)
+    try:
+        new_ex = await _sync(request, platform.retry_execution, ex.id)
+    except PlatformError as e:
+        return json_error(400, str(e))
+    return web.json_response(dump(new_ex), status=201)
+
 async def get_execution(request: web.Request) -> web.Response:
     platform: Platform = request.app["platform"]
     ex = await _sync(request, platform.store.get, DeployExecution,
@@ -743,6 +758,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/clusters/{name}/backups", list_backups)
     r.add_get("/api/v1/clusters/{name}/errorlogs", cluster_error_logs)
     r.add_get("/api/v1/executions/{id}", get_execution)
+    r.add_post("/api/v1/executions/{id}/retry", retry_execution)
     r.add_get("/api/v1/dashboard/{item}", dashboard)
     r.add_get("/api/v1/logs", search_system_logs)
     r.add_get("/api/v1/events", search_cluster_events)

@@ -74,8 +74,26 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
     cluster.status = RUNNING_STATUS.get(execution.operation, ClusterStatus.RUNNING)
     store.save(cluster)
 
+    # operation-level resume (beyond the reference, which re-runs every
+    # step of a failed install): a retry execution carries
+    # params.resume_from = the failed step's name; earlier steps — already
+    # converged and idempotent — are skipped, not re-run
+    start_index = 0
+    resume_from = execution.params.get("resume_from")
+    if resume_from:
+        names = [s.name for s in steps]
+        if resume_from in names:
+            start_index = names.index(resume_from)
+            for i in range(start_index):
+                execution.steps[i]["status"] = StepState.SKIPPED
+        else:
+            log.warning("[%s] resume_from %r not in %s steps; running all",
+                        execution.project, resume_from, execution.operation)
+
     error: str | None = None
     for i, step_def in enumerate(steps):
+        if i < start_index:
+            continue
         execution.current_step = step_def.name
         execution.steps[i]["status"] = StepState.RUNNING
         execution.steps[i]["started_at"] = iso()
@@ -109,7 +127,8 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
         finally:
             execution.steps[i]["finished_at"] = iso()
             done = sum(1 for s in execution.steps
-                       if s["status"] in (StepState.SUCCESS, StepState.ERROR))
+                       if s["status"] in (StepState.SUCCESS, StepState.ERROR,
+                                          StepState.SKIPPED))
             execution.progress = round(done / len(steps), 3)
             store.save(execution)
         if error:

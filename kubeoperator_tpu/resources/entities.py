@@ -59,6 +59,7 @@ class StepState:
     RUNNING = "running"
     SUCCESS = "success"
     ERROR = "error"
+    SKIPPED = "skipped"            # converged in a prior run (retry resume)
 
 
 class ExecutionState:

@@ -254,6 +254,27 @@ class Platform:
         self.start_execution(execution, wait=True)
         return self.store.get(DeployExecution, execution.id, scoped=False)
 
+    def retry_execution(self, execution_id: str) -> DeployExecution:
+        """Resume a FAILED execution from its failed step (the steps before
+        it already converged and every step is idempotent). The reference
+        has no resume — a failed install re-runs all steps; this creates a
+        fresh execution carrying ``resume_from`` so history stays intact."""
+        failed = self.store.get(DeployExecution, execution_id, scoped=False)
+        if failed is None:
+            raise PlatformError(f"no execution {execution_id}")
+        if failed.state != ExecutionState.FAILURE:
+            raise PlatformError(
+                f"execution {execution_id} is {failed.state}; only FAILED "
+                "executions can be retried")
+        failed_step = next((s["name"] for s in failed.steps
+                            if s.get("status") == "error"), None)
+        params = dict(failed.params)
+        if failed_step:
+            params["resume_from"] = failed_step
+        execution = self.create_execution(failed.project, failed.operation, params)
+        self.start_execution(execution)
+        return execution
+
     def _plan_host_count(self, plan: Plan, params: dict | None) -> int:
         params = params or {}
         masters = self.catalog.template(plan.template)["masters"]

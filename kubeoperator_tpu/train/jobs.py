@@ -199,7 +199,7 @@ def cmd_resnet50(args: argparse.Namespace) -> int:
     if args.data_dir:
         source = data_pipe.NpyDataset(args.data_dir).batches(
             local_batch, seed=0, shard_id=dist["process_id"],
-            num_shards=dist["num_processes"])
+            num_shards=dist["num_processes"], skip_batches=int(state.step))
     else:
         source = data_pipe.synthetic_image_batches(
             local_batch, cfg.image_size, cfg.num_classes,

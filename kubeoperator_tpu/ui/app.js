@@ -215,10 +215,13 @@ async function clusterNodes(name) {
 async function clusterExecutions(name) {
   const exs = await api(`/clusters/${name}/executions`);
   $("#tabview").innerHTML = `<div class="card"><h3>Executions</h3>
-    <table><tr><th>op</th><th>state</th><th>progress</th><th>started</th></tr>
+    <table><tr><th>op</th><th>state</th><th>progress</th><th>started</th><th></th></tr>
     ${exs.map(e => `<tr><td><a data-act="watch" data-n="${esc(e.id)}">${esc(e.operation)}</a></td>
       <td>${tag(e.state)}</td><td>${Math.round((e.progress || 0) * 100)}%</td>
-      <td class="dim">${when(e.created_at)}</td></tr>`).join("")}
+      <td class="dim">${when(e.created_at)}</td>
+      <td>${e.state === "FAILURE" ?
+        `<button class="ghost" data-act="retryEx" data-n="${esc(e.id)}">retry</button>` : ""}</td>
+      </tr>`).join("")}
     </table></div>
     <div class="card" id="progress" style="display:none"><h3>Progress</h3>
       <div class="bar"><div id="pbar" style="width:0"></div></div>
@@ -333,6 +336,12 @@ async function clusterKubectl(name) {
   });
 }
 
+async function retryEx(id) {
+  try {
+    const ex = await api(`/executions/${id}/retry`, {method: "POST"});
+    watch(ex.id);
+  } catch (e) { alert(e.message); }
+}
 async function runOp(name, op) {
   try {
     const ex = await api(`/clusters/${name}/executions`, {method: "POST",
@@ -736,7 +745,8 @@ document.addEventListener("click", e => {
   const d = act.dataset;
   ({delCluster: () => delCluster(d.n), runOp: () => runOp(d.n, d.op),
     addStrategy: () => addStrategy(d.n), deployBackend: () => deployBackend(d.n),
-    watch: () => watch(d.n), markRead: () => markRead(d.n)}[d.act] || (() => {}))();
+    watch: () => watch(d.n), markRead: () => markRead(d.n),
+    retryEx: () => retryEx(d.n)}[d.act] || (() => {}))();
 });
 
 window.addEventListener("hashchange", render);

@@ -67,12 +67,16 @@ class NpyDataset:
         return len(self.images)
 
     def batches(self, batch: int, seed: int = 0, epochs: int | None = None,
-                shard_id: int = 0, num_shards: int = 1) -> Iterator[tuple]:
+                shard_id: int = 0, num_shards: int = 1,
+                skip_batches: int = 0) -> Iterator[tuple]:
         """Shuffled epochs; incomplete trailing batches are dropped so
         shapes stay static for XLA. On multi-process runs every process
         passes the SAME seed with its own ``shard_id``: all share one
         per-epoch permutation and take disjoint strided slices of it, so
-        the global batch has no duplicated examples."""
+        the global batch has no duplicated examples. ``skip_batches``
+        fast-forwards the stream (checkpoint resume at step N passes N so
+        the run continues where it left off instead of replaying epoch 0
+        — the shuffle is position-derived, so the skip is O(1))."""
         n = len(self)
         # every shard uses the same truncated length: uneven shards would
         # desync multi-process epochs (one process exhausting first hangs
@@ -83,14 +87,17 @@ class NpyDataset:
                 f"batch {batch} exceeds shard size {shard_len} "
                 f"({n} samples / {num_shards} shards) — the loader would "
                 "never yield")
-        epoch = 0
+        per_epoch = shard_len // batch
+        epoch = skip_batches // per_epoch
+        offset = skip_batches % per_epoch
         while epochs is None or epoch < epochs:
             order = np.random.default_rng(seed + epoch).permutation(n)
             shard = order[shard_id::num_shards][:shard_len]
-            for start in range(0, shard_len - batch + 1, batch):
-                idx = np.sort(shard[start:start + batch])
+            for b_i in range(offset, per_epoch):
+                idx = np.sort(shard[b_i * batch:(b_i + 1) * batch])
                 yield (np.asarray(self.images[idx]),
                        np.asarray(self.labels[idx]))
+            offset = 0
             epoch += 1
 
 

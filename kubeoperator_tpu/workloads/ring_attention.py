@@ -106,6 +106,29 @@ def sharded_ring_attention(mesh: Mesh, q, k, v, causal: bool = True):
     return fn(q, k, v)
 
 
+def blockwise_attention(q, k, v, causal: bool = True,
+                        chunk: int = 1024) -> jnp.ndarray:
+    """Unsharded attention with K/V processed in chunks (online softmax):
+    O(T·chunk) score memory instead of the reference's O(T²). Used for the
+    local computation inside Ulysses, where each device holds the FULL
+    gathered sequence for its head group."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, t, h, d = q.shape
+    chunk = min(chunk, t)
+    pos = jnp.arange(t)
+    o = jnp.zeros((b, t, h, d), jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    m = jnp.full((b, h, t), -1e30, jnp.float32)
+    for start in range(0, t, chunk):          # static python loop: t is traced-static
+        kv_pos = pos[start:start + chunk]
+        bo, bl, bm = _block_attn(q, k[:, start:start + chunk],
+                                 v[:, start:start + chunk], pos, kv_pos,
+                                 scale, causal)
+        o, l, m = _merge(o, l, m, bo, bl, bm)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style sequence parallelism: instead of rotating
     K/V around a ring, two ``all_to_all``s re-partition [seq-sharded, all
@@ -135,7 +158,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True) -> jnp.ndarr
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    out = reference_attention(seq_to_heads(q), seq_to_heads(k),
+    out = blockwise_attention(seq_to_heads(q), seq_to_heads(k),
                               seq_to_heads(v), causal=causal)
     return heads_to_seq(out)
 

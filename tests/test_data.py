@@ -86,3 +86,29 @@ def test_trainer_consumes_pipeline(shd):
         state, metrics = tr.train_step(state, images, labels)
     assert int(state.step) == 2
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_npy_dataset_skip_batches_resume(tmp_path):
+    images = np.arange(32 * 2 * 2 * 3, dtype=np.float32).reshape(32, 2, 2, 3)
+    labels = np.arange(32, dtype=np.int32)
+    np.save(tmp_path / "images.npy", images)
+    np.save(tmp_path / "labels.npy", labels)
+    ds = D.NpyDataset(str(tmp_path))
+    full = [bl.tolist() for _, bl in ds.batches(batch=4, seed=9, epochs=3)]
+    resumed = [bl.tolist() for _, bl in ds.batches(batch=4, seed=9, epochs=3,
+                                                   skip_batches=10)]
+    assert resumed == full[10:]
+
+
+def test_blockwise_attention_matches_reference():
+    import jax
+    import jax.numpy as jnp
+    from kubeoperator_tpu.workloads import ring_attention as ra
+
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (jax.random.normal(kk, (2, 96, 2, 16), jnp.float32) for kk in ks)
+    for causal in (True, False):
+        got = ra.blockwise_attention(q, k, v, causal=causal, chunk=32)
+        want = ra.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)

@@ -1,0 +1,85 @@
+"""Auto-heal beat (services/healing.py): dead AUTOMATIC workers get
+replaced via provider converge; masters/TPU slices only alert."""
+
+import pytest
+
+from kubeoperator_tpu.resources.entities import (
+    DeployType, ExecutionState, HealthRecord, Host, Message, Node, Plan,
+    Region, Setting, Zone,
+)
+from kubeoperator_tpu.services import healing
+
+
+@pytest.fixture
+def auto_running(platform, fake_executor):
+    region = Region(name="r1", provider="gce", vars={"project": "p"})
+    platform.store.save(region)
+    zone = Zone(name="z1", region_id=region.id, vars={},
+                ip_pool=[f"10.5.0.{i}" for i in range(10, 40)])
+    platform.store.save(zone)
+    plan = Plan(name="heal-plan", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=2,
+                tpu_pools=[{"slice_type": "v5e-8", "count": 1}])
+    platform.store.save(plan)
+    platform.create_cluster("healme", deploy_type=DeployType.AUTOMATIC,
+                            plan_id=plan.id,
+                            configs={"registry": "reg.local:8082"})
+    ex = platform.run_operation("healme", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    return "healme"
+
+
+def put_bad_hours(platform, name, hours=("2026-07-30T01", "2026-07-30T02")):
+    for hour in hours:
+        platform.store.save(HealthRecord(project="healme", kind="host",
+                                         target=name, healthy=False,
+                                         hour=hour, name=f"h:{name}:{hour}"))
+
+
+def test_heal_disabled_by_default(platform, auto_running):
+    put_bad_hours(platform, "healme-worker-1")
+    assert healing.heal_tick(platform) == []
+
+
+def test_heal_replaces_dead_worker(platform, fake_executor, auto_running):
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    dead = platform.store.get_by_name(Host, "healme-worker-1", scoped=False)
+    assert dead is not None
+    dead_id = dead.id
+    put_bad_hours(platform, "healme-worker-1")
+
+    healed = healing.heal_tick(platform)
+    assert healed == ["healme-worker-1"]
+    # wait for the scale execution to converge
+    from kubeoperator_tpu.resources.entities import DeployExecution
+    scale = [e for e in platform.store.find(DeployExecution, scoped=False,
+                                            project="healme")
+             if e.operation == "scale"]
+    assert scale
+    platform.tasks.wait(scale[0].id, timeout=120)
+    replacement = platform.store.get_by_name(Host, "healme-worker-1", scoped=False)
+    assert replacement is not None and replacement.id != dead_id
+    # a WARNING message was fanned out
+    msgs = platform.store.find(Message, scoped=False, project="healme")
+    assert any("auto-heal" in m.title for m in msgs)
+    # one heal per tick: a second tick with no new bad records does nothing
+    assert healing.heal_tick(platform) == []
+
+
+def test_heal_never_touches_masters_or_slices(platform, auto_running):
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    put_bad_hours(platform, "healme-master-1")
+    tpu = [h for h in platform.store.find(Host, scoped=False, project="healme")
+           if h.has_tpu]
+    assert tpu
+    put_bad_hours(platform, tpu[0].name)
+    assert healing.heal_tick(platform) == []
+    assert platform.store.get_by_name(Host, "healme-master-1", scoped=False)
+    msgs = platform.store.find(Message, scoped=False, project="healme")
+    assert any("needs operator action" in m.title for m in msgs)
+
+
+def test_single_flap_does_not_heal(platform, auto_running):
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    put_bad_hours(platform, "healme-worker-2", hours=("2026-07-30T02",))
+    assert healing.heal_tick(platform) == []

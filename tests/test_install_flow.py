@@ -73,3 +73,34 @@ def test_facts_gathered_on_register(platform, manual_cluster):
     assert host.tpu_slice_id == "tpu-a"
     cpu = platform.store.get_by_name(Host, "demo-worker-1", scoped=False)
     assert not cpu.has_tpu and not cpu.has_gpu
+
+
+def test_retry_resumes_from_failed_step(platform, fake_executor, manual_cluster):
+    """Operation-level resume: a failed install retried via
+    retry_execution skips the steps that already converged and re-runs
+    from the failed one (the reference re-runs everything)."""
+    from kubeoperator_tpu.resources.entities import ExecutionState, StepState
+
+    # etcd step fails on the master host
+    fake_executor.fail_on("10.0.0.1", r"etcdctl|etcd\.service|systemctl start etcd")
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.FAILURE
+    failed_step = next(s["name"] for s in ex.steps if s["status"] == "error")
+
+    # clear the fault and retry
+    fake_executor.host("10.0.0.1").fail_patterns.clear()
+    retry = platform.retry_execution(ex.id)
+    platform.tasks.wait(retry.id, timeout=120)
+    retry = platform.store.get(type(ex), retry.id, scoped=False)
+    assert retry.state == ExecutionState.SUCCESS, retry.result
+    assert retry.progress == 1.0
+    by_name = {s["name"]: s["status"] for s in retry.steps}
+    assert by_name[failed_step] == StepState.SUCCESS
+    steps = [s["name"] for s in retry.steps]
+    for name in steps[:steps.index(failed_step)]:
+        assert by_name[name] == StepState.SKIPPED
+    # only FAILED executions are retryable
+    import pytest as _pytest
+    from kubeoperator_tpu.services.platform import PlatformError
+    with _pytest.raises(PlatformError):
+        platform.retry_execution(retry.id)
