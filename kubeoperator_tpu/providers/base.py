@@ -36,6 +36,16 @@ def recover_ip(store: Store, zone_id: str, ip: str) -> None:
             store.save(zone)
 
 
+def remove_auto_host(store: Store, node, host) -> None:
+    """Tear one auto-created host out of desired state: node row, pooled
+    IP, host row. The single definition providers (converge shrink,
+    destroy) and the healer share."""
+    if node is not None:
+        store.delete(type(node), node.id)
+    recover_ip(store, host.zone_id, host.ip)
+    store.delete(type(host), host.id)
+
+
 def count_ip_available(store: Store, zone_ids: list[str]) -> int:
     """Pre-flight for install/scale (reference ``plan.count_ip_available``
     check, ``api.py:234-241``)."""

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from kubeoperator_tpu.engine import adhoc
 from kubeoperator_tpu.providers.base import (
-    CloudProvider, ProviderError, allocate_ip, recover_ip,
+    CloudProvider, ProviderError, allocate_ip, remove_auto_host,
 )
 from kubeoperator_tpu.providers.terraform import TerraformDriver
 from kubeoperator_tpu.resources.entities import (
@@ -82,11 +82,7 @@ class TerraformIaasProvider(CloudProvider):
         if surplus:
             self._drain_surplus(ctx, surplus)
             for h in surplus:
-                node = store.get_by_name(Node, h.name)
-                if node:
-                    store.delete(Node, node.id)
-                recover_ip(store, h.zone_id, h.ip)
-                store.delete(Host, h.id)
+                remove_auto_host(store, store.get_by_name(Node, h.name), h)
                 removed.append(h.name)
 
         # -- terraform converge to the full desired set
@@ -108,11 +104,7 @@ class TerraformIaasProvider(CloudProvider):
         hosts = store.find(Host, scoped=False, project=cluster.name, auto_created=True)
         state = self.terraform.destroy(cluster.name)
         for h in hosts:
-            node = store.get_by_name(Node, h.name)
-            if node:
-                store.delete(Node, node.id)
-            recover_ip(store, h.zone_id, h.ip)
-            store.delete(Host, h.id)
+            remove_auto_host(store, store.get_by_name(Node, h.name), h)
         return {**state, "removed": sorted(h.name for h in hosts)}
 
     # ------------------------------------------------------------------

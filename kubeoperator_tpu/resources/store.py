@@ -19,6 +19,7 @@ import json
 import os
 import sqlite3
 import threading
+from contextlib import contextmanager
 from dataclasses import asdict, fields, is_dataclass
 from typing import Any, Iterator, Type, TypeVar
 
@@ -39,6 +40,7 @@ class Store:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._lock = threading.RLock()
         self._tables: set[str] = set()
+        self._in_tx = False
 
     def _ensure(self, cls: type) -> str:
         t = _table(cls)
@@ -50,7 +52,8 @@ class Store:
                 )
                 self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_name ON {t}(name)")
                 self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_project ON {t}(project)")
-                self._conn.commit()
+                if not self._in_tx:   # else DDL would commit the open block
+                    self._conn.commit()
                 self._tables.add(t)
         return t
 
@@ -66,7 +69,8 @@ class Store:
                 "project=excluded.project, data=excluded.data",
                 (doc["id"], doc.get("name"), doc.get("project"), json.dumps(doc)),
             )
-            self._conn.commit()
+            if not self._in_tx:
+                self._conn.commit()
         return entity
 
     def get(self, cls: Type[T], id: str, scoped: bool = True) -> T | None:
@@ -136,7 +140,8 @@ class Store:
         t = self._ensure(cls)
         with self._lock:
             self._conn.execute(f"DELETE FROM {t} WHERE id=?", (id,))
-            self._conn.commit()
+            if not self._in_tx:
+                self._conn.commit()
 
     def count(self, cls: type, scoped: bool = True, **filters: Any) -> int:
         if set(filters) <= {"name", "project"}:
@@ -156,7 +161,23 @@ class Store:
         names = {f.name for f in fields(cls)}
         return cls(**{k: v for k, v in doc.items() if k in names})
 
+    @contextmanager
     def transaction(self):
-        """Reference uses ``select_for_update`` for config writes
-        (``cluster.py:279-286``); here the store lock serializes a block."""
-        return self._lock
+        """Serialized AND atomic: the store lock excludes other writers for
+        the whole block, and an exception rolls every write in the block
+        back (reference leans on ``select_for_update`` + Django's atomic,
+        ``cluster.py:279-286``). Reentrant — an inner transaction joins the
+        outer one."""
+        with self._lock:
+            if self._in_tx:
+                yield
+                return
+            self._in_tx = True
+            try:
+                yield
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+            finally:
+                self._in_tx = False

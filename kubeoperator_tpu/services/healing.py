@@ -22,7 +22,7 @@ from kubeoperator_tpu.resources.entities import (
     Cluster, ClusterStatus, DeployExecution, DeployType, ExecutionState,
     HealthRecord, Host, Node,
 )
-from kubeoperator_tpu.providers.base import recover_ip
+from kubeoperator_tpu.providers.base import remove_auto_host
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -33,6 +33,10 @@ CONSECUTIVE_BAD_HOURS = 2
 def _consistently_down(platform, cluster: Cluster, host: Host) -> bool:
     recs = platform.store.find(HealthRecord, scoped=False, project=cluster.name,
                                kind="host", target=host.name)
+    # hour-grain records only (hour == "YYYY-MM-DDTHH"): day aggregates
+    # from aggregate_health_history mark the whole day unhealthy for one
+    # bad hour and must not count toward the consecutive-hours guard
+    recs = [r for r in recs if len(r.hour) == 13]
     recs = sorted(recs, key=lambda r: r.hour, reverse=True)[:CONSECUTIVE_BAD_HOURS]
     return (len(recs) == CONSECUTIVE_BAD_HOURS
             and all(not r.healthy for r in recs))
@@ -50,6 +54,22 @@ def _busy(platform, cluster: Cluster) -> bool:
         if rec is not None and rec.state in ("PENDING", "STARTED"):
             return True
     return False
+
+
+def _current_sizing(platform, cluster: Cluster) -> dict:
+    """Sizing params of the most recent successful install/scale, so a
+    heal converges at the cluster's CURRENT size, not the plan default."""
+    exs = [e for e in platform.store.find(DeployExecution, scoped=False,
+                                          project=cluster.name)
+           if e.operation in ("install", "scale")
+           and e.state == ExecutionState.SUCCESS]
+    exs.sort(key=lambda e: e.created_at, reverse=True)
+    for e in exs:
+        params = {k: v for k, v in e.params.items()
+                  if k in ("worker_size", "tpu_pools")}
+        if params:
+            return params
+    return {}
 
 
 def _alerted(platform) -> set:
@@ -94,18 +114,21 @@ def heal_tick(platform) -> list[str]:
             # create the scale execution FIRST (it can refuse — preflight,
             # races on shared IP pools); only then remove the dead worker
             # from desired state so a refusal can't leave the cluster short
-            # a worker with no converge scheduled
+            # a worker with no converge scheduled. The heal re-converges at
+            # the CURRENT size: carry the sizing params of the last
+            # successful install/scale, else an operator's earlier
+            # `scale worker_size=3` would shrink back to the plan default,
+            # draining healthy workers.
             try:
-                ex = platform.create_execution(cluster.name, "scale", {})
+                ex = platform.create_execution(cluster.name, "scale",
+                                               _current_sizing(platform, cluster))
             except Exception as e:  # noqa: BLE001 — per-cluster boundary
                 log.warning("[%s] auto-heal for %s could not schedule: %s",
                             cluster.name, host.name, e)
                 continue
             log.warning("[%s] auto-heal: replacing dead worker %s",
                         cluster.name, host.name)
-            platform.store.delete(Node, node.id)
-            recover_ip(platform.store, host.zone_id, host.ip)
-            platform.store.delete(Host, host.id)
+            remove_auto_host(platform.store, node, host)
             # the replacement reuses the name: drop the dead host's health
             # history so stale records can't re-trigger a heal
             for rec in platform.store.find(HealthRecord, scoped=False,

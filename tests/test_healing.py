@@ -83,3 +83,35 @@ def test_single_flap_does_not_heal(platform, auto_running):
     platform.store.save(Setting(name="auto_heal", value="true"))
     put_bad_hours(platform, "healme-worker-2", hours=("2026-07-30T02",))
     assert healing.heal_tick(platform) == []
+
+
+def test_heal_preserves_scaled_size(platform, fake_executor, auto_running):
+    """A cluster scaled beyond its plan default heals at the CURRENT size;
+    the plan's worker_size=2 must not shrink a worker_size=3 cluster."""
+    ex = platform.run_operation("healme", "scale", {"worker_size": 3})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert platform.store.get_by_name(Host, "healme-worker-3", scoped=False)
+
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    put_bad_hours(platform, "healme-worker-1")
+    healed = healing.heal_tick(platform)
+    assert healed == ["healme-worker-1"]
+    from kubeoperator_tpu.resources.entities import DeployExecution
+    scale = sorted((e for e in platform.store.find(DeployExecution, scoped=False,
+                                                   project="healme")
+                    if e.operation == "scale"),
+                   key=lambda e: e.created_at)[-1]
+    platform.tasks.wait(scale.id, timeout=120)
+    hosts = {h.name for h in platform.store.find(Host, scoped=False, project="healme")}
+    assert {"healme-worker-1", "healme-worker-2", "healme-worker-3"} <= hosts
+
+
+def test_day_aggregates_do_not_trigger_heal(platform, auto_running):
+    """Day-grain aggregate records (unhealthy if ANY hour was bad) must not
+    count toward the consecutive-bad-hours guard."""
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    platform.store.save(HealthRecord(project="healme", kind="host",
+                                     target="healme-worker-1", healthy=False,
+                                     hour="2026-07-28", name="day-agg"))
+    put_bad_hours(platform, "healme-worker-1", hours=("2026-07-30T02",))
+    assert healing.heal_tick(platform) == []

@@ -1,0 +1,101 @@
+"""Store durability + concurrency (the race-detection/concurrency-control
+aux row, SURVEY §5): WAL persistence across reopen, threaded writers,
+tenancy scoping, transaction atomicity."""
+
+import threading
+
+import pytest
+
+from kubeoperator_tpu.resources import scope
+from kubeoperator_tpu.resources.entities import Cluster, Host, Setting, Zone
+from kubeoperator_tpu.resources.store import Store
+
+
+def test_persistence_across_reopen(tmp_path):
+    """Committed rows survive a controller restart (sqlite WAL on disk)."""
+    path = str(tmp_path / "ko.sqlite3")
+    s1 = Store(path)
+    s1.save(Cluster(name="durable", status="RUNNING"))
+    s1.save(Setting(name="k", value="v"))
+    s2 = Store(path)
+    c = s2.get_by_name(Cluster, "durable", scoped=False)
+    assert c is not None and c.status == "RUNNING"
+    assert s2.get_by_name(Setting, "k", scoped=False).value == "v"
+
+
+def test_concurrent_writers_no_lost_updates():
+    """32 threads × 25 inserts each land exactly once (process-wide lock +
+    WAL; the reference's zone IP pool had no such guarantee — SURVEY §5
+    flags it fragile)."""
+    store = Store()
+    errors = []
+
+    def writer(t):
+        try:
+            for i in range(25):
+                store.save(Host(name=f"h-{t}-{i}", ip=f"10.{t}.0.{i}"))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(32)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    assert store.count(Host, scoped=False) == 32 * 25
+
+
+def test_concurrent_ip_allocation_is_exclusive():
+    """The transaction-guarded IP allocator hands every address out at most
+    once under contention."""
+    from kubeoperator_tpu.providers.base import ProviderError, allocate_ip
+
+    store = Store()
+    zone = Zone(name="z", ip_pool=[f"10.0.0.{i}" for i in range(50)])
+    store.save(zone)
+    got, errors = [], []
+    lock = threading.Lock()
+
+    def taker():
+        for _ in range(10):
+            try:
+                ip = allocate_ip(store, store.get(Zone, zone.id, scoped=False))
+                with lock:
+                    got.append(ip)
+            except ProviderError:
+                errors.append(1)
+
+    threads = [threading.Thread(target=taker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # 80 requests for 50 addresses: every grant unique, the rest refused
+    assert len(got) == 50 and len(set(got)) == 50
+    assert len(errors) == 30
+
+
+def test_scoped_queries_respect_project():
+    store = Store()
+    store.save(Cluster(name="a"))
+    store.save(Host(name="ha", ip="1.1.1.1", project="a"))
+    store.save(Host(name="hb", ip="2.2.2.2", project="b"))
+    with scope.project("a"):
+        assert [h.name for h in store.find(Host)] == ["ha"]
+        assert store.get_by_name(Host, "hb") is None
+        assert store.get_by_name(Host, "hb", scoped=False) is not None
+    assert {h.name for h in store.find(Host, scoped=False)} == {"ha", "hb"}
+
+
+def test_transaction_rolls_back_on_error(tmp_path):
+    store = Store(str(tmp_path / "tx.sqlite3"))
+    store.save(Zone(name="z1", ip_pool=["10.0.0.1"]))
+    zone = store.get_by_name(Zone, "z1", scoped=False)
+    with pytest.raises(RuntimeError):
+        with store.transaction():
+            zone.ip_used = ["10.0.0.1"]
+            store.save(zone)
+            raise RuntimeError("boom")
+    fresh = store.get_by_name(Zone, "z1", scoped=False)
+    assert fresh.ip_used == []
