@@ -54,7 +54,9 @@ class Store:
                 self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_project ON {t}(project)")
                 if not self._in_tx:   # else DDL would commit the open block
                     self._conn.commit()
-                self._tables.add(t)
+                    # only cache outside a tx: a rollback would drop the
+                    # table but not this cache, bricking the entity kind
+                    self._tables.add(t)
         return t
 
     # -- CRUD -------------------------------------------------------------

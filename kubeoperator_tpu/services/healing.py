@@ -64,12 +64,12 @@ def _current_sizing(platform, cluster: Cluster) -> dict:
            if e.operation in ("install", "scale")
            and e.state == ExecutionState.SUCCESS]
     exs.sort(key=lambda e: e.created_at, reverse=True)
-    for e in exs:
-        params = {k: v for k, v in e.params.items()
-                  if k in ("worker_size", "tpu_pools")}
-        if params:
-            return params
-    return {}
+    sizing: dict = {}
+    for e in exs:                       # newest-first, merged per key — an
+        for k in ("worker_size", "tpu_pools"):   # older execution may be the
+            if k in e.params and k not in sizing:  # only one that set a key
+                sizing[k] = e.params[k]
+    return sizing
 
 
 def _alerted(platform) -> set:

@@ -273,6 +273,18 @@ def cmd_llm(args: argparse.Namespace) -> int:
     if ckpt:
         ckpt.save(int(state["step"]), state)
         ckpt.close()
+    if args.sample > 0:
+        # decode path smoke: KV-cached generation from the trained params
+        from flax import linen as nn
+
+        from kubeoperator_tpu.workloads.generate import generate
+
+        prompt = jnp.asarray(tokens[:1, :4], jnp.int32)
+        sampled = generate(cfg, nn.unbox(state["params"]), prompt,
+                           max_new_tokens=min(args.sample,
+                                              cfg.max_seq_len - 4),
+                           temperature=0.8)
+        emit({"job": "llm", "sampled_tokens": sampled[0].tolist()})
     emit({"job": "llm", "done": True, "steps": int(state["step"]),
           "chips": len(devices), "mesh": dict(spec.sizes()),
           "seq_len": args.seq_len, **dist})
@@ -318,6 +330,9 @@ def build_parser() -> argparse.ArgumentParser:
     lm.add_argument("--d-ff", type=int, default=None)
     lm.add_argument("--experts", type=int, default=0,
                     help=">0 enables MoE FFNs (shard experts with --mesh ep:N)")
+    lm.add_argument("--sample", type=int, default=0,
+                    help=">0: generate this many tokens after training "
+                         "(KV-cached decode smoke)")
     lm.add_argument("--sp-attention", choices=("ring", "ulysses"),
                     default="ring",
                     help="sequence-parallel attention: ring (ppermute K/V) "

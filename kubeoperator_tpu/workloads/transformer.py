@@ -45,6 +45,7 @@ class TransformerConfig:
     moe_experts: int = 0        # >0: every block's FFN is a routed MoE
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    decode: bool = False        # KV-cached single-token decode (generate.py)
     attention: str = "auto"     # auto | flash | dense — auto picks the pallas
                                 # flash kernel on TPU for long sequences
                                 # (≥8k; below that XLA's fused attention is
@@ -96,7 +97,35 @@ class Attention(nn.Module):
                   kernel_init=with_parts(nn.initializers.lecun_normal(),
                                          ("embed", "heads", "kv")), name="v")(x)
         q, k = rope(q, positions), rope(k, positions)
-        if cfg.ring and self.mesh is not None and "sp" in self.mesh.axis_names:
+        if cfg.decode:
+            # KV cache: static [B, max_seq_len, H, D] buffers + a write
+            # index — the TPU-idiomatic decode (no dynamic shapes; the
+            # causal structure becomes a position mask against the index).
+            # Single-token steps only: the mask below is per-index, not
+            # per-query, so a multi-token chunk would silently mis-mask
+            if x.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode processes one token per step, got T={x.shape[1]}")
+            cache_k = self.variable("cache", "cached_k", jnp.zeros,
+                                    (x.shape[0], cfg.max_seq_len,
+                                     cfg.n_heads, cfg.head_dim), cfg.dtype)
+            cache_v = self.variable("cache", "cached_v", jnp.zeros,
+                                    (x.shape[0], cfg.max_seq_len,
+                                     cfg.n_heads, cfg.head_dim), cfg.dtype)
+            idx = positions[0]                     # scalar write position
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            scale = 1.0 / (cfg.head_dim ** 0.5)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k.value,
+                                preferred_element_type=jnp.float32) * scale
+            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= idx
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
+                             cache_v.value)
+        elif cfg.ring and self.mesh is not None and "sp" in self.mesh.axis_names:
             # GSPMD outside, manual collectives inside: shard_map hands each
             # device its [B, T/sp, H/tp, D] block; K/V ride the ring, or two
             # all-to-alls regroup seq<->heads (Ulysses).
@@ -182,7 +211,8 @@ class Transformer(nn.Module):
         # nn.scan stacks layer params on a leading 'layers' axis: one traced
         # body for all depths — compile time and HBM stay flat as n_layers grows
         stacked = nn.scan(
-            block, variable_axes={"params": 0}, split_rngs={"params": True},
+            block, variable_axes={"params": 0, "cache": 0},
+            split_rngs={"params": True},
             in_axes=nn.broadcast, length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, self.mesh, name="layers")

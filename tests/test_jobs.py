@@ -55,11 +55,15 @@ def test_llm_tiny_with_sp(capsys):
     rc, recs = run_job(capsys, ["llm", "--steps", "1", "--seq-len", "64",
                                 "--batch", "4", "--vocab", "64",
                                 "--d-model", "32", "--heads", "4",
-                                "--layers", "1", "--mesh", "dp:2,tp:2,sp:2"])
+                                "--layers", "1", "--mesh", "dp:2,tp:2,sp:2",
+                                "--sample", "5"])
     assert rc == 0
     done = recs[-1]
     assert done["done"] and done["seq_len"] == 64
     assert done["mesh"]["sp"] == 2
+    sampled = next(r for r in recs if "sampled_tokens" in r)
+    assert len(sampled["sampled_tokens"]) == 9          # 4 prompt + 5 new
+    assert all(0 <= t < 64 for t in sampled["sampled_tokens"])
 
 
 def test_tpu_env_parse(tmp_path):
