@@ -22,6 +22,15 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--no-beat", action="store_true",
                        help="skip monitor/health/backup schedules")
     sub.add_parser("version")
+    sub.add_parser("ctl", help="API client (ko): clusters/ops/hosts/logs",
+                   add_help=False)
+
+    # forward everything after "ctl" untouched: argparse REMAINDER drops a
+    # leading option (e.g. `ctl --help`), so slice argv by hand
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "ctl":
+        from kubeoperator_tpu.ctl import main as ctl_main
+        return ctl_main(raw[1:])
     args = parser.parse_args(argv)
 
     if args.cmd == "version":

@@ -1,0 +1,64 @@
+"""CLI client (kubeoperator_tpu.ctl) against a live in-process server."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from kubeoperator_tpu import ctl
+from kubeoperator_tpu.api.app import create_app, ensure_admin
+from kubeoperator_tpu.resources.entities import ExecutionState
+from tests.conftest import CPU_FACTS
+
+
+@pytest.fixture
+def live_server(platform, fake_executor, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    ensure_admin(platform)
+    return platform
+
+
+def run_with_server(platform, fn):
+    """Boot an aiohttp TestServer and run blocking urllib code against it."""
+    async def main():
+        server = TestServer(create_app(platform))
+        await server.start_server()
+        try:
+            url = f"http://{server.host}:{server.port}"
+            return await asyncio.get_event_loop().run_in_executor(
+                None, fn, url)
+        finally:
+            await server.close()
+    return asyncio.run(main())
+
+
+def test_ctl_login_and_flows(live_server, tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+
+    def drive(url):
+        assert ctl.main(["login", url, "admin",
+                         "--password", "KubeOperator@tpu1"]) == 0
+        assert ctl.main(["clusters"]) == 0
+        assert ctl.main(["cluster", "demo"]) == 0
+        assert ctl.main(["hosts"]) == 0
+        assert ctl.main(["packages"]) == 0
+        assert ctl.main(["dashboard"]) == 0
+        assert ctl.main(["logs", "--query", "install"]) == 0
+        # op + watch: backup completes quickly on fakes
+        assert ctl.main(["op", "demo", "backup"]) == 0
+        return True
+
+    assert run_with_server(live_server, drive)
+    out = capsys.readouterr().out
+    assert "demo" in out and "RUNNING" in out
+    assert "backup SUCCESS" in out
+    assert "demo-tpu-1" in out                     # hosts table
+
+
+def test_ctl_not_logged_in(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "nope.json"))
+    assert ctl.main(["clusters"]) == 1
+    assert "not logged in" in capsys.readouterr().err
