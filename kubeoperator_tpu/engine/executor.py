@@ -283,6 +283,15 @@ class FakeExecutor(Executor):
                 return ExecResult(0, stdout)
         if command.strip() == "true":
             return ExecResult(0)
+        if m := re.match(r"^rm (-r?f) (.+)$", command.strip()):
+            recursive = "r" in m.group(1)
+            for p in m.group(2).split():
+                p = p.strip("'\"")
+                h.files.pop(p, None)
+                if recursive:
+                    for key in [k for k in h.files if k.startswith(p.rstrip("/") + "/")]:
+                        del h.files[key]
+            return ExecResult(0)
         if m := re.match(r"^test -[ef] (\S+)$", command.strip()):
             return ExecResult(0 if m.group(1) in h.files else 1)
         # `test -e X || curl ... -o X ...` and plain `curl ... -o X ...`:
@@ -293,8 +302,27 @@ class FakeExecutor(Executor):
             if guard and guard.group(1) in h.files:
                 return ExecResult(0)
             if "healthz" not in command:
-                h.files[dest] = f"fetched:{command}".encode()
+                # content derives from the URL alone (not the whole command)
+                # so checksum tests can precompute the expected digest
+                um = re.search(r"(https?://\S+)", command)
+                url = um.group(1).strip("'\"") if um else dest
+                h.files[dest] = f"fetched:{url}".encode()
             return ExecResult(0)
+        # `echo '<sha>  <path>' | sha256sum -c -` — download verification
+        if "sha256sum -c" in command:
+            m = re.match(r"^echo '?([0-9a-fA-F]{8,})\s+(\S+?)'? \| sha256sum -c -$",
+                         command.strip())
+            if not m:
+                # a -c invocation the fake can't parse must FAIL, not fall
+                # through to the generic emulation's success — that would
+                # let format drift in ensure_binary pass verification
+                return ExecResult(1, "", "fake: unparseable sha256sum -c")
+            import hashlib as _hl
+            want, p = m.group(1).lower(), m.group(2).strip("'\"")
+            content = h.files.get(p)
+            if content is not None and _hl.sha256(content).hexdigest() == want:
+                return ExecResult(0, f"{p}: OK")
+            return ExecResult(1, "", f"{p}: FAILED")
         if m := re.search(r"sha256sum (\S+)", command):
             import hashlib as _hl
             p = m.group(1).strip("'\"")

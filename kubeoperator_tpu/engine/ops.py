@@ -58,15 +58,36 @@ class HostOps:
         self.sh(f"systemctl stop {unit}", check=False)
         self.sh(f"systemctl disable {unit}", check=False)
 
-    def ensure_binary(self, name: str, source_url: str, dest_dir: str = "/usr/local/bin") -> None:
+    def ensure_binary(self, name: str, source_url: str,
+                      dest_dir: str = "/usr/local/bin",
+                      sha256: str | None = None) -> None:
         """Fetch a binary from the cluster's offline repo if not present
-        (reference copies from the package nexus, ``roles/kube-bin``)."""
+        (reference copies from the package nexus, ``roles/kube-bin``).
+        With ``sha256`` (from the package's checksums map) the download is
+        verified and a corrupted/tampered file is removed and fails the
+        step — air-gapped mirrors are exactly where silent corruption
+        hides."""
         dest = f"{dest_dir}/{name}"
+
+        def verified() -> bool:
+            return self.sh(
+                f"echo {shlex.quote(sha256 + '  ' + dest)} | sha256sum -c -",
+                check=False).ok
+
         if self.exists(dest):
-            return
+            if sha256 is None or verified():
+                return
+            # a partial download from an earlier failed run would otherwise
+            # be accepted forever — refetch instead
+            self.sh(f"rm -f {shlex.quote(dest)}", check=False)
         self.ensure_dir(dest_dir)
         self.sh(f"curl -fsSL -o {shlex.quote(dest)} {shlex.quote(source_url)} && chmod 0755 {shlex.quote(dest)}",
                 timeout=600)
+        if sha256 and not verified():
+            self.sh(f"rm -f {shlex.quote(dest)}", check=False)
+            raise RuntimeError(
+                f"checksum mismatch for {name} from {source_url}: "
+                f"expected sha256 {sha256}")
 
     def ensure_line(self, path: str, line: str) -> None:
         q = shlex.quote(line)

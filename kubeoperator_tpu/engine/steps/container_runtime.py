@@ -26,7 +26,8 @@ def run(ctx: StepContext):
     def per(th):
         o = ctx.ops(th)
         for b in ("containerd", "runc", "crictl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN)
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
         o.ensure_file("/etc/containerd/config.toml",
                       CONTAINERD_CONFIG.format(registry=registry, registry_url=registry_url))
         o.ensure_file("/etc/crictl.yaml",

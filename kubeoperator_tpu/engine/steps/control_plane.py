@@ -43,7 +43,8 @@ def run(ctx: StepContext):
     def per(th):
         o = ctx.ops(th)
         for b in ("kube-apiserver", "kube-controller-manager", "kube-scheduler", "kubectl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN)
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
         for name in ("apiserver", "admin", "controller-manager", "scheduler"):
             o.ensure_file(f"{k8s.SSL}/{name}.crt", pki.read(f"{name}.crt"))
             o.ensure_file(f"{k8s.SSL}/{name}.key", pki.read(f"{name}.key"), mode=0o600)

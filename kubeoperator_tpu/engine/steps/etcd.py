@@ -22,7 +22,8 @@ def run(ctx: StepContext):
         o = ctx.ops(th)
         repo = k8s.repo_url(ctx)
         for b in ("etcd", "etcdctl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN)
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
         o.ensure_dir(k8s.ETCD_DATA)
         o.ensure_file(f"{k8s.SSL}/etcd.crt", pki.read(f"{name}.crt"))
         o.ensure_file(f"{k8s.SSL}/etcd.key", pki.read(f"{name}.key"), mode=0o600)

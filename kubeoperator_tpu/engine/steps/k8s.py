@@ -35,6 +35,12 @@ def repo_url(ctx) -> str:
     return ctx.vars.get("repo_url", "http://127.0.0.1:8081/repository/raw")
 
 
+def checksum(ctx, name: str) -> str | None:
+    """Expected sha256 for a repo file, from the offline package's
+    ``checksums:`` map (flows into cluster configs as repo_checksums)."""
+    return (ctx.vars.get("repo_checksums") or {}).get(name)
+
+
 def apiserver_url(ctx) -> str:
     masters = ctx.inventory.masters()
     ip = masters[0].host.ip if masters else "127.0.0.1"

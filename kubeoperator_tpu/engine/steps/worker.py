@@ -29,7 +29,8 @@ def run(ctx: StepContext):
     def per(th):
         o = ctx.ops(th)
         for b in ("kubelet", "kube-proxy", "kubectl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN)
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
         user = f"node-{th.name}"
         pki.ensure_cert(user, f"system:node:{th.name}", org="system:nodes")
         o.ensure_file(f"{k8s.KCFG}/kubelet.conf", pki.kubeconfig(user, server), mode=0o600)

@@ -130,6 +130,8 @@ class Platform:
             from kubeoperator_tpu.services import packages as packages_svc
 
             merged.update(pkg.meta.get("vars", {}))
+            if pkg.meta.get("checksums"):
+                merged.setdefault("repo_checksums", pkg.meta["checksums"])
             # nodes pull binaries from the controller-served package repo
             # (nexus-lite; reference package_manage.py:31-53)
             if "repo_url" not in (configs or {}):

@@ -109,3 +109,47 @@ def test_repo_route_serves_files(platform, package_fixture):
         assert (await r.json())["packages"][0]["name"] == "k8s-v1.28-tpu"
 
     run_api(platform, scenario)
+
+
+def test_package_checksums_verify_downloads(platform, fake_executor, package_fixture):
+    """meta.yml checksums flow into cluster configs and ensure_binary
+    verifies every fetched binary — a corrupted repo file fails the step
+    instead of installing silently."""
+    import hashlib
+
+    pkgs.scan_packages(platform)
+    pkg = platform.store.find(Package, scoped=False)[0]
+    repo = pkgs.repo_url(platform, pkg)
+    # the fake executor materializes downloads as b"fetched:<url>"
+    good = {b: hashlib.sha256(f"fetched:{repo}/{b}".encode()).hexdigest()
+            for b in ("runc", "containerd", "crictl", "kubeadm", "kubelet",
+                      "kubectl", "etcd", "etcdctl", "kube-apiserver",
+                      "kube-controller-manager", "kube-scheduler", "kube-proxy",
+                      "helm")}
+    pkg.meta["checksums"] = good
+    platform.store.save(pkg)
+
+    cred = platform.create_credential("ck", private_key="FAKE")
+    fake_executor.host("10.3.0.1").facts.update(CPU_FACTS)
+    m = platform.register_host("c-m", "10.3.0.1", cred.id)
+    cluster = platform.create_cluster("ckdemo", package="k8s-v1.28-tpu",
+                                      configs={"registry": "reg.local:8082"})
+    assert cluster.configs["repo_checksums"] == good
+    platform.add_node(cluster, m, ["master"])
+    ex = platform.run_operation("ckdemo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert any("sha256sum -c" in c
+               for c in fake_executor.host("10.3.0.1").history)
+
+    # tampered checksum → step fails and the bad binary is removed
+    pkg.meta["checksums"] = {**good, "kubectl": "0" * 64}
+    platform.store.save(pkg)
+    fake_executor.host("10.3.0.2").facts.update(CPU_FACTS)
+    m2 = platform.register_host("c-m2", "10.3.0.2", cred.id)
+    c2 = platform.create_cluster("ckbad", package="k8s-v1.28-tpu",
+                                 configs={"registry": "reg.local:8082"})
+    platform.add_node(c2, m2, ["master"])
+    ex = platform.run_operation("ckbad", "install")
+    assert ex.state == ExecutionState.FAILURE
+    assert "checksum mismatch" in str(ex.result)
+    assert "/opt/kube/bin/kubectl" not in fake_executor.host("10.3.0.2").files
