@@ -27,18 +27,21 @@ def main() -> None:
 
     n = len(jax.devices())
     on_tpu = "tpu" in jax.devices()[0].platform.lower() or "axon" in jax.devices()[0].platform.lower()
-    # batch per chip: 256 is the sweet spot for v5e HBM; fall back on OOM.
-    # 8 scanned steps per dispatch amortize the launch overhead the way a
-    # prefetching input pipeline does in a real training loop.
+    # batch per chip: 128 is the sweet spot with the dot-form dW (PERF.md
+    # round-3 sweep); fall back on OOM. 8 scanned steps per dispatch
+    # amortize the launch overhead the way a prefetching input pipeline does
+    # in a real training loop.
     steps, warmup, k = (6, 2, 8) if on_tpu else (3, 1, 1)
     image = 224 if on_tpu else 64
     result = None
-    for per_chip_batch in (256, 128, 64, 16):
+    for per_chip_batch in (128, 64, 16):  # descending: an OOM at one size
+        # means anything larger would OOM too
         # space-to-depth stem (MLPerf conv0 s2d) + fixed-batch scanned
-        # multi-step: measured 28.3% → 31.8% MFU on v5e (see PERF.md).
+        # multi-step + dot-form 1x1 conv weight gradients (custom VJP,
+        # workloads/conv_vjp.py): measured 31.7% → 32.8% MFU on v5e.
         # s2d is correct on any even image size, CPU included.
         cfg = TrainConfig(batch_size=per_chip_batch * n, image_size=image,
-                          stem="space_to_depth")
+                          stem="space_to_depth", dw_dot_max_k=1)
         tr = Trainer(cfg, MeshSpec(dp=n) if n > 1 else MeshSpec())
         try:
             result = tr.measure(steps=steps, warmup=warmup, steps_per_call=k)
@@ -69,8 +72,9 @@ def main() -> None:
         "image_size": image,
     }
     # secondary metric: transformer LM training MFU (the long-context
-    # workload; dense attention beats the pallas kernel at this size —
-    # PERF.md). Best-effort: the headline metric never depends on it.
+    # workload; the causal-skipping pallas flash kernel beats dense 2.2x at
+    # this size — PERF.md round 3). Best-effort: the headline metric never
+    # depends on it.
     if on_tpu:
         try:
             import jax.numpy as jnp
@@ -81,7 +85,7 @@ def main() -> None:
             lm_cfg = TransformerConfig(
                 vocab_size=32_000, d_model=2048, n_heads=16, n_layers=4,
                 d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16, remat=True,
-                attention="dense")
+                attention="auto", logits_bf16=True)
             lm_spec = MeshSpec(dp=n) if n > 1 else MeshSpec()
             lm = LMTrainer(lm_cfg, lm_spec).measure(batch=8 * n, seq_len=2048,
                                                     steps=6, warmup=2)

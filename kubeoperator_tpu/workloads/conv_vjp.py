@@ -1,0 +1,220 @@
+"""Convolution with a dot-general weight gradient (custom VJP).
+
+The TPU conv emitter is excellent at conv forwards and dInput transposes but
+poor at ResNet-shaped weight gradients: dW outputs are tiny (Cin x Cout) with
+a very long contraction (batch*H*W ~ 800k), a shape that leaves most MXU
+columns idle (measured 43.8 ms/step vs ~10.7 roofline on v5e — PERF.md).
+The same contraction expressed as ``lax.dot_general`` was measured 1.5x
+faster. Swapping the whole conv for a Dense, however, loses XLA's BN-epilogue
+fusion on the forward (measured net -0.8% MFU, PERF.md "Tried and rejected").
+
+This module threads the needle with ``jax.custom_vjp``:
+
+* forward: plain ``lax.conv_general_dilated`` — byte-identical to nn.Conv,
+  so the BN statistic reduces still fuse into the conv epilogue;
+* dInput: the standard transposed-conv VJP, unchanged;
+* dWeight: a ``dot_general`` per kernel tap — ``dW[kh,kw] = x_shifted^T @ g``
+  with f32 accumulation (``preferred_element_type``), where ``x_shifted`` is
+  a strided slice XLA fuses into the dot operand (no patch materialisation).
+
+No reference counterpart: the reference control plane has no training code
+(SURVEY.md §2.10); this is TPU-performance work on the bundled workload.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x: jnp.ndarray, w: jnp.ndarray, strides, padding) -> jnp.ndarray:
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        dimension_numbers=_DIMNUMS)
+
+
+def _dw_dot(x: jnp.ndarray, g: jnp.ndarray, kshape, strides, pads) -> jnp.ndarray:
+    """dW[kh,kw,ci,co] = sum_{b,ho,wo} x_pad[b, ho*sh+kh, wo*sw+kw, ci] * g[b,ho,wo,co].
+
+    One dot_general per kernel tap over a strided slice of the (padded)
+    input. The slice fuses into the dot's operand read; accumulation is f32
+    on the MXU (same as the conv emitter's internal accumulation).
+    """
+    kh, kw = kshape
+    sh, sw = strides
+    b, ho, wo, co = g.shape
+    ci = x.shape[-1]
+    if any(p != (0, 0) for p in pads):
+        x = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
+    taps = []
+    for di in range(kh):
+        for dj in range(kw):
+            xs = lax.slice(
+                x, (0, di, dj, 0),
+                (b, di + (ho - 1) * sh + 1, dj + (wo - 1) * sw + 1, ci),
+                (1, sh, sw, 1))
+            taps.append(lax.dot_general(
+                xs, g, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                preferred_element_type=jnp.float32))
+    return jnp.stack(taps, 0).reshape(kh, kw, ci, co)
+
+
+def conv1x1_bwd_pallas(x: jnp.ndarray, g: jnp.ndarray, w: jnp.ndarray,
+                       interpret: bool | None = None):
+    """Fused backward for a stride-1 1x1 conv: one pass over (x, g) produces
+    both dx = g @ w^T and dW = x^T @ g.
+
+    The separate XLA ops each re-read ``g`` from HBM (dInput and dWeight are
+    independent convs XLA cannot fuse); for ResNet stage-1 shapes ``g`` is a
+    411 MB tensor, so the fusion halves the dominant HBM term and runs the
+    whole backward at the bandwidth floor (profile: dW-as-dot was ~1.8x its
+    bytes/s roofline). The contraction accumulates f32 in a VMEM-resident
+    (Ci, Co) output block that is revisited by every grid step.
+    """
+    if interpret is None:  # pallas TPU lowering needs a real TPU-ish backend
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, h, wd, ci = x.shape
+    co = g.shape[-1]
+    n = b * h * wd
+    # work in 2D (N, C): the reshape is a bitcast on the row-major operand
+    # layout the custom call constrains, and 2D blocks dodge the sublane/lane
+    # padding a (bt, 56, 56, 64) block would pay in VMEM
+    x2, g2 = x.reshape(n, ci), g.reshape(n, co)
+    # row-chunk size: channel dims pad to 128 lanes in VMEM; x/g/dx stream
+    # double-buffered within ~8 MB, f32 dW accumulator + w stay resident;
+    # must divide N (B a multiple of 128 keeps plenty of 2-power divisors)
+    pad = lambda c: -(-c // 128) * 128
+    stream_per_row = 2 * 2 * (2 * pad(ci) + pad(co))
+    tb = 128
+    while tb < 8192 and n % (tb * 2) == 0 and (tb * 2) * stream_per_row <= 8 * 1024 * 1024:
+        tb *= 2
+    if n % tb:
+        raise ValueError(f"N={n} not divisible by row chunk {tb}; "
+                         "caller must fall back to the dot path")
+
+    def kernel(x_ref, g_ref, w_ref, dx_ref, dw_ref):
+        i = pl.program_id(0)
+        dxt = lax.dot_general(g_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        dx_ref[:] = dxt.astype(x.dtype)
+        part = lax.dot_general(x_ref[:], g_ref[:], (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+        @pl.when(i == 0)
+        def _():
+            dw_ref[:] = part
+
+        @pl.when(i > 0)
+        def _():
+            dw_ref[:] = dw_ref[:] + part
+
+    dx, dw = pl.pallas_call(
+        kernel,
+        grid=(n // tb,),
+        in_specs=[
+            pl.BlockSpec((tb, ci), lambda i: (i, 0)),
+            pl.BlockSpec((tb, co), lambda i: (i, 0)),
+            pl.BlockSpec((ci, co), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tb, ci), lambda i: (i, 0)),
+            pl.BlockSpec((ci, co), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, ci), x.dtype),
+            jax.ShapeDtypeStruct((ci, co), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, g2, w)
+    return dx.reshape(b, h, wd, ci), dw
+
+
+@lru_cache(maxsize=None)
+def make_conv(strides: tuple, padding: str, mode: str = "dot") -> Callable:
+    """Build (and cache) the custom-VJP conv for a (strides, padding) config.
+
+    mode "dot": dW as per-tap dot_generals; dInput unchanged.
+    mode "pallas": additionally fuse dx+dW into one Pallas pass for 1x1/s1
+    convs (falls back to "dot" for any other shape).
+    mode "dot2": dInput *also* as a dot for 1x1/s1 convs (kept for
+    measurement; loses to "dot" on v5e — layout copies, PERF.md round 3).
+    """
+    if mode not in ("dot", "pallas", "dot2"):
+        raise ValueError(f"unknown conv backward mode {mode!r}")
+
+    @jax.custom_vjp
+    def conv(x, w):
+        return _conv(x, w, strides, padding)
+
+    def fwd(x, w):
+        return _conv(x, w, strides, padding), (x, w)
+
+    def bwd(res, g):
+        x, w = res
+        kh, kw = w.shape[0], w.shape[1]
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        if (mode == "pallas" and (kh, kw) == (1, 1) and strides == (1, 1)
+                and n % 128 == 0):  # else fall through to the dot path
+            dx, dw = conv1x1_bwd_pallas(x, g, w[0, 0])
+            return dx, dw.astype(w.dtype).reshape(w.shape)
+        if mode == "dot2" and (kh, kw) == (1, 1) and strides == (1, 1):
+            # both gradients as dots: unlike a pallas custom call, an XLA dot
+            # accepts the producers' conv-friendly layouts (no copies), and
+            # unlike the conv emitter it streams the long N contraction well
+            g2 = g.reshape(n, g.shape[-1])
+            dx = lax.dot_general(g2, w[0, 0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            dx = dx.astype(x.dtype).reshape(x.shape)
+            dw = lax.dot_general(x.reshape(n, x.shape[-1]), g2,
+                                 (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            return dx, dw.astype(w.dtype).reshape(w.shape)
+        # dInput: the standard transposed-conv path, via jax.vjp of an
+        # x-only closure. The re-traced primal conv has no consumers and is
+        # dead-code-eliminated by XLA (verified in the profile: no extra
+        # forward conv appears in the backward).
+        _, vjp_x = jax.vjp(lambda xx: _conv(xx, w, strides, padding), x)
+        dx, = vjp_x(g)
+        pads = tuple(lax.padtype_to_pads(
+            x.shape[1:3], (kh, kw), strides, padding))
+        dw = _dw_dot(x, g, (kh, kw), strides, pads).astype(w.dtype)
+        return dx, dw
+
+    conv.defvjp(fwd, bwd)
+    return conv
+
+
+class Conv(nn.Module):
+    """Drop-in for the no-bias NHWC ``nn.Conv`` with the dot-form dW.
+
+    Parameter layout ("kernel", HWIO) and dtype promotion match nn.Conv, so
+    checkpoints are interchangeable between the two implementations.
+    """
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = False
+    dtype: Any = None
+    bwd_impl: str = "dot"            # "dot" | "pallas" (fused 1x1 backward)
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.use_bias:
+            raise NotImplementedError("dw-dot Conv is bias-free (BN follows)")
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", self.kernel_init, (kh, kw, x.shape[-1], self.features))
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        return make_conv(tuple(self.strides), self.padding, self.bwd_impl)(x, kernel)

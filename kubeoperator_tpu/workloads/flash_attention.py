@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK = 128
+DEFAULT_BLOCK = 256     # v5e sweep at [8,2048,16,128] fwd+bwd: 128 → 31.3 ms,
+                        # 256 → 21.1 ms, 512 → 26.1 ms (dense: 46.1 ms)
 NEG_INF = -1e30
 
 
@@ -63,7 +64,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bk):
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    # causal: K/V blocks past the diagonal are fully masked — skip them
+    # (halves the compute; the loop bound is dynamic, fori_loop lowers to
+    # a while loop)
+    hi = jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk if causal else n_kv
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     # lse rides a sublane-padded [BH, 8, T] layout: Mosaic cannot do the
@@ -127,7 +132,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_kv, body, jnp.zeros((bq, d), jnp.float32))
+    hi = jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk if causal else n_kv
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -161,7 +167,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, n_q, body, (dk0, dv0))
+    # causal: Q blocks strictly above this K/V block's diagonal see none of
+    # it — start at the first overlapping Q block
+    lo = (j_blk * bk) // bq if causal else 0
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
     # q was loaded pre-scaled, so dk = dsᵀ(q·scale) already carries the
     # 1/√d factor — no second multiply here
     dk_ref[0] = dk.astype(dk_ref.dtype)
@@ -232,12 +241,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, t, h, d = q.shape
-    if t % min(block, t) != 0:
-        # the grid floor-divides: a ragged tail block would be silently
-        # dropped (unwritten output rows), so refuse instead
-        raise ValueError(f"flash_attention needs seq len divisible by the "
-                         f"block ({min(block, t)}); got {t}. Pad the sequence "
-                         f"or use reference_attention.")
+    if t % 128 != 0 or t % min(block, t) != 0:
+        # the grid floor-divides (a ragged tail block would be silently
+        # dropped) and Mosaic tiles lanes in 128s, so refuse instead
+        raise ValueError(f"flash_attention needs seq len divisible by 128 "
+                         f"and by the block ({min(block, t)}); got {t}. Pad "
+                         f"the sequence or use reference_attention.")
     scale = 1.0 / (d ** 0.5)
 
     def flat(x):

@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from kubeoperator_tpu.workloads import conv_vjp
+
 ModuleDef = Any
 
 STAGE_SIZES = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -91,10 +93,29 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv"               # "conv" (classic 7x7/s2) | "space_to_depth"
+    dw_dot_max_k: int = 0            # kernels up to this size use the dot-form
+                                     # weight gradient (conv_vjp.Conv); 0 = off
+    conv_bwd: str = "dot"            # "dot" | "pallas" | "dot2" — backward impl
+                                     # for custom-VJP convs (conv_vjp.make_conv)
+
+    def _conv_ctor(self) -> ModuleDef:
+        """nn.Conv, or the custom-VJP conv for small kernels (PERF.md: the
+        conv emitter's dW is 4-5x off roofline; the dot form is not)."""
+        if self.dw_dot_max_k <= 0:
+            return partial(nn.Conv, use_bias=False, padding="SAME", dtype=self.dtype)
+
+        def conv(features, kernel_size, **kw):
+            if max(kernel_size) <= self.dw_dot_max_k:
+                return conv_vjp.Conv(features, kernel_size, dtype=self.dtype,
+                                     bwd_impl=self.conv_bwd, **kw)
+            return nn.Conv(features, kernel_size, use_bias=False,
+                           padding="SAME", dtype=self.dtype, **kw)
+
+        return conv
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
-        conv = partial(nn.Conv, use_bias=False, padding="SAME", dtype=self.dtype)
+        conv = self._conv_ctor()
         # BN in the model dtype: flax upcasts the statistics to f32 internally
         # (and params/running stats stay f32), so bf16 here only changes the
         # activation dtype — keeping activations bf16 end-to-end halves HBM

@@ -61,6 +61,9 @@ class TrainConfig:
     total_steps: int = 50_000
     dtype: Any = jnp.bfloat16
     stem: str = "conv"               # "space_to_depth" = MLPerf conv0 s2d (TPU)
+    dw_dot_max_k: int = 0            # dot-form conv weight gradient for kernels
+                                     # up to this size (see workloads/conv_vjp.py)
+    conv_bwd: str = "dot"            # "dot" | "pallas" | "dot2" (conv_vjp.make_conv)
 
 
 @dataclass
@@ -114,7 +117,9 @@ class Trainer:
         self.mesh = build_mesh(self.spec, devices)
         self.model = resnet.ResNet(num_classes=self.cfg.num_classes,
                                    depth=self.cfg.depth, dtype=self.cfg.dtype,
-                                   stem=self.cfg.stem)
+                                   stem=self.cfg.stem,
+                                   dw_dot_max_k=self.cfg.dw_dot_max_k,
+                                   conv_bwd=self.cfg.conv_bwd)
         self.tx = make_optimizer(self.cfg)
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None

@@ -47,9 +47,15 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     decode: bool = False        # KV-cached single-token decode (generate.py)
     attention: str = "auto"     # auto | flash | dense — auto picks the pallas
-                                # flash kernel on TPU for long sequences
-                                # (≥8k; below that XLA's fused attention is
-                                # faster on v5e, PERF.md), dense elsewhere
+                                # flash kernel on TPU at seq ≥2048 (with
+                                # causal block-skipping it beats XLA's fused
+                                # dense attention 2.2x there, PERF.md; below
+                                # that dense wins on launch overhead)
+    logits_bf16: bool = False   # opt-in: logits matmul in bf16 with f32
+                                # accumulation (MXU full rate; the f32 form
+                                # runs at 1/4 rate, ~18% of fwd FLOPs at 32k
+                                # vocab). Off by default so existing configs
+                                # keep bit-identical logits.
 
     @property
     def head_dim(self) -> int:
@@ -82,6 +88,23 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None            # required when cfg.ring (shard_map needs it)
+
+    def _wants_flash(self, seq_len: int) -> bool:
+        """The gate derives divisibility from the kernel's own block size so
+        the two cannot desync (the flash grid floor-divides the sequence)."""
+        from kubeoperator_tpu.workloads.flash_attention import DEFAULT_BLOCK
+        # 128 is the lane-tile floor (Mosaic (8,128) tiling); shorter or
+        # unaligned sequences always take the dense path
+        if seq_len % 128 != 0 or seq_len % min(DEFAULT_BLOCK, seq_len) != 0:
+            return False
+        if self.cfg.attention == "flash":
+            return True
+        # auto: measured crossover on v5e (PERF.md round 3) — flash wins
+        # from 2048 up; below that the S×S tensors are small enough that
+        # XLA's fused dense attention wins on launch overhead
+        return (self.cfg.attention == "auto"
+                and jax.default_backend() in ("tpu", "axon")
+                and seq_len >= 2048)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -133,14 +156,7 @@ class Attention(nn.Module):
                 out = ra.sharded_ulysses_attention(self.mesh, q, k, v, causal=True)
             else:
                 out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
-        elif (cfg.attention == "flash" and q.shape[1] % 128 == 0) or (
-                cfg.attention == "auto"
-                and jax.default_backend() in ("tpu", "axon")
-                and q.shape[1] % 128 == 0
-                # measured on v5e (PERF.md): XLA's fused attention beats the
-                # pallas kernel below ~8k sequence; flash pays off once the
-                # S×S intermediate dominates HBM
-                and q.shape[1] >= 8192):
+        elif self._wants_flash(q.shape[1]):
             from kubeoperator_tpu.workloads.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
         else:
@@ -218,8 +234,15 @@ class Transformer(nn.Module):
         )(cfg, self.mesh, name="layers")
         x, _ = stacked(x, positions)
         x = RMSNorm(name="ln_f")(x)
-        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                            emb.astype(jnp.float32))
+        if cfg.logits_bf16:
+            # bf16 operands, f32 MXU accumulation: same f32 logits out, 4x
+            # the matmul rate of the all-f32 form
+            logits = jnp.einsum("btd,vd->btv", x.astype(cfg.dtype),
+                                emb.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                                emb.astype(jnp.float32))
         return logits
 
 

@@ -1,0 +1,100 @@
+"""conv_vjp.Conv must be numerically interchangeable with nn.Conv.
+
+Forward and both gradients are compared against flax's nn.Conv (the XLA
+conv-VJP path) in f32 on CPU, across the kernel/stride shapes ResNet uses:
+1x1 s1, 1x1 s2 (projection), 3x3 s1, 3x3 s2 (stage transition), 4x4 s1
+(s2d stem), 7x7 s2 (classic stem) — all SAME padding, bias-free.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.workloads import conv_vjp
+
+
+CASES = [  # (kernel, strides, h, cin, cout)
+    ((1, 1), (1, 1), 8, 6, 10),
+    ((1, 1), (2, 2), 8, 6, 10),
+    ((3, 3), (1, 1), 8, 6, 10),
+    ((3, 3), (2, 2), 9, 6, 10),      # odd spatial → asymmetric SAME pads
+    ((4, 4), (1, 1), 8, 12, 16),
+    ((7, 7), (2, 2), 14, 3, 8),
+]
+
+
+@pytest.mark.parametrize("kernel,strides,h,cin,cout", CASES)
+def test_matches_nn_conv(kernel, strides, h, cin, cout):
+    rng = jax.random.key(0)
+    x = jax.random.normal(jax.random.key(1), (2, h, h, cin), jnp.float32)
+
+    ref = nn.Conv(cout, kernel, strides=strides, padding="SAME", use_bias=False)
+    new = conv_vjp.Conv(cout, kernel, strides=strides)
+    params = ref.init(rng, x)
+
+    def loss(mod, params, x):
+        y = mod.apply(params, x)
+        return (y * jnp.cos(y)).sum(), y  # non-trivial cotangent
+
+    (l_ref, y_ref), g_ref = jax.value_and_grad(
+        lambda p, x: loss(ref, p, x), argnums=(0, 1), has_aux=True)(params, x)
+    (l_new, y_new), g_new = jax.value_and_grad(
+        lambda p, x: loss(new, p, x), argnums=(0, 1), has_aux=True)(params, x)
+
+    np.testing.assert_allclose(y_ref, y_new, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        g_ref[0]["params"]["kernel"], g_new[0]["params"]["kernel"],
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(g_ref[1], g_new[1], rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_grads_match_across_impls():
+    """Whole-model: dw_dot_max_k=7 must reproduce the nn.Conv gradients."""
+    from kubeoperator_tpu.workloads.resnet import ResNet
+
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3), jnp.float32)
+    labels = jnp.array([1, 3])
+
+    def grads(dw_dot_max_k):
+        model = ResNet(num_classes=8, depth=18, width=8, dtype=jnp.float32,
+                       dw_dot_max_k=dw_dot_max_k)
+        variables = model.init(jax.random.key(0), x, train=False)
+
+        def loss(params):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return optax_xent(logits, labels)
+
+        return jax.grad(loss)(variables["params"])
+
+    def optax_xent(logits, labels):
+        return -jnp.take_along_axis(
+            jax.nn.log_softmax(logits), labels[:, None], axis=1).mean()
+
+    g0, g7 = grads(0), grads(7)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4),
+                 g0, g7)
+
+
+@pytest.mark.parametrize("kernel,strides,h,cin,cout", CASES)
+def test_pallas_bwd_matches_nn_conv(kernel, strides, h, cin, cout):
+    """bwd_impl='pallas' (fused 1x1 path, dot fallback elsewhere) vs nn.Conv."""
+    rng = jax.random.key(0)
+    x = jax.random.normal(jax.random.key(1), (2, h, h, cin), jnp.float32)
+
+    ref = nn.Conv(cout, kernel, strides=strides, padding="SAME", use_bias=False)
+    new = conv_vjp.Conv(cout, kernel, strides=strides, bwd_impl="pallas")
+    params = ref.init(rng, x)
+
+    def loss(mod, params, x):
+        y = mod.apply(params, x)
+        return (y * jnp.cos(y)).sum()
+
+    g_ref = jax.grad(lambda p, x: loss(ref, p, x), argnums=(0, 1))(params, x)
+    g_new = jax.grad(lambda p, x: loss(new, p, x), argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(g_ref[0]["params"]["kernel"],
+                               g_new[0]["params"]["kernel"], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(g_ref[1], g_new[1], rtol=1e-4, atol=1e-4)
