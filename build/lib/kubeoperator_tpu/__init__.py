@@ -1,0 +1,26 @@
+"""kubeoperator-tpu: a TPU-native cluster lifecycle platform.
+
+A ground-up rebuild of the capabilities of KubeOperator (reference:
+``/root/reference``, a Django+Celery+Ansible+Terraform K8s-as-a-Service
+control plane) designed TPU-first:
+
+* a typed Python control plane (resource model + async task engine + REST API)
+  replacing Django ORM / Celery / DRF (reference ``core/apps/``),
+* an idempotent **step runner** over pluggable SSH executors replacing the
+  embedded Ansible engine (reference ``core/apps/ansible_api/``),
+* a Terraform-backed **GCE/TPU provider** that plans TPU pod-slice worker
+  pools next to CPU control-plane VMs (replacing the vSphere/OpenStack
+  providers in ``core/apps/cloud_provider/``),
+* a **JAX/XLA workload layer** (``models/``, ``parallel/``, ``ops/``,
+  ``train/``): flax models, GSPMD mesh parallelism (dp/fsdp/tp/sp + ring
+  attention), Pallas TPU kernels, and an MFU-accounted trainer — the
+  TPU-native replacement for the reference's GPU role triple + KubeApps
+  TensorFlow/PyTorch charts.
+
+Heavy submodules (anything importing jax) are NOT imported here so the
+control plane stays usable on machines without an accelerator stack.
+"""
+
+from kubeoperator_tpu.version import __version__
+
+__all__ = ["__version__"]

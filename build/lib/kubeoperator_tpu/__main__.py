@@ -1,0 +1,60 @@
+"""CLI entry point (reference: ``core/kubeops.py`` supervisor + ``kubeopsctl.sh``).
+
+No gunicorn/celery/beat process zoo: one process runs the aiohttp server,
+the threaded task engine, and the beat schedules.
+
+    python -m kubeoperator_tpu serve [--host H] [--port P]
+    python -m kubeoperator_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeoperator-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", help="run API server + task engine + beat")
+    serve.add_argument("--host", default=None)
+    serve.add_argument("--port", type=int, default=None)
+    serve.add_argument("--no-beat", action="store_true",
+                       help="skip monitor/health/backup schedules")
+    sub.add_parser("version")
+    sub.add_parser("ctl", help="API client (ko): clusters/ops/hosts/logs",
+                   add_help=False)
+
+    # forward everything after "ctl" untouched: argparse REMAINDER drops a
+    # leading option (e.g. `ctl --help`), so slice argv by hand
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "ctl":
+        from kubeoperator_tpu.ctl import main as ctl_main
+        return ctl_main(raw[1:])
+    args = parser.parse_args(argv)
+
+    if args.cmd == "version":
+        from kubeoperator_tpu.version import __version__
+        print(__version__)
+        return 0
+
+    from kubeoperator_tpu.api.app import ensure_admin, run_server
+    from kubeoperator_tpu.services import backups, healing, ldap_auth, monitor
+    from kubeoperator_tpu.services.platform import Platform
+
+    platform = Platform()
+    ensure_admin(platform)
+    if not args.no_beat:
+        monitor.schedule(platform)
+        backups.schedule(platform)
+        ldap_auth.schedule(platform)
+        healing.schedule(platform)
+    try:
+        run_server(platform, host=args.host, port=args.port)
+    finally:
+        platform.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""REST + WebSocket API (reference: DRF ViewSets + Channels consumers,
+``kubeops_api/api_url.py:15-60``, ``kubeoperator/routing.py:10-18``).
+
+Built on aiohttp (the only async HTTP stack in the image); handlers call the
+synchronous Platform facade through the default thread-pool executor so
+sqlite/SSH work never blocks the event loop.
+"""
+
+from kubeoperator_tpu.api.app import create_app, run_server

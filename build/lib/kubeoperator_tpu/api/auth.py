@@ -1,0 +1,52 @@
+"""JWT auth (reference: DRF JWT login, ``settings.py:192-195,218-223``).
+
+HS256 implemented over stdlib hmac/hashlib — pyjwt is not in the image and
+the token format is 30 lines. Tokens carry ``sub`` (user name), ``adm`` and
+``exp``; the signing key is per-deployment (config ``secret_key``, generated
+and persisted on first boot).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+
+class AuthError(Exception):
+    pass
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def encode(claims: dict, key: str, ttl_s: int = 8 * 3600) -> str:
+    header = {"alg": "HS256", "typ": "JWT"}
+    payload = {**claims, "exp": int(time.time()) + ttl_s}
+    signing = f"{_b64(json.dumps(header).encode())}.{_b64(json.dumps(payload).encode())}"
+    sig = hmac.new(key.encode(), signing.encode(), hashlib.sha256).digest()
+    return f"{signing}.{_b64(sig)}"
+
+
+def decode(token: str, key: str) -> dict:
+    try:
+        signing, _, sig = token.rpartition(".")
+        head_b64, _, payload_b64 = signing.partition(".")
+        want = hmac.new(key.encode(), signing.encode(), hashlib.sha256).digest()
+        if not hmac.compare_digest(want, _unb64(sig)):
+            raise AuthError("bad signature")
+        payload = json.loads(_unb64(payload_b64))
+    except AuthError:
+        raise
+    except Exception as e:  # malformed structure/base64/json
+        raise AuthError(f"malformed token: {type(e).__name__}") from e
+    if payload.get("exp", 0) < time.time():
+        raise AuthError("token expired")
+    return payload

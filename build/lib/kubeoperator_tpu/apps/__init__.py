@@ -1,0 +1,13 @@
+"""Built-in app store.
+
+The reference vendors charts under ``roles/manifests/files/manifests/``
+(dashboard, ingress, kubeapps-plus, prometheus+grafana+loki, weave-scope)
+and serves user apps through KubeApps. Here the store is a manifest
+registry whose AI entries are JAX/XLA TPU workloads (north star: "the
+built-in AI app store runs training/inference on TPU with no GPU node in
+the loop").
+"""
+
+from kubeoperator_tpu.apps.manifests import render_app, list_apps
+
+__all__ = ["render_app", "list_apps"]

@@ -1,0 +1,414 @@
+"""Manifest templates for built-in apps.
+
+System/monitoring manifests are deliberately compact (they are stand-ins
+for the vendored upstream charts the reference ships); the TPU workload
+manifests are the real product: they encode slice gang-scheduling,
+``google.com/tpu`` resources, and JAX distributed initialization via the
+tpu.env written by the accelerator step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_SYSTEM = {
+    "coredns": """apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: coredns, namespace: kube-system}}
+spec:
+  replicas: 2
+  selector: {{matchLabels: {{k8s-app: coredns}}}}
+  template:
+    metadata: {{labels: {{k8s-app: coredns}}}}
+    spec:
+      containers:
+      - name: coredns
+        image: "{registry}/coredns:1.11"
+        args: ["-conf", "/etc/coredns/Corefile"]
+        volumeMounts: [{{name: config, mountPath: /etc/coredns}}]
+      volumes: [{{name: config, configMap: {{name: coredns}}}}]
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: coredns, namespace: kube-system}}
+data:
+  Corefile: |
+    .:53 {{
+        errors
+        health
+        kubernetes cluster.local in-addr.arpa ip6.arpa
+        forward . /etc/resolv.conf
+        cache 30
+    }}
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: kube-dns, namespace: kube-system}}
+spec:
+  clusterIP: 10.68.0.2
+  selector: {{k8s-app: coredns}}
+  ports: [{{name: dns, port: 53, protocol: UDP}},
+          {{name: dns-tcp, port: 53, protocol: TCP}}]
+""",
+    "dashboard": """apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: kubernetes-dashboard, namespace: kube-system}}
+spec:
+  selector: {{matchLabels: {{k8s-app: dashboard}}}}
+  template:
+    metadata: {{labels: {{k8s-app: dashboard}}}}
+    spec:
+      containers:
+      - name: dashboard
+        image: "{registry}/dashboard:v2.7"
+        args: ["--namespace=kube-system"]
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: kubernetes-dashboard, namespace: kube-system}}
+spec: {{selector: {{k8s-app: dashboard}}, ports: [{{port: 443, targetPort: 8443}}]}}
+""",
+    # the ingress controller is the spine the control plane monitors
+    # through: nodePort 30910 + Host headers (prometheus.apps.ko /
+    # loki.apps.ko / grafana.apps.ko) — services/monitor.py PromClient and
+    # LokiClient point at exactly this route (reference apps_client.py
+    # Host-header trick).
+    "ingress-nginx": """apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: ingress-nginx, namespace: ingress-nginx}}
+spec:
+  selector: {{matchLabels: {{app: ingress-nginx}}}}
+  template:
+    metadata: {{labels: {{app: ingress-nginx}}}}
+    spec:
+      containers:
+      - name: controller
+        image: "{registry}/ingress-nginx:v1.9"
+        args: ["/nginx-ingress-controller",
+               "--ingress-class=nginx"]
+        ports: [{{containerPort: 80}}]
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: ingress-nginx, namespace: ingress-nginx}}
+spec:
+  type: NodePort
+  selector: {{app: ingress-nginx}}
+  ports: [{{port: 80, nodePort: 30910}}]
+""",
+    "prometheus": """apiVersion: v1
+kind: ServiceAccount
+metadata: {{name: prometheus, namespace: monitoring}}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata: {{name: prometheus}}
+rules:
+- apiGroups: [""]
+  resources: [nodes, nodes/metrics, services, endpoints, pods]
+  verbs: [get, list, watch]
+- nonResourceURLs: [/metrics]
+  verbs: [get]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata: {{name: prometheus}}
+roleRef: {{apiGroup: rbac.authorization.k8s.io, kind: ClusterRole, name: prometheus}}
+subjects: [{{kind: ServiceAccount, name: prometheus, namespace: monitoring}}]
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: prometheus, namespace: monitoring}}
+spec:
+  selector: {{matchLabels: {{app: prometheus}}}}
+  template:
+    metadata: {{labels: {{app: prometheus}}}}
+    spec:
+      serviceAccountName: prometheus
+      containers:
+      - name: prometheus
+        image: "{registry}/prometheus:v2.50"
+        args: ["--config.file=/etc/prometheus/prometheus.yml"]
+        volumeMounts: [{{name: config, mountPath: /etc/prometheus}}]
+      volumes: [{{name: config, configMap: {{name: prometheus}}}}]
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: prometheus, namespace: monitoring}}
+data:
+  prometheus.yml: |
+    global: {{scrape_interval: 30s}}
+    scrape_configs:
+    - job_name: apiserver
+      kubernetes_sd_configs: [{{role: endpoints}}]
+      scheme: https
+      tls_config: {{insecure_skip_verify: true}}
+    - job_name: node
+      kubernetes_sd_configs: [{{role: node}}]
+    - job_name: tpu
+      # libtpu exposes tensorcore utilization on :8431 (tpu-device-plugin)
+      kubernetes_sd_configs: [{{role: pod}}]
+      relabel_configs:
+      - source_labels: [__meta_kubernetes_pod_label_ko_accelerator]
+        regex: tpu
+        action: keep
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: prometheus, namespace: monitoring}}
+spec: {{selector: {{app: prometheus}}, ports: [{{port: 9090}}]}}
+---
+apiVersion: networking.k8s.io/v1
+kind: Ingress
+metadata: {{name: prometheus, namespace: monitoring}}
+spec:
+  ingressClassName: nginx
+  rules:
+  - host: prometheus.apps.ko
+    http:
+      paths:
+      - path: /
+        pathType: Prefix
+        backend: {{service: {{name: prometheus, port: {{number: 9090}}}}}}
+""",
+    "grafana": """apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: grafana, namespace: monitoring}}
+spec:
+  selector: {{matchLabels: {{app: grafana}}}}
+  template:
+    metadata: {{labels: {{app: grafana}}}}
+    spec:
+      containers:
+      - name: grafana
+        image: "{registry}/grafana:10"
+        volumeMounts: [{{name: datasources, mountPath: /etc/grafana/provisioning/datasources}}]
+      volumes: [{{name: datasources, configMap: {{name: grafana-datasources}}}}]
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: grafana-datasources, namespace: monitoring}}
+data:
+  ds.yaml: |
+    apiVersion: 1
+    datasources:
+    - {{name: Prometheus, type: prometheus, url: "http://prometheus:9090"}}
+    - {{name: Loki, type: loki, url: "http://loki:3100"}}
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: grafana, namespace: monitoring}}
+spec: {{selector: {{app: grafana}}, ports: [{{port: 3000}}]}}
+---
+apiVersion: networking.k8s.io/v1
+kind: Ingress
+metadata: {{name: grafana, namespace: monitoring}}
+spec:
+  ingressClassName: nginx
+  rules:
+  - host: grafana.apps.ko
+    http:
+      paths:
+      - path: /
+        pathType: Prefix
+        backend: {{service: {{name: grafana, port: {{number: 3000}}}}}}
+""",
+    "loki": """apiVersion: apps/v1
+kind: StatefulSet
+metadata: {{name: loki, namespace: monitoring}}
+spec:
+  selector: {{matchLabels: {{app: loki}}}}
+  serviceName: loki
+  template:
+    metadata: {{labels: {{app: loki}}}}
+    spec:
+      containers:
+      - name: loki
+        image: "{registry}/loki:2.9"
+        args: ["-config.file=/etc/loki/loki.yml"]
+        volumeMounts: [{{name: config, mountPath: /etc/loki}}]
+      volumes: [{{name: config, configMap: {{name: loki}}}}]
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: loki, namespace: monitoring}}
+data:
+  loki.yml: |
+    auth_enabled: false
+    server: {{http_listen_port: 3100}}
+    common:
+      ring: {{kvstore: {{store: inmemory}}}}
+      replication_factor: 1
+      path_prefix: /tmp/loki
+    schema_config:
+      configs:
+      - from: "2024-01-01"
+        store: tsdb
+        object_store: filesystem
+        schema: v13
+        index: {{prefix: index_, period: 24h}}
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: loki, namespace: monitoring}}
+spec: {{selector: {{app: loki}}, ports: [{{port: 3100}}]}}
+---
+apiVersion: networking.k8s.io/v1
+kind: Ingress
+metadata: {{name: loki, namespace: monitoring}}
+spec:
+  ingressClassName: nginx
+  rules:
+  - host: loki.apps.ko
+    http:
+      paths:
+      - path: /
+        pathType: Prefix
+        backend: {{service: {{name: loki, port: {{number: 3100}}}}}}
+""",
+    "kubeapps": """apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: kubeapps, namespace: kubeapps}}
+spec:
+  selector: {{matchLabels: {{app: kubeapps}}}}
+  template:
+    metadata: {{labels: {{app: kubeapps}}}}
+    spec:
+      containers:
+      - {{name: kubeapps, image: "{registry}/kubeapps:2.9"}}
+      - {{name: chartmuseum, image: "{registry}/chartmuseum:0.16"}}
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: kubeapps, namespace: kubeapps}}
+spec: {{selector: {{app: kubeapps}}, ports: [{{port: 8080}}]}}
+---
+apiVersion: networking.k8s.io/v1
+kind: Ingress
+metadata: {{name: kubeapps, namespace: kubeapps}}
+spec:
+  ingressClassName: nginx
+  rules:
+  - host: apps.apps.ko
+    http:
+      paths:
+      - path: /
+        pathType: Prefix
+        backend: {{service: {{name: kubeapps, port: {{number: 8080}}}}}}
+""",
+    "weave-scope": """apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: weave-scope, namespace: weave}}
+spec:
+  selector: {{matchLabels: {{app: weave-scope}}}}
+  template:
+    metadata: {{labels: {{app: weave-scope}}}}
+    spec:
+      containers: [{{name: agent, image: "{registry}/weave-scope:1.13"}}]
+""",
+}
+
+# -- workload charts (the AI app store) -------------------------------------
+
+_WORKLOADS = {
+    # CPU sanity workload (BASELINE config 1)
+    "tf-mnist": """apiVersion: batch/v1
+kind: Job
+metadata: {{name: tf-mnist, namespace: default}}
+spec:
+  template:
+    spec:
+      restartPolicy: Never
+      containers:
+      - name: trainer
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "mnist"]
+        resources: {{limits: {{cpu: "4", memory: 8Gi}}}}
+""",
+    # single-host TPU smoke test (BASELINE config 2)
+    "jax-smoke": """apiVersion: batch/v1
+kind: Job
+metadata: {{name: jax-smoke, namespace: default}}
+spec:
+  template:
+    metadata: {{labels: {{ko-accelerator: tpu}}}}
+    spec:
+      restartPolicy: Never
+      nodeSelector: {{ko.accelerator: tpu}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      containers:
+      - name: smoke
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "smoke"]
+        resources: {{limits: {{google.com/tpu: "4"}}}}
+        volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
+      volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
+""",
+    # distributed ResNet50 over a pod slice (BASELINE config 5):
+    # a StatefulSet with one pod per slice host; jax.distributed.initialize
+    # reads TPU_WORKER_ID / TPU_WORKER_HOSTNAMES from the mounted tpu.env.
+    "jax-resnet50": """apiVersion: apps/v1
+kind: StatefulSet
+metadata: {{name: jax-resnet50, namespace: default}}
+spec:
+  serviceName: jax-resnet50
+  replicas: {slice_hosts}
+  podManagementPolicy: Parallel
+  selector: {{matchLabels: {{app: jax-resnet50}}}}
+  template:
+    metadata: {{labels: {{app: jax-resnet50, ko-accelerator: tpu}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: tpu, ko.tpu/slice: "{slice_id}"}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      affinity:
+        podAntiAffinity:
+          requiredDuringSchedulingIgnoredDuringExecution:
+          - labelSelector: {{matchLabels: {{app: jax-resnet50}}}}
+            topologyKey: kubernetes.io/hostname
+      containers:
+      - name: trainer
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "resnet50",
+                  "--batch-per-chip", "256", "--steps", "200"]
+        resources: {{limits: {{google.com/tpu: "4"}}}}
+        volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
+      volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
+""",
+    "jax-llm-train": """apiVersion: apps/v1
+kind: StatefulSet
+metadata: {{name: jax-llm-train, namespace: default}}
+spec:
+  serviceName: jax-llm-train
+  replicas: {slice_hosts}
+  podManagementPolicy: Parallel
+  selector: {{matchLabels: {{app: jax-llm-train}}}}
+  template:
+    metadata: {{labels: {{app: jax-llm-train, ko-accelerator: tpu}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: tpu, ko.tpu/slice: "{slice_id}"}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      containers:
+      - name: trainer
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "llm",
+                  "--seq-len", "8192", "--mesh", "dp:auto,tp:4"]
+        resources: {{limits: {{google.com/tpu: "4"}}}}
+        volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
+      volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
+""",
+}
+
+
+def list_apps() -> list[str]:
+    return sorted(_SYSTEM) + sorted(_WORKLOADS)
+
+
+def render_app(name: str, registry: str, vars: dict[str, Any] | None = None) -> str | None:
+    vars = vars or {}
+    params = {
+        "registry": registry,
+        "slice_hosts": vars.get("slice_hosts", 1),
+        "slice_id": vars.get("slice_id", ""),
+    }
+    tmpl = _SYSTEM.get(name) or _WORKLOADS.get(name)
+    return tmpl.format(**params) if tmpl else None

@@ -1,0 +1,4 @@
+from kubeoperator_tpu.config.loader import Config, load_config
+from kubeoperator_tpu.config.catalog import Catalog, load_catalog
+
+__all__ = ["Config", "load_config", "Catalog", "load_catalog"]

@@ -1,0 +1,132 @@
+"""Typed access to the declarative catalog (``catalog.yml``).
+
+The reference loads its catalog ad hoc with ``yaml.load`` at call sites
+(``cluster.py:242-245``); here the catalog is parsed once into a typed
+object the engine, planner, and API all share.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Any
+
+import yaml
+
+CATALOG_PATH = os.path.join(os.path.dirname(__file__), "catalog.yml")
+
+
+@dataclass(frozen=True)
+class StepDef:
+    name: str
+    module: str
+    targets: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class TpuSlice:
+    type: str
+    hosts: int
+    chips_per_host: int
+    chips: int
+    gen: str
+    ici: str
+
+
+@dataclass(frozen=True)
+class ComputeModel:
+    name: str
+    cpu: int
+    memory_gb: int
+    disk_gb: int
+
+
+@dataclass
+class Catalog:
+    raw: dict[str, Any]
+    steps: dict[str, StepDef] = field(default_factory=dict)
+    operations: dict[str, list[str]] = field(default_factory=dict)
+    roles: dict[str, dict] = field(default_factory=dict)
+    networks: list[dict] = field(default_factory=list)
+    storages: list[dict] = field(default_factory=list)
+    accelerators: dict[str, dict] = field(default_factory=dict)
+    templates: list[dict] = field(default_factory=list)
+    tpu_slices: dict[str, TpuSlice] = field(default_factory=dict)
+    compute_models: dict[str, ComputeModel] = field(default_factory=dict)
+    apps: list[dict] = field(default_factory=list)
+
+    # -- queries ----------------------------------------------------------
+    def operation_steps(self, operation: str) -> list[StepDef]:
+        if operation not in self.operations:
+            raise KeyError(f"unknown operation {operation!r}; have {sorted(self.operations)}")
+        return [self.steps[s] for s in self.operations[operation]]
+
+    def template(self, name: str) -> dict:
+        for t in self.templates:
+            if t["name"] == name:
+                return t
+        raise KeyError(f"unknown deploy template {name!r}")
+
+    def network(self, name: str) -> dict:
+        for n in self.networks:
+            if n["name"] == name:
+                return n
+        raise KeyError(f"unknown network plugin {name!r}")
+
+    def storage(self, name: str) -> dict:
+        for s in self.storages:
+            if s["name"] == name:
+                return s
+        raise KeyError(f"unknown storage provider {name!r}")
+
+    def slice(self, type_: str) -> TpuSlice:
+        try:
+            return self.tpu_slices[type_]
+        except KeyError:
+            raise KeyError(f"unknown TPU slice type {type_!r}; have {sorted(self.tpu_slices)}")
+
+    def grade_host(self, template: str, role: str, cpu: int, memory_gb: int,
+                   disk_gb: float | None = None) -> str:
+        """Planner grading used by the UI host picker (reference
+        ``config.yml:293-453`` requirement specs): unfit/minimal/recommended.
+        ``disk_gb=None`` skips the disk check (facts not gathered yet)."""
+        req = self.template(template)["requires"].get(role)
+        if req is None:
+            return "recommended"
+        if cpu < req["cpu"] or memory_gb < req["memory_gb"]:
+            return "unfit"
+        if disk_gb is not None and disk_gb < req.get("disk_gb", 0):
+            return "unfit"
+        rec = req.get("recommend", {})
+        if cpu >= rec.get("cpu", 10**9) and memory_gb >= rec.get("memory_gb", 10**9):
+            return "recommended"
+        return "minimal"
+
+
+def _parse(raw: dict[str, Any]) -> Catalog:
+    cat = Catalog(raw=raw)
+    for name, spec in raw.get("steps", {}).items():
+        cat.steps[name] = StepDef(name=name, module=spec["module"], targets=tuple(spec["targets"]))
+    cat.operations = {k: list(v) for k, v in raw.get("operations", {}).items()}
+    for op, steps in cat.operations.items():
+        missing = [s for s in steps if s not in cat.steps]
+        if missing:
+            raise ValueError(f"operation {op!r} references undefined steps {missing}")
+    cat.roles = raw.get("roles", {})
+    cat.networks = raw.get("networks", [])
+    cat.storages = raw.get("storages", [])
+    cat.accelerators = raw.get("accelerators", {})
+    cat.templates = raw.get("templates", [])
+    for s in raw.get("tpu_slices", []):
+        cat.tpu_slices[s["type"]] = TpuSlice(**s)
+    for m in raw.get("compute_models", []):
+        cat.compute_models[m["name"]] = ComputeModel(**m)
+    cat.apps = raw.get("apps", [])
+    return cat
+
+
+@lru_cache(maxsize=8)
+def load_catalog(path: str = CATALOG_PATH) -> Catalog:
+    with open(path) as f:
+        return _parse(yaml.safe_load(f))

@@ -1,0 +1,125 @@
+"""Layered process configuration.
+
+Replaces the reference's Flask-style ``Config`` class
+(``core/apps/kubeoperator/conf.py:31-120``), which loads a user
+``config.yml`` over hardcoded defaults. Layers, lowest to highest
+precedence:
+
+1. built-in defaults (``DEFAULTS``)
+2. a YAML file (``KO_CONFIG`` env var, or ``config.yml`` in the data dir)
+3. environment variables prefixed ``KO_`` (e.g. ``KO_DATA_DIR``)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import yaml
+
+DEFAULTS: dict[str, Any] = {
+    # paths
+    "data_dir": "data",                     # reference settings.py BASE_DIR/data
+    "db_path": None,                        # default: <data_dir>/kubeoperator.sqlite3
+    "task_log_dir": None,                   # default: <data_dir>/tasks (ref: data/celery)
+    "project_dir": None,                    # default: <data_dir>/projects (ref: data/ansible)
+    "terraform_dir": None,                  # default: <data_dir>/terraform
+    "package_dir": None,                    # default: <data_dir>/packages
+    "backup_dir": None,                     # default: <data_dir>/backups
+    # engine
+    "task_workers": 4,                      # ref: celery -c 4 (core/kubeops.py:28)
+    "node_forks": 10,                       # ref: ansible forks=5 (runner.py:39); TPU pools are bigger
+    "step_retry": 1,
+    "ssh_connect_timeout": 10,
+    # api
+    "bind_host": "127.0.0.1",
+    "repo_host": "",                        # node-reachable controller addr for
+                                            # the /repo package plane (KO_REPO_HOST)
+    "bind_port": 8000,
+    "auth_secret": "kubeoperator-tpu-dev-key",
+    "token_ttl_hours": 24,                  # ref JWT_AUTH expiration (settings.py:218-223)
+    # monitoring cadence (seconds); ref kubeops_api/tasks.py:40-89 (5 min / hourly / daily)
+    "monitor_interval": 300,
+    "health_interval": 300,
+    "backup_hour": 1,
+    # executor selection: "ssh" | "fake"
+    "executor": "ssh",
+    # terraform binary ("" -> fake apply)
+    "terraform_bin": "terraform",
+}
+
+
+class Config(dict):
+    """Dict with attribute access and path helpers."""
+
+    def __getattr__(self, k: str) -> Any:
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    # -- derived paths ----------------------------------------------------
+    def path(self, key: str, default_subdir: str) -> str:
+        val = self.get(key)
+        if not val:
+            val = os.path.join(self["data_dir"], default_subdir)
+        os.makedirs(val, exist_ok=True)
+        return val
+
+    @property
+    def database(self) -> str:
+        if self.get("db_path"):
+            return self["db_path"]
+        os.makedirs(self["data_dir"], exist_ok=True)
+        return os.path.join(self["data_dir"], "kubeoperator.sqlite3")
+
+    @property
+    def task_logs(self) -> str:
+        return self.path("task_log_dir", "tasks")
+
+    @property
+    def projects(self) -> str:
+        return self.path("project_dir", "projects")
+
+    @property
+    def terraform(self) -> str:
+        return self.path("terraform_dir", "terraform")
+
+    @property
+    def packages(self) -> str:
+        return self.path("package_dir", "packages")
+
+    @property
+    def backups(self) -> str:
+        return self.path("backup_dir", "backups")
+
+
+def _coerce(value: str, like: Any) -> Any:
+    if isinstance(like, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        return int(value)
+    if isinstance(like, float):
+        return float(value)
+    return value
+
+
+def load_config(path: str | None = None, overrides: Mapping[str, Any] | None = None) -> Config:
+    cfg = Config(DEFAULTS)
+    path = path or os.environ.get("KO_CONFIG")
+    if path:
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"config file {path} not found (from KO_CONFIG or argument)")
+        with open(path) as f:
+            user = yaml.safe_load(f) or {}
+        if not isinstance(user, dict):
+            raise ValueError(f"config file {path} must contain a mapping")
+        cfg.update(user)
+    for key, default in DEFAULTS.items():
+        env = os.environ.get("KO_" + key.upper())
+        if env is not None:
+            cfg[key] = _coerce(env, default)
+    if overrides:
+        cfg.update(overrides)
+    return cfg

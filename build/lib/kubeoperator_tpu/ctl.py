@@ -1,0 +1,244 @@
+"""``ko`` — CLI client for the REST API (``python -m kubeoperator_tpu ctl``).
+
+The reference is driven by its Angular UI only; a terminal client costs
+little and makes the platform scriptable: login once (token cached under
+``~/.config/kubeoperator-tpu/``), then list/inspect/operate clusters,
+hosts, packages, and executions. Zero dependencies — stdlib urllib.
+
+    ko login http://controller:8000 admin
+    ko clusters
+    ko cluster demo
+    ko op demo install            # streams step progress until done
+    ko retry <execution-id>
+    ko hosts | ko packages | ko logs --query error
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+CONFIG_DIR = os.path.expanduser("~/.config/kubeoperator-tpu")
+CONFIG = os.path.join(CONFIG_DIR, "client.json")
+
+
+class ApiError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, server: str = "", token: str = ""):
+        if not server:
+            cfg = self._load()
+            server, token = cfg.get("server", ""), cfg.get("token", "")
+        if not server:
+            raise ApiError("not logged in — run: ko login <server> <user>")
+        self.server = server.rstrip("/")
+        self.token = token
+
+    @staticmethod
+    def _load() -> dict:
+        try:
+            with open(CONFIG) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    @staticmethod
+    def save(server: str, token: str) -> None:
+        os.makedirs(CONFIG_DIR, exist_ok=True)
+        # 0600 from creation: open()+chmod would expose the token for a
+        # moment on umask-022 machines
+        fd = os.open(CONFIG, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump({"server": server, "token": token}, f)
+
+    def call(self, method: str, path: str, body: dict | None = None):
+        req = urllib.request.Request(
+            self.server + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Authorization": f"Bearer {self.token}",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:  # noqa: BLE001
+                detail = ""
+            raise ApiError(f"{method} {path} -> {e.code}: {detail}") from e
+        except urllib.error.URLError as e:
+            raise ApiError(f"cannot reach {self.server}: {e.reason}") from e
+
+
+def table(rows: list[dict], columns: list[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = [max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in columns]
+    print("  ".join(c.upper().ljust(w) for c, w in zip(columns, widths)))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(w) for c, w in zip(columns, widths)))
+
+
+def cmd_login(args) -> int:
+    password = args.password or getpass.getpass(f"password for {args.user}: ")
+    req = urllib.request.Request(
+        args.server.rstrip("/") + "/api/v1/auth/login", method="POST",
+        data=json.dumps({"username": args.user, "password": password}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            token = json.loads(resp.read())["token"]
+    except urllib.error.HTTPError as e:
+        raise ApiError("login rejected (wrong credentials?)"
+                       if e.code == 401 else f"login failed: HTTP {e.code}") from e
+    except urllib.error.URLError as e:
+        raise ApiError(f"cannot reach {args.server}: {e.reason}") from e
+    Client.save(args.server.rstrip("/"), token)
+    print(f"logged in to {args.server} as {args.user}")
+    return 0
+
+
+def cmd_clusters(args) -> int:
+    table(Client().call("GET", "/api/v1/clusters"),
+          ["name", "status", "template", "network_plugin", "deploy_type"])
+    return 0
+
+
+def cmd_cluster(args) -> int:
+    c = Client()
+    print(json.dumps(c.call("GET", f"/api/v1/clusters/{args.name}"), indent=2))
+    nodes = c.call("GET", f"/api/v1/clusters/{args.name}/nodes")
+    table(nodes, ["name", "roles"])
+    return 0
+
+
+def _watch(c: Client, ex_id: str) -> int:
+    """Poll the execution until terminal, printing step transitions."""
+    seen: dict[str, str] = {}
+    while True:
+        ex = c.call("GET", f"/api/v1/executions/{ex_id}")
+        for s in ex.get("steps", []):
+            if seen.get(s["name"]) != s["status"]:
+                seen[s["name"]] = s["status"]
+                mark = {"success": "✔", "error": "✘", "running": "▶",
+                        "skipped": "↷"}.get(s["status"], "·")
+                print(f"  {mark} {s['name']} {s.get('message', '')}".rstrip())
+        if ex["state"] in ("SUCCESS", "FAILURE"):
+            print(f"{ex['operation']} {ex['state']}")
+            return 0 if ex["state"] == "SUCCESS" else 1
+        time.sleep(2)
+
+
+def cmd_op(args) -> int:
+    c = Client()
+    body = {"operation": args.operation}
+    if args.param:
+        bad = [p for p in args.param if "=" not in p]
+        if bad:
+            raise ApiError(f"--param must be KEY=VALUE, got {bad}")
+        body["params"] = dict(p.split("=", 1) for p in args.param)
+    ex = c.call("POST", f"/api/v1/clusters/{args.name}/executions", body)
+    print(f"execution {ex['id']}")
+    return _watch(c, ex["id"]) if not args.no_wait else 0
+
+
+def cmd_retry(args) -> int:
+    c = Client()
+    ex = c.call("POST", f"/api/v1/executions/{args.id}/retry")
+    print(f"retry execution {ex['id']}")
+    return _watch(c, ex["id"]) if not args.no_wait else 0
+
+
+def cmd_hosts(args) -> int:
+    table(Client().call("GET", "/api/v1/hosts"),
+          ["name", "ip", "cpu_core", "tpu_type", "tpu_slice_id", "project"])
+    return 0
+
+
+def cmd_packages(args) -> int:
+    pkgs = Client().call("GET", "/api/v1/packages")
+    table([{"name": p["name"],
+            "kube_version": p.get("meta", {}).get("vars", {}).get("kube_version", "")}
+           for p in pkgs], ["name", "kube_version"])
+    return 0
+
+
+def cmd_logs(args) -> int:
+    q = f"?query={urllib.parse.quote(args.query)}&level={args.level}&limit={args.limit}"
+    for rec in reversed(Client().call("GET", "/api/v1/logs" + q)["logs"]):
+        print(f"{rec['ts']} {rec['level']:7s} {rec['message']}")
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    d = Client().call("GET", "/api/v1/dashboard/all")
+    print(f"clusters: {d['cluster_count']} (running {d['running']}, "
+          f"error {d['error']}) · nodes {d['node_count']} · pods {d['pod_count']}")
+    for s in d.get("degraded_slices", []):
+        print(f"  DEGRADED slice {s['slice']} on {s['cluster']}: down {s['down']}")
+    return 0
+
+
+def build_parser(sub) -> None:
+    """Register the ``ctl`` subcommands on the main argument parser."""
+    login = sub.add_parser("login", help="authenticate against a controller")
+    login.add_argument("server")
+    login.add_argument("user")
+    login.add_argument("--password", default=None)
+    login.set_defaults(fn=cmd_login)
+
+    sub.add_parser("clusters", help="list clusters").set_defaults(fn=cmd_clusters)
+    one = sub.add_parser("cluster", help="cluster detail + nodes")
+    one.add_argument("name")
+    one.set_defaults(fn=cmd_cluster)
+
+    op = sub.add_parser("op", help="run an operation and stream progress")
+    op.add_argument("name")
+    # no client-side choices: the server's catalog is authoritative and a
+    # stale list here would reject valid operations (e.g. lb-config)
+    op.add_argument("operation")
+    op.add_argument("--param", action="append", default=[],
+                    metavar="KEY=VALUE")
+    op.add_argument("--no-wait", action="store_true")
+    op.set_defaults(fn=cmd_op)
+
+    retry = sub.add_parser("retry", help="resume a failed execution")
+    retry.add_argument("id")
+    retry.add_argument("--no-wait", action="store_true")
+    retry.set_defaults(fn=cmd_retry)
+
+    sub.add_parser("hosts", help="list hosts").set_defaults(fn=cmd_hosts)
+    sub.add_parser("packages", help="list offline packages").set_defaults(fn=cmd_packages)
+    sub.add_parser("dashboard", help="fleet summary").set_defaults(fn=cmd_dashboard)
+
+    logs = sub.add_parser("logs", help="search system logs")
+    logs.add_argument("--query", default="")
+    logs.add_argument("--level", default="")
+    logs.add_argument("--limit", default="100")
+    logs.set_defaults(fn=cmd_logs)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="ko")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    build_parser(sub)
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

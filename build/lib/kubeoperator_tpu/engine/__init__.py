@@ -1,0 +1,12 @@
+"""Execution engine.
+
+The TPU-native replacement for the reference's embedded Ansible engine
+(``core/apps/ansible_api/``) + Celery runtime (``core/apps/celery_api/``):
+
+* ``executor``  — pluggable node transports (SSH subprocess, local, fake)
+* ``inventory`` — in-memory host/group/var resolution from the store
+* ``tasks``     — threaded async task engine with per-task log files
+* ``steps``     — idempotent Python step modules (replacing Ansible roles)
+* ``operations``— the DeployExecution driver (replacing ``deploy.py``)
+* ``adhoc``     — typed one-off node operations (facts, ping, drain)
+"""

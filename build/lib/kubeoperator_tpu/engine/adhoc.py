@@ -1,0 +1,87 @@
+"""Typed ad-hoc node operations (reference: ``kubeops_api/adhoc.py`` —
+gather_host_info / test_host / get_host_time / fetch_cluster_config).
+
+Facts gathering is the accelerator-detection path: the reference probes
+GPUs with ``lspci | grep -i nvidia`` (``utils/gpu.py:1-9``); the TPU
+mirror probes the GCE metadata server for ``accelerator-type`` — present
+exactly on TPU VMs."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.executor import Conn, Executor
+from kubeoperator_tpu.resources.entities import AcceleratorType, Host
+
+METADATA = "http://metadata.google.internal/computeMetadata/v1/instance"
+MD_HDR = "-H 'Metadata-Flavor: Google'"
+
+
+def test_host(executor: Executor, conn: Conn) -> bool:
+    """SSH reachability (reference ``adhoc.py:36-50`` ansible ping)."""
+    return executor.ping(conn)
+
+
+def get_host_time(executor: Executor, conn: Conn) -> str:
+    """NTP-drift input (reference ``adhoc.py:78-91``)."""
+    return executor.run(conn, "date -Is").stdout.strip()
+
+
+def gather_facts(executor: Executor, conn: Conn) -> dict:
+    """Collect cpu/mem/os/disk/accelerator facts in one pass."""
+    facts: dict = {}
+    r = executor.run(conn, "nproc")
+    facts["cpu_core"] = int(r.stdout.strip() or 0) if r.ok else 0
+    r = executor.run(conn, "grep MemTotal /proc/meminfo")
+    try:
+        facts["memory_mb"] = int(r.stdout.split()[1]) // 1024
+    except (IndexError, ValueError):
+        facts["memory_mb"] = 0
+    r = executor.run(conn, '. /etc/os-release && echo "$NAME|$VERSION_ID"')
+    parts = (r.stdout.strip() or "|").split("|")
+    facts["os"], facts["os_version"] = parts[0], parts[-1]
+    r = executor.run(conn, "df -BG --output=size / | tail -1")
+    try:
+        facts["disk_gb"] = float(r.stdout.strip().rstrip("G").split()[-1])
+    except (IndexError, ValueError):
+        facts["disk_gb"] = 0.0
+
+    # GPU probe (reference lspci parity)
+    r = executor.run(conn, "lspci 2>/dev/null | grep -i nvidia | wc -l")
+    gpu_num = int(r.stdout.strip() or 0) if r.ok else 0
+    # TPU probe (GCE metadata; empty/unreachable on non-TPU machines)
+    # -f: a 404 body from the metadata server must not read as a TPU type
+    r = executor.run(conn, f"curl -sf --max-time 3 {MD_HDR} "
+                           f"{METADATA}/attributes/accelerator-type || true")
+    tpu_type = r.stdout.strip() if r.ok else ""
+    if tpu_type:
+        facts["accelerator"] = AcceleratorType.TPU
+        facts["tpu_type"] = tpu_type
+        r = executor.run(conn, f"curl -s --max-time 3 {MD_HDR} "
+                               f"{METADATA}/attributes/agent-worker-number || true")
+        try:
+            facts["tpu_worker_id"] = int(r.stdout.strip())
+        except ValueError:
+            facts["tpu_worker_id"] = 0
+        # slice identity: the TPU name from tpu-env metadata groups the
+        # hosts of one pod slice; fall back to a per-type manual slice
+        r = executor.run(conn, f"curl -s --max-time 3 {MD_HDR} "
+                               f"{METADATA}/attributes/tpu-env || true")
+        import re as _re
+        m = _re.search(r"NODE_NAME:\s*'?([\w-]+)'?", r.stdout or "")
+        facts["tpu_slice_id"] = m.group(1) if m else f"manual-{tpu_type}"
+    elif gpu_num:
+        facts["accelerator"] = AcceleratorType.GPU
+        facts["gpu_num"] = gpu_num
+    else:
+        facts["accelerator"] = AcceleratorType.NONE
+    return facts
+
+
+def apply_facts(host: Host, facts: dict) -> Host:
+    for key in ("cpu_core", "memory_mb", "os", "os_version", "accelerator",
+                "gpu_num", "tpu_type", "tpu_worker_id", "tpu_slice_id"):
+        if key in facts:
+            setattr(host, key, facts[key])
+    if facts.get("disk_gb"):
+        host.volumes = [{"name": "/", "size_gb": facts["disk_gb"]}]
+    host.status = "RUNNING"
+    return host

@@ -1,0 +1,406 @@
+"""Node transports.
+
+The reference talks to nodes through the ansible connection layer
+(paramiko/openssh, ``ansible/runner.py:50-52``; ``common/ssh.py:23-55``).
+Here transports implement a minimal ``Executor`` interface the step modules
+build on:
+
+* ``SSHExecutor``  — OpenSSH subprocess (BatchMode, key auth); no paramiko
+  dependency in this image.
+* ``LocalExecutor``— runs on the controller itself (the reference's
+  "config"/localhost node, ``cluster.py:416-426``).
+* ``FakeExecutor`` — the CI backbone (SURVEY §4: make the fake backend
+  first-class): a virtual filesystem + systemd + canned fact responses per
+  host, with full command history for assertions.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import re
+import shlex
+import subprocess
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubeoperator_tpu.resources.entities import Credential, Host
+from kubeoperator_tpu.utils.secrets import default_box
+
+
+@dataclass
+class Conn:
+    """Resolved connection spec for one host."""
+    ip: str
+    port: int = 22
+    username: str = "root"
+    password: str = ""
+    private_key: str = ""
+
+    @classmethod
+    def from_host(cls, host: Host, credential: Credential | None) -> "Conn":
+        c = credential or Credential()
+        return cls(
+            ip=host.ip, port=host.port, username=c.username,
+            password=default_box().decrypt(c.password) if c.password else "",
+            private_key=default_box().decrypt(c.private_key) if c.private_key else "",
+        )
+
+
+@dataclass
+class ExecResult:
+    rc: int
+    stdout: str = ""
+    stderr: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.rc == 0
+
+    def check(self, what: str = "command") -> "ExecResult":
+        if not self.ok:
+            raise ExecError(f"{what} failed (rc={self.rc}): {self.stderr or self.stdout}")
+        return self
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+class Executor:
+    """Transport interface. ``host`` is always a ``Conn``."""
+
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        raise NotImplementedError
+
+    def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
+        raise NotImplementedError
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        raise NotImplementedError
+
+    def ping(self, conn: Conn) -> bool:
+        return self.run(conn, "true", timeout=10).ok
+
+    def run_many(self, targets: list[tuple[Conn, str]], timeout: int = 300,
+                 max_parallel: int = 32) -> list[ExecResult]:
+        """Run one command per connection, concurrently where the transport
+        supports it. Base implementation is sequential (FakeExecutor relies
+        on it for deterministic histories)."""
+        return [self.run(conn, cmd, timeout=timeout) for conn, cmd in targets]
+
+
+# ---------------------------------------------------------------------------
+
+
+class LocalExecutor(Executor):
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        try:
+            p = subprocess.run(["bash", "-lc", command], capture_output=True,
+                               text=True, timeout=timeout)
+            return ExecResult(p.returncode, p.stdout, p.stderr)
+        except subprocess.TimeoutExpired:
+            return ExecResult(124, "", f"timeout after {timeout}s")
+
+    def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(content)
+        os.chmod(path, mode)
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class SSHExecutor(Executor):
+    """OpenSSH subprocess transport. Key-based auth; the private key from
+    the credential is materialized to a 0600 temp file per executor."""
+
+    def __init__(self, connect_timeout: int = 10):
+        self.connect_timeout = connect_timeout
+        self._keyfiles: dict[str, str] = {}
+        self._lock = threading.Lock()
+        # decrypted keys must not outlive the process: without this, the
+        # SecretBox at-rest encryption is defeated by plaintext in /tmp
+        atexit.register(self.cleanup_keys)
+
+    def _key_path(self, conn: Conn) -> str | None:
+        if not conn.private_key:
+            return None
+        digest = str(hash(conn.private_key))
+        with self._lock:
+            if digest not in self._keyfiles:
+                fd, path = tempfile.mkstemp(prefix="ko-key-")
+                with os.fdopen(fd, "w") as f:
+                    f.write(conn.private_key)
+                os.chmod(path, 0o600)
+                self._keyfiles[digest] = path
+            return self._keyfiles[digest]
+
+    def cleanup_keys(self) -> None:
+        with self._lock:
+            for path in self._keyfiles.values():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self._keyfiles.clear()
+
+    def _base(self, conn: Conn) -> list[str]:
+        args = [
+            "ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+            "-o", f"ConnectTimeout={self.connect_timeout}",
+            "-p", str(conn.port),
+        ]
+        key = self._key_path(conn)
+        if key:
+            args += ["-i", key]
+        args.append(f"{conn.username}@{conn.ip}")
+        return args
+
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        try:
+            p = subprocess.run(self._base(conn) + [command], capture_output=True,
+                               text=True, timeout=timeout)
+            return ExecResult(p.returncode, p.stdout, p.stderr)
+        except subprocess.TimeoutExpired:
+            return ExecResult(124, "", f"timeout after {timeout}s")
+
+    def run_many(self, targets: list[tuple[Conn, str]], timeout: int = 300,
+                 max_parallel: int = 32) -> list[ExecResult]:
+        """Fan out over the koagent C++ thread pool (GIL-free, process-group
+        timeouts); falls back to the sequential base path without the lib."""
+        from kubeoperator_tpu import native
+
+        cmds = [" ".join(shlex.quote(a) for a in self._base(conn)) + " " +
+                shlex.quote(cmd) for conn, cmd in targets]
+        results = native.fanout(cmds, max_parallel=max_parallel,
+                                timeout_s=float(timeout))
+        if results is None:
+            return super().run_many(targets, timeout=timeout,
+                                    max_parallel=max_parallel)
+        return [ExecResult(124 if code == -2 else code, out, err)
+                for code, out, err in results]
+
+    def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
+        d = os.path.dirname(path)
+        quoted = shlex.quote(path)
+        cmd = (f"mkdir -p {shlex.quote(d)} && cat > {quoted} && chmod {mode:o} {quoted}"
+               if d else f"cat > {quoted} && chmod {mode:o} {quoted}")
+        p = subprocess.run(self._base(conn) + [cmd], input=content,
+                           capture_output=True, timeout=120)
+        if p.returncode != 0:
+            raise ExecError(f"put_file {path} failed: {p.stderr.decode(errors='replace')}")
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        p = subprocess.run(self._base(conn) + [f"cat {shlex.quote(path)}"],
+                           capture_output=True, timeout=120)
+        if p.returncode != 0:
+            raise ExecError(f"get_file {path} failed: {p.stderr.decode(errors='replace')}")
+        return p.stdout
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FakeHost:
+    """Virtual node state."""
+    files: dict[str, bytes] = field(default_factory=dict)
+    services: dict[str, str] = field(default_factory=dict)   # unit -> enabled|started
+    facts: dict[str, Any] = field(default_factory=dict)
+    history: list[str] = field(default_factory=list)
+    fail_patterns: list[str] = field(default_factory=list)
+    responses: list[tuple[str, str]] = field(default_factory=list)  # pattern -> stdout
+    down: bool = False
+
+    def respond(self, pattern: str, stdout: str) -> None:
+        """Canned stdout for commands matching ``pattern`` (checked before
+        the built-in shell emulation)."""
+        self.responses.append((pattern, stdout))
+
+
+class FakeExecutor(Executor):
+    """Scriptable in-memory transport.
+
+    ``facts`` per ip: cpu_core, memory_mb, os, os_version, gpu (count),
+    accelerator/tpu_type/tpu_worker_id for TPU metadata probes, disk_gb.
+    Unmatched commands succeed with empty output (idempotent-shell style);
+    ``fail_on(ip, pattern)`` injects failures for failure-path tests.
+    """
+
+    def __init__(self, facts: dict[str, dict] | None = None):
+        self.hosts: dict[str, FakeHost] = {}
+        self._lock = threading.Lock()
+        for ip, f in (facts or {}).items():
+            self.host(ip).facts.update(f)
+
+    def host(self, ip: str) -> FakeHost:
+        with self._lock:
+            if ip not in self.hosts:
+                self.hosts[ip] = FakeHost()
+            return self.hosts[ip]
+
+    def fail_on(self, ip: str, pattern: str) -> None:
+        self.host(ip).fail_patterns.append(pattern)
+
+    def set_down(self, ip: str, down: bool = True) -> None:
+        self.host(ip).down = down
+
+    # -- interface ---------------------------------------------------------
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        h = self.host(conn.ip)
+        h.history.append(command)
+        if h.down:
+            return ExecResult(255, "", "ssh: connect to host timed out")
+        for pat in h.fail_patterns:
+            if re.search(pat, command):
+                return ExecResult(1, "", f"injected failure for /{pat}/")
+        return self._interpret(h, command)
+
+    def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
+        h = self.host(conn.ip)
+        h.history.append(f"put_file {path}")
+        if h.down:
+            raise ExecError("host down")
+        h.files[path] = content
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        h = self.host(conn.ip)
+        h.history.append(f"get_file {path}")
+        if path not in h.files:
+            raise ExecError(f"{path}: no such file")
+        return h.files[path]
+
+    # -- command emulation -------------------------------------------------
+    def _interpret(self, h: FakeHost, command: str) -> ExecResult:
+        facts = h.facts
+        for pat, stdout in h.responses:
+            if re.search(pat, command):
+                return ExecResult(0, stdout)
+        if command.strip() == "true":
+            return ExecResult(0)
+        if m := re.match(r"^rm (-r?f) (.+)$", command.strip()):
+            recursive = "r" in m.group(1)
+            for p in m.group(2).split():
+                p = p.strip("'\"")
+                h.files.pop(p, None)
+                if recursive:
+                    for key in [k for k in h.files if k.startswith(p.rstrip("/") + "/")]:
+                        del h.files[key]
+            return ExecResult(0)
+        if m := re.match(r"^test -[ef] (\S+)$", command.strip()):
+            return ExecResult(0 if m.group(1) in h.files else 1)
+        # `test -e X || curl ... -o X ...` and plain `curl ... -o X ...`:
+        # emulate a fetch from the offline package repo by materializing X
+        if "curl" in command and (m := re.search(r"-o\s+(\S+)", command)):
+            dest = m.group(1).strip("'\"")
+            guard = re.match(r"^test -e (\S+)\s*\|\|", command.strip())
+            if guard and guard.group(1) in h.files:
+                return ExecResult(0)
+            if "healthz" not in command:
+                # content derives from the URL alone (not the whole command)
+                # so checksum tests can precompute the expected digest
+                um = re.search(r"(https?://\S+)", command)
+                url = um.group(1).strip("'\"") if um else dest
+                h.files[dest] = f"fetched:{url}".encode()
+            return ExecResult(0)
+        # `echo '<sha>  <path>' | sha256sum -c -` — download verification
+        if "sha256sum -c" in command:
+            m = re.match(r"^echo '?([0-9a-fA-F]{8,})\s+(\S+?)'? \| sha256sum -c -$",
+                         command.strip())
+            if not m:
+                # a -c invocation the fake can't parse must FAIL, not fall
+                # through to the generic emulation's success — that would
+                # let format drift in ensure_binary pass verification
+                return ExecResult(1, "", "fake: unparseable sha256sum -c")
+            import hashlib as _hl
+            want, p = m.group(1).lower(), m.group(2).strip("'\"")
+            content = h.files.get(p)
+            if content is not None and _hl.sha256(content).hexdigest() == want:
+                return ExecResult(0, f"{p}: OK")
+            return ExecResult(1, "", f"{p}: FAILED")
+        if m := re.search(r"sha256sum (\S+)", command):
+            import hashlib as _hl
+            p = m.group(1).strip("'\"")
+            if p in h.files:
+                return ExecResult(0, _hl.sha256(h.files[p]).hexdigest())
+            return ExecResult(0, "")
+        if m := re.search(r"\|\| echo (.+) >> (\S+)$", command):
+            import shlex as _shlex
+            try:
+                line = _shlex.split(m.group(1))[0]
+            except ValueError:
+                line = m.group(1)
+            path = m.group(2).strip("'\"")
+            existing = h.files.get(path, b"").decode()
+            if line not in existing.splitlines():
+                h.files[path] = (existing + line + "\n").encode()
+            return ExecResult(0)
+        if m := re.search(r"etcdctl .*snapshot save (\S+)", command):
+            h.files[m.group(1).strip("'\"")] = b"etcd-snapshot-fake"
+            return ExecResult(0, "Snapshot saved")
+        if "kubectl" in command and "get nodes" in command:
+            lines = []
+            with self._lock:
+                items = list(self.hosts.items())
+            for ip, fh in items:
+                if fh.services.get("kubelet") == "started":
+                    unit = fh.files.get("/etc/systemd/system/kubelet.service", b"").decode()
+                    mm = re.search(r"--hostname-override=(\S+)", unit)
+                    lines.append(f"{mm.group(1) if mm else ip}   Ready   <none>   1m   v1.29")
+            return ExecResult(0, "\n".join(lines))
+        if m := re.match(r"^cat (\S+)$", command.strip()):
+            p = m.group(1)
+            if p in h.files:
+                return ExecResult(0, h.files[p].decode(errors="replace"))
+            return ExecResult(1, "", f"cat: {p}: No such file or directory")
+        if m := re.search(r"systemctl (enable|start|restart|stop|disable) ([\w@.-]+)", command):
+            action, unit = m.groups()
+            if action in ("enable", "start", "restart"):
+                # `enable` alone doesn't start a unit, but every step here
+                # pairs enable with restart; keep the fake simple
+                h.services[unit] = "started"
+            elif action == "stop":
+                h.services[unit] = "stopped"
+            elif action == "disable":
+                h.services.setdefault(unit, "stopped")
+            return ExecResult(0)
+        if m := re.search(r"systemctl is-active ([\w@.-]+)", command):
+            state = h.services.get(m.group(1))
+            return ExecResult(0 if state == "started" else 3,
+                              "active" if state == "started" else "inactive")
+        if command.strip() == "nproc":
+            return ExecResult(0, str(facts.get("cpu_core", 4)))
+        if "MemTotal" in command:
+            return ExecResult(0, f"MemTotal:       {facts.get('memory_mb', 8192) * 1024} kB")
+        if "/etc/os-release" in command:
+            return ExecResult(0, f"{facts.get('os', 'Ubuntu')}|{facts.get('os_version', '22.04')}")
+        if "lspci" in command:
+            n = facts.get("gpu", 0)
+            if "wc -l" in command:
+                return ExecResult(0, str(n))
+            return ExecResult(0, "NVIDIA Corporation GA100\n" * n if n else "")
+        if "accelerator-type" in command:   # GCE TPU metadata probe
+            return ExecResult(0, facts.get("tpu_type", ""))
+        if "agent-worker-number" in command:
+            return ExecResult(0, str(facts.get("tpu_worker_id", 0)))
+        if "tpu-env" in command:
+            return ExecResult(0, facts.get("tpu_env", ""))
+        if "df " in command:
+            return ExecResult(0, f"/ {facts.get('disk_gb', 100)}G")
+        if "hostname" in command and "-I" not in command:
+            return ExecResult(0, facts.get("hostname", "fake-host"))
+        if command.strip().startswith("date"):
+            # a healthy fake host's clock matches the controller's (the
+            # monitor derives NTP drift from this probe)
+            from datetime import datetime, timezone
+            return ExecResult(0, datetime.now(timezone.utc).isoformat())
+        return ExecResult(0)
+
+    # -- assertions for tests ---------------------------------------------
+    def ran(self, ip: str, pattern: str) -> bool:
+        return any(re.search(pattern, c) for c in self.host(ip).history)

@@ -1,0 +1,117 @@
+"""In-memory inventory.
+
+The reference builds an in-memory ansible inventory from DB models or raw
+dicts (``ansible_api/ansible/inventory.py:36-124``, adapters ``:225-310``)
+— no files on disk. Here the inventory resolves a cluster's nodes into
+target groups and layered vars; steps fan out over ``targets(group)``.
+
+Var precedence (low→high): cluster.configs < role vars (catalog) < node
+vars < host accelerator facts. This mirrors ``extra_vars`` assembly in the
+reference (``deploy.py:42-47``) + node var propagation (``node.py:40-50``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubeoperator_tpu.config.catalog import Catalog
+from kubeoperator_tpu.engine.executor import Conn
+from kubeoperator_tpu.resources.entities import Cluster, Credential, Host, Node
+from kubeoperator_tpu.resources.store import Store
+
+
+@dataclass
+class TargetHost:
+    """A resolved (node, host, conn, vars) tuple steps operate on."""
+    name: str
+    conn: Conn
+    roles: list[str]
+    vars: dict[str, Any]
+    host: Host
+    node: Node
+
+
+@dataclass
+class Inventory:
+    cluster: Cluster
+    targets_by_group: dict[str, list[TargetHost]] = field(default_factory=dict)
+    global_vars: dict[str, Any] = field(default_factory=dict)
+
+    def targets(self, group: str) -> list[TargetHost]:
+        """Resolve a catalog target expression: a role name, ``all``, or
+        ``first-<role>`` (run on a single representative, like the
+        reference's 'first master' playbook hosts)."""
+        if group == "all":
+            seen, out = set(), []
+            for ths in self.targets_by_group.values():
+                for th in ths:
+                    if th.name not in seen:
+                        seen.add(th.name)
+                        out.append(th)
+            return out
+        if group.startswith("first-"):
+            role = group[len("first-"):]
+            ths = self.targets_by_group.get(role, [])
+            return ths[:1]
+        return list(self.targets_by_group.get(group, []))
+
+    def masters(self) -> list[TargetHost]:
+        return self.targets("master")
+
+    def workers(self) -> list[TargetHost]:
+        return self.targets("worker")
+
+
+def expand_roles(roles: list[str], catalog: Catalog) -> tuple[set[str], dict[str, Any]]:
+    """Walk the catalog role tree: a node with role ``master`` is also in
+    every child group (e.g. ``etcd``), per reference ``config.yml:105-132``;
+    role-level vars (has_tpu/has_gpu) accumulate."""
+    groups: set[str] = set()
+    vars_: dict[str, Any] = {}
+    stack = list(roles)
+    while stack:
+        r = stack.pop()
+        if r in groups:
+            continue
+        groups.add(r)
+        spec = catalog.roles.get(r, {})
+        vars_.update(spec.get("vars", {}))
+        stack.extend(spec.get("children", []))
+    return groups, vars_
+
+
+def build_inventory(store: Store, cluster: Cluster, catalog: Catalog) -> Inventory:
+    inv = Inventory(cluster=cluster, global_vars=dict(cluster.configs))
+    nodes = store.find(Node, project=cluster.name)
+    hosts = {h.id: h for h in store.find(Host, scoped=False, project=cluster.name)}
+    creds = {c.id: c for c in store.find(Credential, scoped=False)}
+    for node in sorted(nodes, key=lambda n: n.name):
+        host = hosts.get(node.host_id)
+        if host is None:
+            continue
+        groups, role_vars = expand_roles(node.roles, catalog)
+        hv: dict[str, Any] = dict(inv.global_vars)
+        hv.update(role_vars)
+        hv.update(node.vars)
+        # accelerator facts outrank everything (reference node.py:47-48 sets
+        # has_gpu from the host probe; has_tpu is the TPU mirror)
+        if host.has_gpu:
+            hv["has_gpu"] = True
+            hv["gpu_num"] = host.gpu_num
+        if host.has_tpu:
+            hv.update(
+                has_tpu=True, tpu_type=host.tpu_type,
+                tpu_worker_id=host.tpu_worker_id, tpu_slice_id=host.tpu_slice_id,
+            )
+        th = TargetHost(
+            name=node.name,
+            conn=Conn.from_host(host, creds.get(host.credential_id)),
+            roles=sorted(groups),
+            vars=hv,
+            host=host,
+            node=node,
+        )
+        for g in groups:
+            inv.targets_by_group.setdefault(g, []).append(th)
+    return inv

@@ -1,0 +1,185 @@
+"""Operation driver — the engine behind every Day-1/Day-2 flow.
+
+Replaces ``DeployExecution.start`` (reference ``deploy.py:36-145``): set
+cluster status, walk the catalog's step list for the operation, track
+per-step state + progress (consumed by the progress stream, reference
+``ws.py:8-30``), flip cluster status on completion/failure, and fan a
+message into the message center.
+
+Inventory is rebuilt before every step: the provider step mutates it
+(creates hosts/nodes) for AUTOMATIC clusters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+
+from kubeoperator_tpu.engine.inventory import build_inventory
+from kubeoperator_tpu.engine.steps import StepContext, StepError, load_step
+from kubeoperator_tpu.resources import scope
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, DeployExecution, ExecutionState, ExecutionStep,
+    Message, StepState,
+)
+from kubeoperator_tpu.utils.logs import get_logger
+from kubeoperator_tpu.utils.timeutil import iso
+
+log = get_logger(__name__)
+
+# cluster status while an operation runs (reference deploy.py:61,74,96,115…)
+RUNNING_STATUS = {
+    "install": ClusterStatus.INSTALLING,
+    "uninstall": ClusterStatus.DELETING,
+    "upgrade": ClusterStatus.UPGRADING,
+    "restore": ClusterStatus.RESTORING,
+    "backup": ClusterStatus.BACKUP,
+    "scale": ClusterStatus.INSTALLING,
+    "add-worker": ClusterStatus.INSTALLING,
+    "remove-worker": ClusterStatus.INSTALLING,
+    "lb-config": ClusterStatus.RUNNING,
+}
+# terminal status on success
+DONE_STATUS = {
+    "uninstall": ClusterStatus.READY,
+}
+
+
+def run_execution(platform, execution_id: str) -> DeployExecution:
+    """Entry point the task engine invokes (reference:
+    ``start_deploy_execution`` celery task, ``kubeops_api/tasks.py:28-37``)."""
+    store = platform.store
+    with scope.root():
+        execution = store.get(DeployExecution, execution_id)
+    assert execution is not None, f"no execution {execution_id}"
+    with scope.project(execution.project):
+        return _run(platform, execution)
+
+
+def _run(platform, execution: DeployExecution) -> DeployExecution:
+    store = platform.store
+    cluster = store.get_by_name(Cluster, execution.project)
+    if cluster is None:
+        execution.state = ExecutionState.FAILURE
+        execution.result = {"error": f"cluster {execution.project} not found"}
+        store.save(execution)
+        return execution
+
+    steps = platform.catalog.operation_steps(execution.operation)
+    execution.steps = [asdict(ExecutionStep(name=s.name)) for s in steps]
+    execution.state = ExecutionState.STARTED
+    execution.started_at = iso()
+    store.save(execution)
+
+    prev_status = cluster.status
+    cluster.status = RUNNING_STATUS.get(execution.operation, ClusterStatus.RUNNING)
+    store.save(cluster)
+
+    # operation-level resume (beyond the reference, which re-runs every
+    # step of a failed install): a retry execution carries
+    # params.resume_from = the failed step's name; earlier steps — already
+    # converged and idempotent — are skipped, not re-run
+    start_index = 0
+    resume_from = execution.params.get("resume_from")
+    if resume_from:
+        names = [s.name for s in steps]
+        if resume_from in names:
+            start_index = names.index(resume_from)
+            for i in range(start_index):
+                execution.steps[i]["status"] = StepState.SKIPPED
+        else:
+            log.warning("[%s] resume_from %r not in %s steps; running all",
+                        execution.project, resume_from, execution.operation)
+
+    error: str | None = None
+    for i, step_def in enumerate(steps):
+        if i < start_index:
+            continue
+        execution.current_step = step_def.name
+        execution.steps[i]["status"] = StepState.RUNNING
+        execution.steps[i]["started_at"] = iso()
+        store.save(execution)
+        log.info("[%s] %s: step %s (%d/%d)", execution.project,
+                 execution.operation, step_def.name, i + 1, len(steps))
+        try:
+            cluster = store.get_by_name(Cluster, execution.project) or cluster
+            ctx = StepContext(
+                cluster=cluster,
+                store=store,
+                inventory=build_inventory(store, cluster, platform.catalog),
+                executor=platform.executor,
+                catalog=platform.catalog,
+                config=platform.config,
+                vars={**cluster.configs, **execution.params.get("vars", {})},
+                step=step_def,
+                provider=platform.provider_for(cluster),
+                params=execution.params,
+                operation=execution.operation,
+            )
+            result = load_step(step_def)(ctx)
+            execution.steps[i]["status"] = StepState.SUCCESS
+            if isinstance(result, dict):
+                execution.result[step_def.name] = result
+        except Exception as e:  # noqa: BLE001 — step boundary
+            error = f"{step_def.name}: {e}"
+            execution.steps[i]["status"] = StepState.ERROR
+            execution.steps[i]["message"] = str(e)
+            log.error("[%s] step %s failed: %s", execution.project, step_def.name, e)
+        finally:
+            execution.steps[i]["finished_at"] = iso()
+            done = sum(1 for s in execution.steps
+                       if s["status"] in (StepState.SUCCESS, StepState.ERROR,
+                                          StepState.SKIPPED))
+            execution.progress = round(done / len(steps), 3)
+            store.save(execution)
+        if error:
+            break
+
+    execution.finished_at = iso()
+    if error:
+        execution.state = ExecutionState.FAILURE
+        execution.result["error"] = error
+        cluster.status = ClusterStatus.ERROR
+    else:
+        execution.state = ExecutionState.SUCCESS
+        cluster.status = DONE_STATUS.get(execution.operation, ClusterStatus.RUNNING)
+        if execution.operation in ("scale", "add-worker"):
+            _exit_new_node(store, cluster)
+    store.save(execution)
+    store.save(cluster)
+    platform.notify(
+        title=f"cluster {cluster.name} {execution.operation} "
+              f"{'failed' if error else 'succeeded'}",
+        level="ERROR" if error else "INFO",
+        project=cluster.name,
+        content={"execution": execution.id, "error": error or "",
+                 "prev_status": prev_status},
+    )
+    return execution
+
+
+def _exit_new_node(store, cluster: Cluster) -> None:
+    """Graduate freshly-joined nodes out of the ``new_node`` staging group
+    (reference ``cluster.exit_new_node``, ``cluster.py:170-175``), assigning
+    the accelerator-appropriate worker role if staging was their only one."""
+    from kubeoperator_tpu.resources.entities import Host, Node
+    for node in store.find(Node, project=cluster.name):
+        if "new_node" not in node.roles:
+            continue
+        node.roles = [r for r in node.roles if r != "new_node"]
+        if not node.roles:
+            host = store.get(Host, node.host_id, scoped=False)
+            node.roles = ["tpu-worker" if (host and host.has_tpu) else "worker"]
+        store.save(node)
+
+
+def progress_payload(execution: DeployExecution) -> dict:
+    """JSON the progress stream sends every second (reference
+    ``F2OWebsocket``, ``kubeops_api/ws.py:8-30``)."""
+    return {
+        "id": execution.id,
+        "operation": execution.operation,
+        "state": execution.state,
+        "progress": execution.progress,
+        "current_step": execution.current_step,
+        "steps": execution.steps,
+    }

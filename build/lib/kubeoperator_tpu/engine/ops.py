@@ -1,0 +1,98 @@
+"""Idempotent per-host operations built on the Executor transport.
+
+This is the vocabulary step modules speak — the equivalent of the handful
+of ansible modules the reference's roles actually use (copy/template/
+systemd/shell/yum). Every operation converges state and is safe to re-run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shlex
+
+from kubeoperator_tpu.engine.executor import Conn, ExecResult, Executor
+
+
+class HostOps:
+    def __init__(self, executor: Executor, conn: Conn):
+        self.x = executor
+        self.conn = conn
+
+    # -- primitives --------------------------------------------------------
+    def sh(self, command: str, check: bool = True, timeout: int = 300) -> ExecResult:
+        r = self.x.run(self.conn, command, timeout=timeout)
+        if check:
+            r.check(command.split()[0] if command else "command")
+        return r
+
+    def exists(self, path: str) -> bool:
+        return self.x.run(self.conn, f"test -e {shlex.quote(path)}").ok
+
+    # -- converging operations --------------------------------------------
+    def ensure_dir(self, path: str) -> None:
+        self.sh(f"mkdir -p {shlex.quote(path)}")
+
+    def ensure_file(self, path: str, content: str | bytes, mode: int = 0o644) -> bool:
+        """Write ``path`` only if its sha256 differs. Returns True if written."""
+        data = content.encode() if isinstance(content, str) else content
+        want = hashlib.sha256(data).hexdigest()
+        r = self.x.run(self.conn, f"sha256sum {shlex.quote(path)} 2>/dev/null | cut -d' ' -f1")
+        if r.ok and r.stdout.strip() == want:
+            return False
+        self.x.put_file(self.conn, path, data, mode=mode)
+        return True
+
+    def ensure_service(self, unit: str, unit_content: str | None = None) -> None:
+        """Install a systemd unit (if content given) and enable+start it."""
+        changed = False
+        if unit_content is not None:
+            changed = self.ensure_file(f"/etc/systemd/system/{unit}.service", unit_content)
+        if changed:
+            self.sh("systemctl daemon-reload")
+        self.sh(f"systemctl enable {unit}", check=False)
+        if self.x.run(self.conn, f"systemctl is-active {unit}").ok and not changed:
+            return
+        self.sh(f"systemctl restart {unit}")
+
+    def service_stopped(self, unit: str) -> None:
+        self.sh(f"systemctl stop {unit}", check=False)
+        self.sh(f"systemctl disable {unit}", check=False)
+
+    def ensure_binary(self, name: str, source_url: str,
+                      dest_dir: str = "/usr/local/bin",
+                      sha256: str | None = None) -> None:
+        """Fetch a binary from the cluster's offline repo if not present
+        (reference copies from the package nexus, ``roles/kube-bin``).
+        With ``sha256`` (from the package's checksums map) the download is
+        verified and a corrupted/tampered file is removed and fails the
+        step — air-gapped mirrors are exactly where silent corruption
+        hides."""
+        dest = f"{dest_dir}/{name}"
+
+        def verified() -> bool:
+            return self.sh(
+                f"echo {shlex.quote(sha256 + '  ' + dest)} | sha256sum -c -",
+                check=False).ok
+
+        if self.exists(dest):
+            if sha256 is None or verified():
+                return
+            # a partial download from an earlier failed run would otherwise
+            # be accepted forever — refetch instead
+            self.sh(f"rm -f {shlex.quote(dest)}", check=False)
+        self.ensure_dir(dest_dir)
+        self.sh(f"curl -fsSL -o {shlex.quote(dest)} {shlex.quote(source_url)} && chmod 0755 {shlex.quote(dest)}",
+                timeout=600)
+        if sha256 and not verified():
+            self.sh(f"rm -f {shlex.quote(dest)}", check=False)
+            raise RuntimeError(
+                f"checksum mismatch for {name} from {source_url}: "
+                f"expected sha256 {sha256}")
+
+    def ensure_line(self, path: str, line: str) -> None:
+        q = shlex.quote(line)
+        self.sh(f"grep -qxF {q} {shlex.quote(path)} 2>/dev/null || echo {q} >> {shlex.quote(path)}")
+
+    def ensure_sysctl(self, key: str, value: str) -> None:
+        self.ensure_line("/etc/sysctl.d/95-kubeoperator.conf", f"{key} = {value}")
+        self.sh("sysctl --system >/dev/null", check=False)

@@ -1,0 +1,109 @@
+"""Cluster PKI.
+
+The reference generates a CA + component certs with cfssl on the localhost
+"config" node and distributes them (``roles/deploy/tasks/main.yml``). We do
+the same on the controller with the openssl CLI (no extra Python deps),
+storing per-cluster PKI under ``<projects>/<cluster>/pki/``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+
+class PkiError(RuntimeError):
+    pass
+
+
+def _run(args: list[str], cwd: str) -> None:
+    p = subprocess.run(args, cwd=cwd, capture_output=True, text=True)
+    if p.returncode != 0:
+        raise PkiError(f"openssl failed: {' '.join(args)}: {p.stderr.strip()}")
+
+
+class ClusterPKI:
+    # one lock for all instances: step fan-out issues certs concurrently and
+    # openssl's -CAcreateserial serial file is not concurrency-safe
+    _lock = threading.Lock()
+
+    def __init__(self, base_dir: str):
+        self.dir = base_dir
+        os.makedirs(self.dir, exist_ok=True)
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def read(self, name: str) -> str:
+        with open(self.path(name)) as f:
+            return f.read()
+
+    def ensure_ca(self, cn: str = "kubernetes-ca") -> None:
+        with self._lock:
+            self._ensure_ca(cn)
+
+    def _ensure_ca(self, cn: str = "kubernetes-ca") -> None:
+        if os.path.exists(self.path("ca.crt")):
+            return
+        _run(["openssl", "genrsa", "-out", "ca.key", "2048"], self.dir)
+        _run(["openssl", "req", "-x509", "-new", "-nodes", "-key", "ca.key",
+              "-subj", f"/CN={cn}", "-days", "3650", "-out", "ca.crt"], self.dir)
+
+    def ensure_cert(self, name: str, cn: str, sans: list[str] | None = None,
+                    org: str | None = None) -> None:
+        """Issue a cert signed by the cluster CA. ``org`` maps to k8s group
+        (e.g. system:masters for admin)."""
+        with self._lock:
+            self._ensure_cert(name, cn, sans, org)
+
+    def _ensure_cert(self, name: str, cn: str, sans: list[str] | None = None,
+                     org: str | None = None) -> None:
+        if os.path.exists(self.path(f"{name}.crt")):
+            return
+        self._ensure_ca()
+        subj = f"/CN={cn}" + (f"/O={org}" if org else "")
+        _run(["openssl", "genrsa", "-out", f"{name}.key", "2048"], self.dir)
+        req = ["openssl", "req", "-new", "-key", f"{name}.key", "-subj", subj,
+               "-out", f"{name}.csr"]
+        ext_file = None
+        if sans:
+            alt = ",".join(
+                (f"IP:{s}" if s.replace(".", "").isdigit() else f"DNS:{s}") for s in sans
+            )
+            # bare filename: openssl runs with cwd=self.dir, and self.dir may
+            # itself be relative — a self.path() here would resolve doubled
+            ext_file = f"{name}.ext"
+            with open(self.path(ext_file), "w") as f:
+                f.write(f"subjectAltName={alt}\n")
+        _run(req, self.dir)
+        sign = ["openssl", "x509", "-req", "-in", f"{name}.csr", "-CA", "ca.crt",
+                "-CAkey", "ca.key", "-CAcreateserial", "-days", "3650",
+                "-out", f"{name}.crt"]
+        if ext_file:
+            sign += ["-extfile", ext_file]
+        _run(sign, self.dir)
+
+    def kubeconfig(self, user: str, server: str) -> str:
+        """Render a static kubeconfig embedding CA + client cert paths'
+        contents (reference builds these with kubectl config in the deploy
+        role)."""
+        import base64
+        b64 = lambda s: base64.b64encode(s.encode()).decode()  # noqa: E731
+        return f"""apiVersion: v1
+kind: Config
+clusters:
+- name: kubernetes
+  cluster:
+    certificate-authority-data: {b64(self.read('ca.crt'))}
+    server: {server}
+users:
+- name: {user}
+  user:
+    client-certificate-data: {b64(self.read(user + '.crt'))}
+    client-key-data: {b64(self.read(user + '.key'))}
+contexts:
+- name: {user}@kubernetes
+  context: {{cluster: kubernetes, user: {user}}}
+current-context: {user}@kubernetes
+"""

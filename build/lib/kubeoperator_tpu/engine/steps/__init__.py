@@ -1,0 +1,99 @@
+"""Step modules — the replacement for the reference's Ansible playbooks/roles.
+
+Each catalog step maps to a module here exposing ``run(ctx) -> dict|None``.
+Steps are **idempotent**: they converge node state (check-then-apply) so a
+failed operation can simply be re-run — the same property the reference
+leans on ansible for (SURVEY §5 "ansible idempotency is the de-facto
+resume").
+
+Fan-out across a step's target hosts uses a thread pool of
+``config.node_forks`` (reference: ansible ``forks=5``, ``runner.py:39``).
+The per-host result contract mirrors the reference's callback summary
+(``ansible/callback.py:88-112``): a step fails if any host fails or is
+unreachable.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import importlib
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubeoperator_tpu.config.catalog import Catalog, StepDef
+from kubeoperator_tpu.config.loader import Config
+from kubeoperator_tpu.engine.executor import ExecError, Executor
+from kubeoperator_tpu.engine.inventory import Inventory, TargetHost
+from kubeoperator_tpu.engine.ops import HostOps
+from kubeoperator_tpu.resources.entities import Cluster
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+class StepError(RuntimeError):
+    """Raised by a step to fail the execution at that step (reference:
+    step status ERROR stops the operation, ``deploy.py:127-134``)."""
+
+
+@dataclass
+class StepContext:
+    cluster: Cluster
+    store: Store
+    inventory: Inventory
+    executor: Executor
+    catalog: Catalog
+    config: Config
+    vars: dict[str, Any] = field(default_factory=dict)   # execution extra vars
+    step: StepDef | None = None
+    provider: Any = None          # CloudProvider for AUTOMATIC clusters
+    params: dict[str, Any] = field(default_factory=dict)  # operation params
+    operation: str = ""           # the running operation (install/scale/...)
+
+    # -- helpers usable by every step -------------------------------------
+    def targets(self) -> list[TargetHost]:
+        assert self.step is not None
+        out: list[TargetHost] = []
+        seen: set[str] = set()
+        for expr in self.step.targets:
+            for th in self.inventory.targets(expr):
+                if th.name not in seen:
+                    seen.add(th.name)
+                    out.append(th)
+        return out
+
+    def ops(self, th: TargetHost) -> HostOps:
+        return HostOps(self.executor, th.conn)
+
+    def fan_out(self, fn: Callable[[TargetHost], Any],
+                targets: list[TargetHost] | None = None) -> dict[str, Any]:
+        """Run ``fn`` on every target host in parallel; raise StepError with
+        the full per-host failure map if any host fails."""
+        targets = self.targets() if targets is None else targets
+        if not targets:
+            return {}
+        results: dict[str, Any] = {}
+        failures: dict[str, str] = {}
+        workers = max(1, min(int(self.config.get("node_forks", 10)), len(targets)))
+        with ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-fanout") as pool:
+            # copy_context per host: worker threads inherit CURRENT_TASK so
+            # their log records reach the owning task's log file
+            futs = {pool.submit(contextvars.copy_context().run, fn, th): th
+                    for th in targets}
+            for fut, th in futs.items():
+                try:
+                    results[th.name] = fut.result()
+                except (StepError, ExecError) as e:
+                    failures[th.name] = str(e)
+                except Exception as e:  # noqa: BLE001 — per-host boundary
+                    failures[th.name] = f"{type(e).__name__}: {e}"
+        if failures:
+            raise StepError(f"{len(failures)}/{len(targets)} hosts failed: {failures}")
+        return results
+
+
+def load_step(step: StepDef) -> Callable[[StepContext], Any]:
+    mod = importlib.import_module(f"kubeoperator_tpu.engine.steps.{step.module}")
+    return getattr(mod, "run")

@@ -1,0 +1,70 @@
+"""Accelerator node stack — the GPU/TPU "triple", part 1+2.
+
+The reference installs NVIDIA drivers (``roles/gpu-driver``) and the
+container runtime hook (``roles/gpu-docker``) on ``has_gpu`` nodes. The
+TPU mirror (BASELINE.json north star) installs libtpu and writes the
+slice-discovery environment JAX/XLA workloads consume:
+
+* ``TPU_WORKER_ID``         — this host's index within its pod slice
+* ``TPU_WORKER_HOSTNAMES``  — comma-separated IPs of every host in the
+  slice (the role NCCL env vars play in GPU plans is played by XLA
+  collectives over ICI, which discover peers via exactly these vars)
+* ``TPU_ACCELERATOR_TYPE``  — e.g. v5e-16
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+TPU_ENV_DIR = "/etc/kubeoperator"
+LIBTPU_PATH = "/lib/libtpu.so"
+
+NVIDIA_RUNTIME_TOML = """[plugins."io.containerd.grpc.v1.cri".containerd.runtimes.nvidia]
+  runtime_type = "io.containerd.runc.v2"
+  [plugins."io.containerd.grpc.v1.cri".containerd.runtimes.nvidia.options]
+    BinaryName = "/usr/bin/nvidia-container-runtime"
+"""
+
+
+def slice_peers(ctx: StepContext, slice_id: str) -> list:
+    """All hosts of one TPU pod slice, ordered by worker id."""
+    peers = [th for th in ctx.inventory.targets("all")
+             if th.host.tpu_slice_id == slice_id and th.host.has_tpu]
+    return sorted(peers, key=lambda t: t.host.tpu_worker_id)
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+
+    def per(th):
+        o = ctx.ops(th)
+        if th.host.has_gpu:
+            # reference gpu-driver role: unload nouveau, install driver from
+            # the offline repo, persistence daemon, runtime hook
+            o.sh("lsmod | grep -q nouveau && rmmod nouveau || true", check=False)
+            o.sh(f"test -e /usr/bin/nvidia-smi || curl -fsSL {repo}/nvidia-driver.run "
+                 f"-o /tmp/nvidia-driver.run && sh /tmp/nvidia-driver.run -s", timeout=1200)
+            o.ensure_service("nvidia-persistenced", k8s.unit(
+                "NVIDIA persistence daemon", "/usr/bin/nvidia-persistenced --verbose"))
+            o.ensure_file("/etc/containerd/nvidia-runtime.toml", NVIDIA_RUNTIME_TOML)
+            o.sh("systemctl restart containerd")
+        if th.host.has_tpu:
+            # TPU triple part 1: libtpu from the offline repo (on Cloud TPU
+            # VM images it ships pre-installed; converge either way)
+            o.sh(f"test -e {LIBTPU_PATH} || curl -fsSL {repo}/libtpu.so -o {LIBTPU_PATH}",
+                 timeout=600)
+            # part 2: slice-discovery env consumed by the device plugin and
+            # by JAX workload pods (jax.distributed.initialize)
+            peers = slice_peers(ctx, th.host.tpu_slice_id)
+            hostnames = ",".join(p.host.ip for p in peers)
+            env = (
+                f"TPU_ACCELERATOR_TYPE={th.host.tpu_type}\n"
+                f"TPU_WORKER_ID={th.host.tpu_worker_id}\n"
+                f"TPU_WORKER_HOSTNAMES={hostnames}\n"
+                f"TPU_SLICE_ID={th.host.tpu_slice_id}\n"
+            )
+            o.ensure_dir(TPU_ENV_DIR)
+            o.ensure_file(f"{TPU_ENV_DIR}/tpu.env", env)
+
+    ctx.fan_out(per)

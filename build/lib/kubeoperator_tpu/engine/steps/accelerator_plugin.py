@@ -1,0 +1,81 @@
+"""Accelerator device plugins + node labels/taints — the "triple", part 3.
+
+Reference: ``roles/gpu-plugin`` applies the NVIDIA device-plugin DaemonSet
+(``templates/nvidia-plugin.yml.j2``) when any node has a GPU. The TPU
+mirror applies a tpu-device-plugin DaemonSet advertising ``google.com/tpu``
+resources, and labels slice membership so pod-slice workloads can be
+gang-scheduled onto exactly the hosts of one slice.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+NVIDIA_PLUGIN = """apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: nvidia-device-plugin, namespace: kube-system}}
+spec:
+  selector: {{matchLabels: {{name: nvidia-device-plugin}}}}
+  template:
+    metadata: {{labels: {{name: nvidia-device-plugin}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: gpu}}
+      containers:
+      - name: nvidia-device-plugin
+        image: {registry}/k8s-device-plugin:v0.14
+        volumeMounts: [{{name: dp, mountPath: /var/lib/kubelet/device-plugins}}]
+      volumes: [{{name: dp, hostPath: {{path: /var/lib/kubelet/device-plugins}}}}]
+"""
+
+TPU_PLUGIN = """apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: tpu-device-plugin, namespace: kube-system}}
+spec:
+  selector: {{matchLabels: {{name: tpu-device-plugin}}}}
+  template:
+    metadata: {{labels: {{name: tpu-device-plugin}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: tpu}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      containers:
+      - name: tpu-device-plugin
+        image: {registry}/tpu-device-plugin:v1
+        env: [{{name: TPU_ENV_FILE, value: /etc/kubeoperator/tpu.env}}]
+        volumeMounts:
+        - {{name: dp, mountPath: /var/lib/kubelet/device-plugins}}
+        - {{name: tpuenv, mountPath: /etc/kubeoperator}}
+      volumes:
+      - {{name: dp, hostPath: {{path: /var/lib/kubelet/device-plugins}}}}
+      - {{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}
+"""
+
+
+def run(ctx: StepContext):
+    registry = ctx.vars.get("registry", "registry.local:8082")
+    gpu_nodes = [th for th in ctx.inventory.targets("all") if th.host.has_gpu]
+    tpu_nodes = [th for th in ctx.inventory.targets("all") if th.host.has_tpu]
+
+    def per(th):
+        o = ctx.ops(th)
+        if gpu_nodes:
+            path = f"{k8s.MANIFESTS}/nvidia-device-plugin.yaml"
+            o.ensure_file(path, NVIDIA_PLUGIN.format(registry=registry))
+            o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=120)
+        if tpu_nodes:
+            path = f"{k8s.MANIFESTS}/tpu-device-plugin.yaml"
+            o.ensure_file(path, TPU_PLUGIN.format(registry=registry))
+            o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=120)
+        for node in gpu_nodes:
+            o.sh(f"{k8s.KUBECTL} label node {node.name} ko.accelerator=gpu --overwrite",
+                 check=False)
+        for node in tpu_nodes:
+            h = node.host
+            o.sh(f"{k8s.KUBECTL} label node {node.name} ko.accelerator=tpu "
+                 f"ko.tpu/type={h.tpu_type} ko.tpu/slice={h.tpu_slice_id} "
+                 f"ko.tpu/worker-id={h.tpu_worker_id} --overwrite", check=False)
+            # keep non-TPU pods off slice hosts (a slice is one schedulable unit)
+            o.sh(f"{k8s.KUBECTL} taint node {node.name} "
+                 f"google.com/tpu=present:NoSchedule --overwrite", check=False)
+
+    ctx.fan_out(per)

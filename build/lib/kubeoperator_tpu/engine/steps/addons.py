@@ -1,0 +1,41 @@
+"""Cluster addons (reference: ``addon.yml`` + ``cluster-addon``/``manifests``
+/``kubeapps`` roles): coredns, dashboard, ingress, monitoring stack, and
+the app store. Which apps deploy comes from the catalog's app list plus
+cluster config flags (``app_<name>_enabled``)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.apps.manifests import render_app
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+DEFAULT_APPS = ["coredns", "dashboard", "ingress-nginx", "prometheus", "kubeapps"]
+
+
+def enabled_apps(ctx: StepContext) -> list[str]:
+    apps = list(DEFAULT_APPS)
+    for app in ctx.catalog.apps:
+        flag = ctx.vars.get(f"app_{app['name'].replace('-', '_')}_enabled")
+        if flag and app["name"] not in apps:
+            apps.append(app["name"])
+        if flag is False and app["name"] in apps:
+            apps.remove(app["name"])
+    return apps
+
+
+def run(ctx: StepContext):
+    registry = ctx.vars.get("registry", "registry.local:8082")
+    apps = enabled_apps(ctx)
+
+    def per(th):
+        o = ctx.ops(th)
+        for name in apps:
+            manifest = render_app(name, registry=registry, vars=ctx.vars)
+            if manifest is None:
+                continue
+            path = f"{k8s.MANIFESTS}/app-{name}.yaml"
+            o.ensure_file(path, manifest)
+            o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=300)
+
+    ctx.fan_out(per)
+    return {"apps": apps}

@@ -1,0 +1,40 @@
+"""Container runtime (reference: ``docker`` role; modernized to containerd).
+
+Binaries come from the cluster's offline package repo (``repo_url`` var,
+mirroring the nexus-per-package pattern)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+CONTAINERD_CONFIG = """version = 2
+[plugins."io.containerd.grpc.v1.cri"]
+  sandbox_image = "{registry}/pause:3.9"
+  [plugins."io.containerd.grpc.v1.cri".registry.mirrors."docker.io"]
+    endpoint = ["{registry_url}"]
+[plugins."io.containerd.grpc.v1.cri".containerd.runtimes.runc.options]
+  SystemdCgroup = true
+"""
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+    registry = ctx.vars.get("registry", "registry.local:8082")
+    registry_url = ctx.vars.get("registry_url", f"http://{registry}")
+
+    def per(th):
+        o = ctx.ops(th)
+        for b in ("containerd", "runc", "crictl"):
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
+        o.ensure_file("/etc/containerd/config.toml",
+                      CONTAINERD_CONFIG.format(registry=registry, registry_url=registry_url))
+        o.ensure_file("/etc/crictl.yaml",
+                      "runtime-endpoint: unix:///run/containerd/containerd.sock\n")
+        o.ensure_service("containerd", k8s.unit(
+            "containerd container runtime",
+            f"{k8s.BIN}/containerd --config /etc/containerd/config.toml",
+        ))
+
+    ctx.fan_out(per)

@@ -1,0 +1,42 @@
+"""Drain workers slated for removal (reference: ``drain_worker_node``
+ad-hoc, ``kubeops_api/adhoc.py:5-12`` — kubectl drain on a master).
+
+TPU semantics: you cannot remove one host of a pod slice — the slice is
+one schedulable unit — so draining any slice member drains the whole
+slice's hosts (SURVEY §7 hard part (e))."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def nodes_to_remove(ctx: StepContext) -> list[str]:
+    names = list(ctx.params.get("nodes", []))
+    if not names:
+        raise StepError("remove-worker requires params.nodes")
+    all_ths = {th.name: th for th in ctx.inventory.targets("all")}
+    expanded = set(names)
+    for name in names:
+        th = all_ths.get(name)
+        if th is None:
+            raise StepError(f"unknown node {name!r}")
+        if th.host.has_tpu and th.host.tpu_slice_id:
+            for other in all_ths.values():
+                if other.host.tpu_slice_id == th.host.tpu_slice_id:
+                    expanded.add(other.name)
+    return sorted(expanded)
+
+
+def run(ctx: StepContext):
+    names = nodes_to_remove(ctx)
+
+    def per(th):
+        o = ctx.ops(th)
+        for name in names:
+            o.sh(f"{k8s.KUBECTL} cordon {name}", check=False)
+            o.sh(f"{k8s.KUBECTL} drain {name} --ignore-daemonsets "
+                 f"--delete-emptydir-data --force --timeout=300s", check=False, timeout=360)
+
+    ctx.fan_out(per)
+    return {"drained": names}

@@ -1,0 +1,47 @@
+"""etcd cluster (reference: ``etcd`` role): per-member server certs, static
+initial-cluster bootstrap, systemd unit, health check."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def run(ctx: StepContext):
+    pki = k8s.pki_for(ctx)
+    members = ctx.inventory.targets("etcd")
+    if not members:
+        raise StepError("no etcd members in inventory")
+    initial = ",".join(f"{th.name}=https://{th.host.ip}:2380" for th in members)
+    pki.ensure_cert("etcd-client", "etcd-client")
+    client_crt, client_key = pki.read("etcd-client.crt"), pki.read("etcd-client.key")
+
+    def per(th):
+        name = f"etcd-{th.name}"
+        pki.ensure_cert(name, th.name, sans=[th.host.ip, "127.0.0.1", th.name])
+        o = ctx.ops(th)
+        repo = k8s.repo_url(ctx)
+        for b in ("etcd", "etcdctl"):
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
+        o.ensure_dir(k8s.ETCD_DATA)
+        o.ensure_file(f"{k8s.SSL}/etcd.crt", pki.read(f"{name}.crt"))
+        o.ensure_file(f"{k8s.SSL}/etcd.key", pki.read(f"{name}.key"), mode=0o600)
+        o.ensure_file(f"{k8s.SSL}/etcd-client.crt", client_crt)
+        o.ensure_file(f"{k8s.SSL}/etcd-client.key", client_key, mode=0o600)
+        exec_start = (
+            f"{k8s.BIN}/etcd --name={th.name} --data-dir={k8s.ETCD_DATA}"
+            f" --listen-peer-urls=https://{th.host.ip}:2380"
+            f" --listen-client-urls=https://{th.host.ip}:2379,https://127.0.0.1:2379"
+            f" --advertise-client-urls=https://{th.host.ip}:2379"
+            f" --initial-advertise-peer-urls=https://{th.host.ip}:2380"
+            f" --initial-cluster={initial} --initial-cluster-state=new"
+            f" --cert-file={k8s.SSL}/etcd.crt --key-file={k8s.SSL}/etcd.key"
+            f" --peer-cert-file={k8s.SSL}/etcd.crt --peer-key-file={k8s.SSL}/etcd.key"
+            f" --trusted-ca-file={k8s.SSL}/ca.crt --peer-trusted-ca-file={k8s.SSL}/ca.crt"
+            f" --client-cert-auth --peer-client-cert-auth"
+        )
+        o.ensure_service("etcd", k8s.unit("etcd key-value store", exec_start))
+        o.sh(f"{k8s.BIN}/etcdctl {k8s.etcd_flags(ctx)} endpoint health", check=True, timeout=60)
+
+    ctx.fan_out(per)

@@ -1,0 +1,61 @@
+"""etcd snapshot backup (reference: ``cluster-backup.yml`` +
+``cluster_backup_utils.py``): snapshot on the first etcd member, fetch to
+the controller, hand to the backup storage client, apply retention."""
+
+from __future__ import annotations
+
+import os
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+from kubeoperator_tpu.resources.entities import BackupStorage, BackupStrategy, ClusterBackup
+from kubeoperator_tpu.services.backup_client import storage_client
+from kubeoperator_tpu.utils.timeutil import utcnow
+
+SNAP_PATH = "/tmp/ko-etcd-snapshot.db"
+
+
+def run(ctx: StepContext):
+    targets = ctx.targets()
+    if not targets:
+        raise StepError("no etcd member to back up")
+    th = targets[0]
+    o = ctx.ops(th)
+    o.sh(f"{k8s.BIN}/etcdctl {k8s.etcd_flags(ctx)} snapshot save {SNAP_PATH}", timeout=300)
+    data = ctx.executor.get_file(th.conn, SNAP_PATH)
+
+    stamp = utcnow().strftime("%Y%m%d-%H%M%S")
+    folder = f"{ctx.cluster.name}/etcd-{stamp}.db"
+    local_dir = os.path.join(ctx.config.backups, ctx.cluster.name)
+    os.makedirs(local_dir, exist_ok=True)
+    local_path = os.path.join(local_dir, f"etcd-{stamp}.db")
+    with open(local_path, "wb") as f:
+        f.write(data)
+
+    storage_id = ctx.params.get("backup_storage_id", "")
+    storage = ctx.store.get(BackupStorage, storage_id, scoped=False) if storage_id else None
+    if storage:
+        storage_client(storage, ctx.config).upload(local_path, folder)
+
+    backup = ClusterBackup(project=ctx.cluster.name, folder=folder,
+                           backup_storage_id=storage_id, size_bytes=len(data),
+                           name=f"etcd-{stamp}")
+    ctx.store.save(backup)
+
+    # retention (reference save_num, cluster_backup_utils.py:26-28)
+    strategies = ctx.store.find(BackupStrategy, scoped=False, project=ctx.cluster.name)
+    save_num = strategies[0].save_num if strategies else 5
+    backups = sorted(ctx.store.find(ClusterBackup, scoped=False, project=ctx.cluster.name),
+                     key=lambda b: b.created_at)
+    for old in backups[:-save_num] if save_num > 0 else []:
+        old_path = os.path.join(ctx.config.backups, old.folder.replace("/", os.sep))
+        if os.path.exists(old_path):
+            os.remove(old_path)
+        if old.backup_storage_id:
+            # each backup's object lives in ITS storage, not the current run's
+            old_storage = ctx.store.get(BackupStorage, old.backup_storage_id,
+                                        scoped=False)
+            if old_storage:
+                storage_client(old_storage, ctx.config).delete(old.folder)
+        ctx.store.delete(ClusterBackup, old.id)
+    return {"backup": backup.name, "size": len(data)}

@@ -1,0 +1,54 @@
+"""etcd snapshot restore (reference: ``cluster-restore.yml`` + restore
+download flow ``deploy.py:235-250``): push the snapshot to every member,
+rebuild data dirs, restart the quorum and the apiservers."""
+
+from __future__ import annotations
+
+import os
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+from kubeoperator_tpu.resources.entities import BackupStorage, ClusterBackup
+
+RESTORE_PATH = "/tmp/ko-etcd-restore.db"
+
+
+def run(ctx: StepContext):
+    backups = sorted(ctx.store.find(ClusterBackup, scoped=False, project=ctx.cluster.name),
+                     key=lambda b: b.created_at)
+    backup_id = ctx.params.get("backup_id")
+    backup = (ctx.store.get(ClusterBackup, backup_id, scoped=False) if backup_id
+              else (backups[-1] if backups else None))
+    if backup is None:
+        raise StepError("no backup available to restore")
+
+    local_path = os.path.join(ctx.config.backups, backup.folder.replace("/", os.sep))
+    if not os.path.exists(local_path) and backup.backup_storage_id:
+        storage = ctx.store.get(BackupStorage, backup.backup_storage_id, scoped=False)
+        if storage:
+            from kubeoperator_tpu.services.backup_client import storage_client
+            storage_client(storage, ctx.config).download(backup.folder, local_path)
+    if not os.path.exists(local_path):
+        raise StepError(f"backup payload missing: {local_path}")
+    with open(local_path, "rb") as f:
+        data = f.read()
+
+    members = ctx.targets()
+    initial = ",".join(f"{th.name}=https://{th.host.ip}:2380" for th in members)
+
+    def per(th):
+        o = ctx.ops(th)
+        ctx.executor.put_file(th.conn, RESTORE_PATH, data)
+        o.sh("systemctl stop etcd", check=False)
+        o.sh(f"rm -rf {k8s.ETCD_DATA}")
+        o.sh(f"{k8s.BIN}/etcdctl snapshot restore {RESTORE_PATH}"
+             f" --name={th.name} --initial-cluster={initial}"
+             f" --initial-advertise-peer-urls=https://{th.host.ip}:2380"
+             f" --data-dir={k8s.ETCD_DATA}", timeout=300)
+        o.sh("systemctl restart etcd")
+
+    ctx.fan_out(per)
+
+    for th in ctx.inventory.masters():
+        ctx.ops(th).sh("systemctl restart kube-apiserver", check=False)
+    return {"restored": backup.name}

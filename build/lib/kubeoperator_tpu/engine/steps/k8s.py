@@ -1,0 +1,76 @@
+"""Shared constants, unit templates and helpers for the k8s step modules.
+
+Layout parity with the reference's kubeasz roles: binaries in
+``/opt/kube/bin`` (``roles/kube-bin``), certs in ``/etc/kubernetes/ssl``
+(``roles/deploy``), systemd-managed components (``roles/kube-master``,
+``roles/kube-node``, ``roles/etcd``). Binary sources come from the
+cluster's offline package repository (``repo_url`` var — the nexus-per-
+package pattern, ``package_manage.py:31-53``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeoperator_tpu.engine.pki import ClusterPKI
+
+BIN = "/opt/kube/bin"
+SSL = "/etc/kubernetes/ssl"
+KCFG = "/etc/kubernetes"
+MANIFESTS = "/etc/kubernetes/addons"
+ETCD_DATA = "/var/lib/etcd"
+KUBECTL = f"{BIN}/kubectl --kubeconfig={KCFG}/admin.conf"
+
+K8S_BINARIES = ["kubectl", "kube-apiserver", "kube-controller-manager",
+                "kube-scheduler", "kubelet", "kube-proxy", "etcd", "etcdctl",
+                "containerd", "runc", "crictl", "helm"]
+
+
+def pki_for(ctx) -> ClusterPKI:
+    base = os.path.join(ctx.config.projects, ctx.cluster.name, "pki")
+    return ClusterPKI(base)
+
+
+def repo_url(ctx) -> str:
+    return ctx.vars.get("repo_url", "http://127.0.0.1:8081/repository/raw")
+
+
+def checksum(ctx, name: str) -> str | None:
+    """Expected sha256 for a repo file, from the offline package's
+    ``checksums:`` map (flows into cluster configs as repo_checksums)."""
+    return (ctx.vars.get("repo_checksums") or {}).get(name)
+
+
+def apiserver_url(ctx) -> str:
+    masters = ctx.inventory.masters()
+    ip = masters[0].host.ip if masters else "127.0.0.1"
+    # HA clusters front the apiservers with the LB vip (lb-config step)
+    vip = ctx.vars.get("lb_vip")
+    return f"https://{vip or ip}:6443"
+
+
+def etcd_endpoints(ctx) -> str:
+    return ",".join(f"https://{th.host.ip}:2379" for th in ctx.inventory.targets("etcd"))
+
+
+def etcd_flags(ctx) -> str:
+    return (f"--cacert={SSL}/ca.crt --cert={SSL}/etcd-client.crt "
+            f"--key={SSL}/etcd-client.key --endpoints={etcd_endpoints(ctx)}")
+
+
+def unit(description: str, exec_start: str, after: str = "network.target",
+         env_file: str | None = None) -> str:
+    env = f"EnvironmentFile=-{env_file}\n" if env_file else ""
+    return f"""[Unit]
+Description={description}
+After={after}
+
+[Service]
+{env}ExecStart={exec_start}
+Restart=always
+RestartSec=5
+LimitNOFILE=65536
+
+[Install]
+WantedBy=multi-user.target
+"""

@@ -1,0 +1,39 @@
+"""API-server load balancer (reference: ``f5-bigip.yml`` / ``bigip-config``
+operation; generalized): haproxy fronting the HA masters on the lb_vip."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+HAPROXY_CFG = """# kubeoperator-tpu apiserver LB
+defaults
+  mode tcp
+  timeout connect 5s
+  timeout client 60s
+  timeout server 60s
+frontend apiserver
+  bind {vip}:6443
+  default_backend masters
+backend masters
+{servers}
+"""
+
+
+def run(ctx: StepContext):
+    masters = ctx.inventory.masters()
+    vip = ctx.vars.get("lb_vip", "0.0.0.0")
+    servers = "\n".join(
+        f"  server {th.name} {th.host.ip}:6443 check" for th in masters
+    )
+
+    def per(th):
+        o = ctx.ops(th)
+        o.ensure_dir("/etc/haproxy")
+        o.ensure_file("/etc/haproxy/haproxy.cfg",
+                      HAPROXY_CFG.format(vip=vip, servers=servers))
+        o.ensure_service("haproxy", k8s.unit(
+            "apiserver load balancer",
+            "/usr/sbin/haproxy -f /etc/haproxy/haproxy.cfg"))
+
+    ctx.fan_out(per)

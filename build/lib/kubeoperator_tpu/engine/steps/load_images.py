@@ -1,0 +1,71 @@
+"""Load offline container images from the package repo into containerd.
+
+The reference delivers workload images through a per-package nexus docker
+registry that nodes pull from (``core/apps/kubeops_api/package_manage.py:
+31-53``, registry login retry ``addon.yml:25-34``). The TPU-native stack
+has no registry server at all: image tarballs live in the offline package,
+the controller serves them over ``/repo/<package>/images/...``, and this
+step imports them into every node's containerd image store, tagged with
+the cluster's registry name — so the charts' ``{registry}/ko-workloads``
+references resolve locally with ``imagePullPolicy: IfNotPresent`` and an
+air-gapped cluster never dials out.
+
+Package ``meta.yml`` schema::
+
+    images:
+      - file: images/ko-workloads.tar    # path under the package dir
+        ref: ko-workloads:latest         # tag inside the tarball
+        sha256: <hex>                    # tarball checksum (verified)
+
+``create_cluster`` merges the list into cluster configs as ``repo_images``.
+"""
+
+from __future__ import annotations
+
+import shlex
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+IMAGES_DIR = "/opt/kube/images"
+CTR = "ctr -n k8s.io"
+
+
+def run(ctx: StepContext):
+    images = ctx.vars.get("repo_images") or []
+    if not images:
+        return {"images": []}
+    repo = k8s.repo_url(ctx)
+    registry = ctx.vars.get("registry", "registry.local:8082")
+
+    def per(th):
+        o = ctx.ops(th)
+        for img in images:
+            file, ref = img["file"], img["ref"]
+            dest_ref = f"{registry}/{ref}"
+            present = o.sh(f"{CTR} images ls -q name=={shlex.quote(dest_ref)}",
+                           check=False)
+            if present.ok and present.stdout.strip():
+                continue                      # already imported+tagged
+            tar = f"{IMAGES_DIR}/{file.rsplit('/', 1)[-1]}"
+            o.ensure_binary(tar.rsplit("/", 1)[-1], f"{repo}/{file}",
+                            dest_dir=IMAGES_DIR, sha256=img.get("sha256"))
+            o.sh(f"{CTR} images import {shlex.quote(tar)}", timeout=600)
+            # docker-save tarballs carry the short ref; containerd may
+            # normalize it under docker.io/library — tag whichever spelling
+            # the import produced at the name the charts use
+            tagged = False
+            for src in (ref, f"docker.io/library/{ref}"):
+                if o.sh(f"{CTR} images tag {shlex.quote(src)} "
+                        f"{shlex.quote(dest_ref)}", check=False).ok:
+                    tagged = True
+                    break
+            if not tagged:
+                raise RuntimeError(
+                    f"import of {tar} produced neither {ref!r} nor the "
+                    f"docker.io/library spelling; cannot tag {dest_ref}")
+            # the tarball stays on disk (checksum-verified on refetch) so
+            # re-runs are cheap; operators may prune /opt/kube/images
+
+    ctx.fan_out(per)
+    return {"images": [f"{registry}/{i['ref']}" for i in images]}

@@ -1,0 +1,73 @@
+"""CNI plugin (reference: ``flannel``/``calico`` roles + typed option
+schema ``config.yml:189-246``). Manifests render from the catalog-validated
+cluster network config and apply on the first master."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+from kubeoperator_tpu.engine.steps.control_plane import POD_CIDR
+
+FLANNEL = """# rendered by kubeoperator-tpu network step
+apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: kube-flannel, namespace: kube-system}}
+spec:
+  selector: {{matchLabels: {{app: flannel}}}}
+  template:
+    metadata: {{labels: {{app: flannel}}}}
+    spec:
+      hostNetwork: true
+      containers:
+      - name: flannel
+        image: {registry}/flannel:v0.24.2
+        args: ["--ip-masq", "--kube-subnet-mgr", "--iface-can-reach=8.8.8.8"]
+        env: [{{name: FLANNEL_BACKEND, value: "{backend}"}}, {{name: POD_CIDR, value: "{pod_cidr}"}}]
+"""
+
+CALICO = """# rendered by kubeoperator-tpu network step
+apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: calico-node, namespace: kube-system}}
+spec:
+  selector: {{matchLabels: {{k8s-app: calico-node}}}}
+  template:
+    metadata: {{labels: {{k8s-app: calico-node}}}}
+    spec:
+      hostNetwork: true
+      containers:
+      - name: calico-node
+        image: {registry}/calico-node:v3.27
+        env:
+        - {{name: CALICO_IPV4POOL_CIDR, value: "{pod_cidr}"}}
+        - {{name: CALICO_IPV4POOL_IPIP, value: "{ipip_mode}"}}
+        - {{name: CALICO_NETWORKING_BACKEND, value: "{backend}"}}
+"""
+
+
+def render(ctx: StepContext) -> str:
+    plugin = ctx.cluster.network_plugin
+    spec = ctx.catalog.network(plugin)   # validates the plugin exists
+    opts = {o["name"]: o.get("default") for o in spec.get("options", [])}
+    opts.update(ctx.cluster.network_config)
+    registry = ctx.vars.get("registry", "registry.local:8082")
+    if plugin == "flannel":
+        return FLANNEL.format(registry=registry, pod_cidr=POD_CIDR,
+                              backend=opts.get("backend", "vxlan"))
+    if plugin == "calico":
+        return CALICO.format(registry=registry, pod_cidr=POD_CIDR,
+                             ipip_mode=opts.get("ipip_mode", "Always"),
+                             backend=opts.get("backend", "bird"))
+    raise StepError(f"unsupported network plugin {plugin!r}")
+
+
+def run(ctx: StepContext):
+    manifest = render(ctx)
+
+    def per(th):
+        o = ctx.ops(th)
+        path = f"{k8s.MANIFESTS}/network-{ctx.cluster.network_plugin}.yaml"
+        o.ensure_file(path, manifest)
+        o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=120)
+
+    ctx.fan_out(per)

@@ -1,0 +1,30 @@
+"""Post-operation conformance check (reference: cordon/label checks at the
+end of ``master.yml`` + implicit 'cluster went RUNNING'): every expected
+node registered and Ready."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def run(ctx: StepContext):
+    expected = {th.name for th in ctx.inventory.workers()}
+
+    def per(th):
+        o = ctx.ops(th)
+        r = o.sh(f"{k8s.KUBECTL} get nodes --no-headers", timeout=60)
+        lines = [ln.split() for ln in r.stdout.strip().splitlines() if ln.strip()]
+        seen = {parts[0] for parts in lines}
+        # exact status-token match: "NotReady" contains "Ready" as a substring
+        not_ready = [parts[0] for parts in lines
+                     if len(parts) > 1 and "Ready" not in parts[1].split(",")]
+        missing = expected - seen
+        if missing:
+            raise StepError(f"nodes never registered: {sorted(missing)}")
+        if not_ready:
+            raise StepError(f"nodes not Ready: {sorted(not_ready)}")
+        return {"nodes": sorted(seen)}
+
+    results = ctx.fan_out(per)
+    return next(iter(results.values()), {})

@@ -1,0 +1,36 @@
+"""Node preparation (reference: ``prepare.yml`` + prepare/ssh/ntp/firewall
+roles): hostname, /etc/hosts fan-out, swap off, sysctls, base dirs, CA
+distribution."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def run(ctx: StepContext):
+    pki = k8s.pki_for(ctx)
+    pki.ensure_ca()
+    ca = pki.read("ca.crt")
+    host_lines = [f"{th.host.ip} {th.name}" for th in ctx.inventory.targets("all")]
+
+    def per(th):
+        o = ctx.ops(th)
+        o.sh(f"hostnamectl set-hostname {th.name}", check=False)
+        o.ensure_dir(k8s.BIN)
+        o.ensure_dir(k8s.SSL)
+        o.ensure_dir(k8s.MANIFESTS)
+        o.sh("swapoff -a", check=False)
+        o.sh("sed -i '/ swap / s/^/#/' /etc/fstab", check=False)
+        o.sh("modprobe br_netfilter", check=False)
+        o.ensure_sysctl("net.ipv4.ip_forward", "1")
+        o.ensure_sysctl("net.bridge.bridge-nf-call-iptables", "1")
+        o.ensure_sysctl("fs.inotify.max_user_watches", "524288")
+        o.sh("systemctl stop firewalld 2>/dev/null; systemctl disable firewalld 2>/dev/null",
+             check=False)
+        for line in host_lines:
+            o.ensure_line("/etc/hosts", line)
+        o.ensure_file(f"{k8s.SSL}/ca.crt", ca)
+        o.ensure_line("/etc/profile.d/kubeoperator.sh", f"export PATH=$PATH:{k8s.BIN}")
+
+    ctx.fan_out(per)

@@ -1,0 +1,16 @@
+"""Provision/converge IaaS resources for AUTOMATIC clusters (reference:
+``create_resource``/``scale_compute_resource``,
+``kubeops_api/cloud_provider.py:12-114``). MANUAL clusters no-op."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.resources.entities import DeployType
+
+
+def run(ctx: StepContext):
+    if ctx.cluster.deploy_type != DeployType.AUTOMATIC:
+        return {"skipped": "manual cluster"}
+    if ctx.provider is None:
+        raise StepError("AUTOMATIC cluster has no provider configured")
+    return ctx.provider.converge(ctx)

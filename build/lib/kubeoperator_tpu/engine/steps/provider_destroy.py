@@ -1,0 +1,13 @@
+"""Destroy IaaS resources for AUTOMATIC clusters (reference:
+``destroy_terraform``, ``cloud_client.py:41-50``)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.resources.entities import DeployType
+
+
+def run(ctx: StepContext):
+    if ctx.cluster.deploy_type != DeployType.AUTOMATIC or ctx.provider is None:
+        return {"skipped": "manual cluster"}
+    return ctx.provider.destroy(ctx)

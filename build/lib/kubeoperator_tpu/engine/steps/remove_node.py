@@ -1,0 +1,39 @@
+"""Delete drained nodes from the cluster and reset the underlying hosts
+(reference: ``remove-worker.yml`` + node cleanup in
+``cloud_provider.py:51-64``)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+from kubeoperator_tpu.engine.steps.drain import nodes_to_remove
+from kubeoperator_tpu.engine.steps.reset_node import reset_host
+from kubeoperator_tpu.resources.entities import Node
+
+
+def run(ctx: StepContext):
+    names = nodes_to_remove(ctx)
+    all_ths = {th.name: th for th in ctx.inventory.targets("all")}
+
+    def per(th):
+        o = ctx.ops(th)
+        for name in names:
+            o.sh(f"{k8s.KUBECTL} delete node {name} --ignore-not-found", check=False)
+
+    ctx.fan_out(per)
+
+    # stop services / wipe state on the removed hosts themselves
+    removed = [all_ths[n] for n in names if n in all_ths]
+    ctx.fan_out(lambda th: reset_host(ctx.ops(th)), targets=removed)
+
+    # drop node rows (host rows stay registered, back in the free pool —
+    # reference recovers zone IPs on host delete, host.py:77-80)
+    for name in names:
+        node = ctx.store.get_by_name(Node, name)
+        if node:
+            ctx.store.delete(Node, node.id)
+        th = all_ths.get(name)
+        if th:
+            th.host.project = None
+            ctx.store.save(th.host)
+    return {"removed": names}

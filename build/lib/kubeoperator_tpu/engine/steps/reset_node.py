@@ -1,0 +1,24 @@
+"""Tear a node back to a clean OS (reference: ``clean.yml``, 262 lines of
+service/iptables/mount cleanup)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.ops import HostOps
+from kubeoperator_tpu.engine.steps import StepContext
+
+UNITS = ["kubelet", "kube-proxy", "kube-apiserver", "kube-controller-manager",
+         "kube-scheduler", "etcd", "containerd", "nvidia-persistenced"]
+DIRS = ["/etc/kubernetes", "/var/lib/etcd", "/var/lib/kubelet", "/opt/kube",
+        "/etc/kubeoperator", "/etc/containerd"]
+
+
+def reset_host(o: HostOps) -> None:
+    for unit in UNITS:
+        o.service_stopped(unit)
+    o.sh("iptables -F && iptables -t nat -F", check=False)
+    for d in DIRS:
+        o.sh(f"rm -rf {d}", check=False)
+
+
+def run(ctx: StepContext):
+    ctx.fan_out(lambda th: reset_host(ctx.ops(th)))

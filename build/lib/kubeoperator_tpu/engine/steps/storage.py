@@ -1,0 +1,121 @@
+"""Cluster storage (reference: ``cluster-storage`` role + storage option
+catalog ``config.yml:247-281``): deploy the chosen provisioner + a default
+StorageClass, then probe it with a test PVC (the reference applies
+``test-sc.yaml.j2``)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+TEMPLATES = {
+    "local-volume": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: local-volume
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: kubernetes.io/no-provisioner
+volumeBindingMode: WaitForFirstConsumer
+""",
+    "nfs": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: nfs
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: nfs.csi.k8s.io
+parameters: {{server: "{nfs_server}", share: "{nfs_path}"}}
+""",
+    "rook-ceph": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: rook-ceph-block
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: rook-ceph.rbd.csi.ceph.com
+""",
+    "external-ceph": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: external-ceph
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: rbd.csi.ceph.com
+parameters: {{monitors: "{ceph_monitors}"}}
+""",
+    "gcp-pd": """apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: gcp-pd
+  annotations: {{storageclass.kubernetes.io/is-default-class: "true"}}
+provisioner: pd.csi.storage.gke.io
+parameters: {{type: pd-balanced}}
+""",
+}
+
+TEST_PVC = """apiVersion: v1
+kind: PersistentVolumeClaim
+metadata: {name: ko-storage-probe, namespace: default}
+spec:
+  accessModes: [ReadWriteOnce]
+  resources: {requests: {storage: 1Gi}}
+"""
+
+CEPH_SECRET = """apiVersion: v1
+kind: Secret
+metadata: {{name: ceph-csi-secret, namespace: kube-system}}
+stringData:
+  userID: "{ceph_user}"
+  userKey: "{ceph_key}"
+"""
+
+
+def _resolve_backend(ctx: StepContext, cfg: dict) -> None:
+    """A ``backend`` name in storage_config points at a managed
+    StorageBackend (reference NfsStorage/CephStorage rows) — pull the
+    server address/credentials from it."""
+    from kubeoperator_tpu.resources.entities import StorageBackend
+
+    backend = ctx.store.get_by_name(StorageBackend, cfg["backend"], scoped=False)
+    if backend is None:
+        raise StepError(f"storage backend {cfg['backend']!r} not found")
+    if backend.status != "READY":
+        raise StepError(f"storage backend {backend.name!r} is {backend.status}, "
+                        "deploy it first")
+    # one precedence rule for every field: an explicit value in the
+    # cluster's storage_config wins, the backend fills the gaps
+    fill = lambda key, value: cfg.__setitem__(key, cfg.get(key) or value)
+    if backend.type == "nfs":
+        fill("nfs_server", backend.config.get("server_ip", ""))
+        fill("nfs_path", backend.config.get("export_path", "/export"))
+    elif backend.type == "external-ceph":
+        fill("ceph_monitors", backend.config.get("monitors", ""))
+        fill("ceph_user", backend.config.get("user", "admin"))
+        fill("ceph_key", backend.config.get("key", ""))
+
+
+def run(ctx: StepContext):
+    provider = ctx.cluster.storage_provider
+    spec = ctx.catalog.storage(provider)
+    # deploy-type gating (reference gates storages by deploy_type+provider)
+    if ctx.cluster.deploy_type not in spec["deploy_types"]:
+        raise StepError(f"storage {provider!r} not allowed for {ctx.cluster.deploy_type}")
+    tmpl = TEMPLATES[provider]
+    # precedence: explicit cluster storage_config > managed backend > defaults
+    cfg = dict(ctx.cluster.storage_config)
+    if cfg.get("backend"):
+        _resolve_backend(ctx, cfg)
+    for key, default in (("nfs_server", ""), ("nfs_path", "/export"),
+                         ("ceph_monitors", ""), ("ceph_user", "admin"),
+                         ("ceph_key", "")):
+        cfg.setdefault(key, default)
+    manifest = tmpl.format(**cfg)
+    if provider == "external-ceph" and cfg["ceph_key"]:
+        manifest += "---\n" + CEPH_SECRET.format(**cfg)
+
+    def per(th):
+        o = ctx.ops(th)
+        path = f"{k8s.MANIFESTS}/storage-{provider}.yaml"
+        o.ensure_file(path, manifest)
+        o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=120)
+        o.ensure_file(f"{k8s.MANIFESTS}/storage-probe.yaml", TEST_PVC)
+        o.sh(f"{k8s.KUBECTL} apply -f {k8s.MANIFESTS}/storage-probe.yaml", check=False)
+
+    ctx.fan_out(per)

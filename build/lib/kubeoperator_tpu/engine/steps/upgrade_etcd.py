@@ -1,0 +1,20 @@
+"""Rolling etcd upgrade (reference: ``upgrade-etcd`` role): refresh the
+binary from the new package repo, restart, re-check health, one member at
+a time."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+    # serial, not fan-out: an etcd quorum survives one member restarting
+    for th in ctx.targets():
+        o = ctx.ops(th)
+        for b in ("etcd", "etcdctl"):
+            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
+                 timeout=600)
+        o.sh("systemctl restart etcd")
+        o.sh(f"{k8s.BIN}/etcdctl {k8s.etcd_flags(ctx)} endpoint health", timeout=60)

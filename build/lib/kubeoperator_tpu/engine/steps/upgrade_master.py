@@ -1,0 +1,21 @@
+"""Rolling control-plane upgrade (reference: ``upgrade-master`` role)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+BINARIES = ("kube-apiserver", "kube-controller-manager", "kube-scheduler", "kubectl")
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+    for th in ctx.targets():   # serial: keep the HA plane up
+        o = ctx.ops(th)
+        for b in BINARIES:
+            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
+                 timeout=600)
+        for unit in ("kube-apiserver", "kube-controller-manager", "kube-scheduler"):
+            o.sh(f"systemctl restart {unit}")
+        o.sh("curl -sk --max-time 30 --retry 10 --retry-delay 3 --retry-connrefused "
+             "https://127.0.0.1:6443/healthz", timeout=120)

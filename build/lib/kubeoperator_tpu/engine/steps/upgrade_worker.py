@@ -1,0 +1,25 @@
+"""Rolling worker upgrade (reference: ``upgrade-worker`` role): cordon,
+refresh binaries, restart kubelet/proxy, uncordon. TPU slices upgrade
+slice-at-a-time implicitly since their hosts share one group."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+    masters = ctx.inventory.masters()
+    mo = ctx.ops(masters[0]) if masters else None
+
+    for th in ctx.targets():
+        if mo:
+            mo.sh(f"{k8s.KUBECTL} cordon {th.name}", check=False)
+        o = ctx.ops(th)
+        for b in ("kubelet", "kube-proxy"):
+            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
+                 timeout=600)
+        o.sh("systemctl restart kubelet && systemctl restart kube-proxy")
+        if mo:
+            mo.sh(f"{k8s.KUBECTL} uncordon {th.name}", check=False)

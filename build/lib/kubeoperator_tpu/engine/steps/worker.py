@@ -1,0 +1,50 @@
+"""Worker nodes (reference: ``kube-node`` role): kubelet + kube-proxy with
+per-node client certs; accelerator labels/taints are applied by the
+``accelerator_plugin`` step once the node registers."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+KUBELET_CONFIG = """apiVersion: kubelet.config.k8s.io/v1beta1
+kind: KubeletConfiguration
+authentication:
+  x509: {{clientCAFile: {ssl}/ca.crt}}
+clusterDNS: ["10.68.0.2"]
+clusterDomain: cluster.local
+cgroupDriver: systemd
+containerRuntimeEndpoint: unix:///run/containerd/containerd.sock
+failSwapOn: false
+"""
+
+
+def run(ctx: StepContext):
+    pki = k8s.pki_for(ctx)
+    server = k8s.apiserver_url(ctx)
+    repo = k8s.repo_url(ctx)
+    pki.ensure_cert("kube-proxy", "system:kube-proxy")   # shared; issue once
+    proxy_conf = pki.kubeconfig("kube-proxy", server)
+
+    def per(th):
+        o = ctx.ops(th)
+        for b in ("kubelet", "kube-proxy", "kubectl"):
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                                sha256=k8s.checksum(ctx, b))
+        user = f"node-{th.name}"
+        pki.ensure_cert(user, f"system:node:{th.name}", org="system:nodes")
+        o.ensure_file(f"{k8s.KCFG}/kubelet.conf", pki.kubeconfig(user, server), mode=0o600)
+        o.ensure_file(f"{k8s.KCFG}/kube-proxy.conf", proxy_conf, mode=0o600)
+        o.ensure_file(f"{k8s.KCFG}/kubelet-config.yaml", KUBELET_CONFIG.format(ssl=k8s.SSL))
+        kubelet = (
+            f"{k8s.BIN}/kubelet --kubeconfig={k8s.KCFG}/kubelet.conf"
+            f" --config={k8s.KCFG}/kubelet-config.yaml"
+            f" --hostname-override={th.name} --node-ip={th.host.ip}"
+        )
+        proxy = (f"{k8s.BIN}/kube-proxy --kubeconfig={k8s.KCFG}/kube-proxy.conf"
+                 f" --hostname-override={th.name}")
+        o.ensure_service("kubelet", k8s.unit("Kubernetes kubelet", kubelet,
+                                             after="containerd.service"))
+        o.ensure_service("kube-proxy", k8s.unit("Kubernetes kube-proxy", proxy))
+
+    ctx.fan_out(per)

@@ -1,0 +1,135 @@
+"""Async task engine.
+
+Replaces Celery + Redis + django-celery-beat in the reference
+(``settings.py:157-182``, ``kubeops.py:143-194``) with a threaded engine:
+
+* a worker pool (default 4 — parity with ``celery -c 4``),
+* idempotent dispatch by task id (reference sets ``task_id=execution.id``
+  so a double-POST can't run twice, ``api.py:252-254``),
+* per-task log files under ``<data>/tasks/<task_id>.log`` (reference
+  ``data/celery/<task_id>.log``, ``celery_api/logger.py:139-160``),
+* a beat-style periodic scheduler for monitor/health/backup cadences
+  (reference ``kubeops_api/tasks.py:40-89``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubeoperator_tpu.utils.logs import CURRENT_TASK, TaskLogHandler, get_logger
+from kubeoperator_tpu.utils.timeutil import iso
+
+log = get_logger(__name__)
+
+
+@dataclass
+class TaskRecord:
+    id: str
+    name: str
+    state: str = "PENDING"       # PENDING|STARTED|SUCCESS|FAILURE
+    result: Any = None
+    error: str = ""
+    started_at: str = ""
+    finished_at: str = ""
+    future: Future | None = field(default=None, repr=False)
+
+
+class TaskEngine:
+    def __init__(self, workers: int = 4, log_dir: str = "data/tasks"):
+        self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-task")
+        self.log_dir = log_dir
+        self.tasks: dict[str, TaskRecord] = {}
+        self._lock = threading.Lock()
+        self._periodic: list[threading.Timer] = []
+        self._closed = False
+
+    # -- one-shot tasks ----------------------------------------------------
+    def submit(self, task_id: str, name: str, fn: Callable, *args: Any, **kwargs: Any) -> TaskRecord:
+        with self._lock:
+            existing = self.tasks.get(task_id)
+            if existing and existing.state in ("PENDING", "STARTED"):
+                return existing   # idempotent dispatch
+            rec = TaskRecord(id=task_id, name=name)
+            self.tasks[task_id] = rec
+            rec.future = self.pool.submit(self._run, rec, fn, args, kwargs)
+            return rec
+
+    def _run(self, rec: TaskRecord, fn: Callable, args: tuple, kwargs: dict) -> Any:
+        rec.state = "STARTED"
+        rec.started_at = iso()
+        token = CURRENT_TASK.set(rec.id)
+        handler = TaskLogHandler(self.task_log_path(rec.id), task_id=rec.id)
+        root = logging.getLogger("kubeoperator_tpu")
+        root.addHandler(handler)
+        try:
+            rec.result = fn(*args, **kwargs)
+            rec.state = "SUCCESS"
+            return rec.result
+        except Exception as e:  # noqa: BLE001 — task boundary
+            rec.state = "FAILURE"
+            rec.error = f"{type(e).__name__}: {e}"
+            log.error("task %s (%s) failed:\n%s", rec.id, rec.name, traceback.format_exc())
+            return None
+        finally:
+            rec.finished_at = iso()
+            CURRENT_TASK.reset(token)
+            root.removeHandler(handler)
+            handler.close()
+
+    def wait(self, task_id: str, timeout: float | None = None) -> TaskRecord:
+        rec = self.tasks[task_id]
+        if rec.future is not None:
+            rec.future.result(timeout=timeout)
+        return rec
+
+    def task_log_path(self, task_id: str) -> str:
+        return os.path.join(self.log_dir, f"{task_id}.log")
+
+    def read_log(self, task_id: str, offset: int = 0) -> tuple[str, int]:
+        """Incremental log read for streaming (the reference tails the file
+        in 4 KB chunks for the UI xterm, ``celery_api/ws.py:8-43``); uses the
+        koagent native tail when built."""
+        from kubeoperator_tpu import native
+
+        path = self.task_log_path(task_id)
+        if not os.path.exists(path):
+            return "", offset
+        return native.tail(path, offset)
+
+    # -- periodic tasks ----------------------------------------------------
+    def every(self, interval_s: float, name: str, fn: Callable) -> None:
+        """Beat-style recurring task (reference cadence: 5-min monitor/health
+        loops)."""
+        def tick():
+            if self._closed:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                log.error("periodic %s failed:\n%s", name, traceback.format_exc())
+            schedule()
+
+        def schedule():
+            if self._closed:
+                return
+            t = threading.Timer(interval_s, tick)
+            t.daemon = True
+            with self._lock:
+                # prune fired timers so the list doesn't grow one entry per tick
+                self._periodic = [p for p in self._periodic if p.is_alive()]
+                self._periodic.append(t)
+            t.start()
+
+        schedule()
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._closed = True
+        for t in self._periodic:
+            t.cancel()
+        self.pool.shutdown(wait=wait)

@@ -1,0 +1,104 @@
+"""ctypes bindings for the koagent C++ runtime helper (native/koagent.cpp).
+
+Builds lazily with g++ on first use (cached next to the source; ~1 s).
+Everything here has a pure-Python fallback — the engine works without a
+compiler — but with the library loaded, command fan-out across a pool of
+hosts runs on a GIL-free C++ thread pool with process-group timeouts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "native", "koagent.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "..", "native", "libkoagent.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+class _KoResult(ctypes.Structure):
+    _fields_ = [("exit_code", ctypes.c_int),
+                ("out", ctypes.c_char_p),
+                ("err", ctypes.c_char_p)]
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    lib = os.path.abspath(_LIB)
+    if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return True
+    try:
+        subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", lib, src,
+                        "-lpthread"], check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.info("koagent build unavailable (%s); using Python fallback", e)
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(os.path.abspath(_SRC)) or not _build():
+            return None
+        lib = ctypes.CDLL(os.path.abspath(_LIB))
+        lib.ko_fanout.restype = ctypes.POINTER(_KoResult)
+        lib.ko_fanout.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_double]
+        lib.ko_free_results.argtypes = [ctypes.POINTER(_KoResult), ctypes.c_int]
+        lib.ko_tail.restype = ctypes.c_long
+        lib.ko_tail.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+                                ctypes.c_long]
+        _lib = lib
+        return _lib
+
+
+def fanout(commands: list[str], max_parallel: int = 32,
+           timeout_s: float = 300.0) -> list[tuple[int, str, str]] | None:
+    """Run shell commands concurrently in C++. Returns [(code, out, err)]
+    aligned with the input, or None when the library is unavailable
+    (callers fall back to their thread-pool path)."""
+    lib = load()
+    if lib is None or not commands:
+        return None if lib is None else []
+    arr = (ctypes.c_char_p * len(commands))(
+        *[c.encode() for c in commands])
+    res = lib.ko_fanout(arr, len(commands), max_parallel, timeout_s)
+    try:
+        return [(res[i].exit_code,
+                 (res[i].out or b"").decode(errors="replace"),
+                 (res[i].err or b"").decode(errors="replace"))
+                for i in range(len(commands))]
+    finally:
+        lib.ko_free_results(res, len(commands))
+
+
+def tail(path: str, offset: int, cap: int = 1 << 16) -> tuple[str, int]:
+    """Incremental file read; falls back to Python IO without the lib."""
+    lib = load()
+    if lib is None:
+        try:
+            # binary read: offsets are byte positions; decoding replacement
+            # chars must not desync them (U+FFFD re-encodes to 3 bytes)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(cap)
+                return data.decode("utf-8", errors="replace"), offset + len(data)
+        except OSError:
+            return "", offset
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.ko_tail(path.encode(), offset, buf, cap)
+    if n <= 0:
+        return "", offset
+    return buf.raw[:n].decode("utf-8", errors="replace"), offset + n

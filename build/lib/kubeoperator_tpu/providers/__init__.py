@@ -1,0 +1,21 @@
+"""Cloud providers — Day-0 provisioning.
+
+Replaces the reference's ``cloud_provider`` app (vSphere/OpenStack via
+``python_terraform``) with a Terraform-JSON driver and a GCE provider
+whose worker pools are **TPU pod slices**: one slice = ``hosts(type)`` VMs
+= one schedulable unit (BASELINE.json north star; breaks the reference's
+1-host-=-1-node planner assumption, ``cloud_provider.py:125-174``).
+"""
+
+from kubeoperator_tpu.providers.base import CloudProvider, allocate_ip, recover_ip
+from kubeoperator_tpu.providers.gce_tpu import GceTpuProvider
+from kubeoperator_tpu.providers.openstack import OpenstackProvider
+from kubeoperator_tpu.providers.terraform import TerraformDriver
+from kubeoperator_tpu.providers.vsphere import VsphereProvider
+
+PROVIDERS = {"gce": GceTpuProvider, "vsphere": VsphereProvider,
+             "openstack": OpenstackProvider}
+
+__all__ = ["CloudProvider", "GceTpuProvider", "VsphereProvider",
+           "OpenstackProvider", "TerraformDriver", "PROVIDERS",
+           "allocate_ip", "recover_ip"]

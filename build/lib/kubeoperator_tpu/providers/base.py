@@ -1,0 +1,70 @@
+"""Provider interface + zone IP-pool allocation.
+
+The reference's Zone model allocates static IPs from a pool with **no row
+locks** (``cloud_provider/models.py:140-193`` — flagged fragile in SURVEY
+§5); here allocation happens under the store lock."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.resources.entities import Zone
+from kubeoperator_tpu.resources.store import Store
+
+
+class ProviderError(RuntimeError):
+    pass
+
+
+def allocate_ip(store: Store, zone: Zone) -> str:
+    with store.transaction():
+        fresh = store.get(Zone, zone.id, scoped=False) or zone
+        free = [ip for ip in fresh.ip_pool if ip not in fresh.ip_used]
+        if not free:
+            raise ProviderError(f"zone {fresh.name}: IP pool exhausted")
+        ip = free[0]
+        fresh.ip_used.append(ip)
+        store.save(fresh)
+        zone.ip_used = fresh.ip_used
+        return ip
+
+
+def recover_ip(store: Store, zone_id: str, ip: str) -> None:
+    """Return an IP on host deletion (reference ``host.py:77-80``)."""
+    with store.transaction():
+        zone = store.get(Zone, zone_id, scoped=False)
+        if zone and ip in zone.ip_used:
+            zone.ip_used.remove(ip)
+            store.save(zone)
+
+
+def remove_auto_host(store: Store, node, host) -> None:
+    """Tear one auto-created host out of desired state: node row, pooled
+    IP, host row. The single definition providers (converge shrink,
+    destroy) and the healer share."""
+    if node is not None:
+        store.delete(type(node), node.id)
+    recover_ip(store, host.zone_id, host.ip)
+    store.delete(type(host), host.id)
+
+
+def count_ip_available(store: Store, zone_ids: list[str]) -> int:
+    """Pre-flight for install/scale (reference ``plan.count_ip_available``
+    check, ``api.py:234-241``)."""
+    total = 0
+    for zid in zone_ids:
+        zone = store.get(Zone, zid, scoped=False)
+        if zone:
+            total += len([ip for ip in zone.ip_pool if ip not in zone.ip_used])
+    return total
+
+
+class CloudProvider:
+    """Converge-style interface: both install and scale call ``converge``;
+    the provider diffs desired (plan+params) against actual (store)."""
+
+    name = "base"
+
+    def converge(self, ctx) -> dict:
+        raise NotImplementedError
+
+    def destroy(self, ctx) -> dict:
+        raise NotImplementedError

@@ -1,0 +1,90 @@
+"""GCE/TPU provider — the heart of the TPU-first Day-0 plan.
+
+Mirrors the reference's create/scale compute-resource flow
+(``kubeops_api/cloud_provider.py:12-114,125-197``: role sizes → zone
+round-robin → IP allocation → Host rows → terraform apply → gather_info)
+with one structural change: **worker capacity comes in two kinds** —
+plain CPU worker VMs, and TPU pod-slice pools where one
+``TpuPool(slice_type, count)`` expands to ``count × hosts(slice_type)``
+VMs that are provisioned, labeled, and drained as a unit. The shared
+converge machinery lives in providers/iaas.py; this class renders the
+GCE terraform.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.providers.iaas import TerraformIaasProvider, machine_role
+from kubeoperator_tpu.resources.entities import (
+    AcceleratorType, Host, Plan, Region, Zone,
+)
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+class GceTpuProvider(TerraformIaasProvider):
+    name = "gce"
+    supports_tpu = True
+
+    def render_tf(self, name: str, region: Region, zones: list[Zone], plan: Plan,
+                  hosts: list[Host], ctx) -> dict:
+        """Terraform-JSON: CPU VMs as ``google_compute_instance``, TPU pod
+        slices as ``google_tpu_v2_vm`` (one resource per slice — the unit
+        terraform creates/destroys atomically)."""
+        cat = ctx.catalog
+        project = region.vars.get("project", "my-project")
+        zone_by_id = {z.id: z for z in zones}
+        models = {"master": cat.compute_models.get(plan.master_model),
+                  "worker": cat.compute_models.get(plan.worker_model)}
+        instances: dict = {}
+        tpu_vms: dict = {}
+        seen_slices: set[str] = set()
+        for h in hosts:
+            zone = zone_by_id.get(h.zone_id)
+            zone_name = zone.vars.get("gce_zone", zone.name) if zone else "us-central2-b"
+            if h.accelerator == AcceleratorType.TPU:
+                if h.tpu_slice_id in seen_slices:
+                    continue
+                seen_slices.add(h.tpu_slice_id)
+                pool = next((p for p in self._effective_pools(ctx, plan)
+                             if p.slice_type == h.tpu_type), None)
+                tpu_vms[h.tpu_slice_id.replace(".", "-")] = {
+                    "name": h.tpu_slice_id,
+                    "zone": zone_name,
+                    "accelerator_type": h.tpu_type,
+                    "runtime_version": (pool.runtime_version if pool
+                                        else "tpu-ubuntu2204-base"),
+                    "network_config": {"enable_external_ips": False},
+                }
+            else:
+                model = models[machine_role(h)]
+                instances[h.name.replace(".", "-")] = {
+                    "name": h.name,
+                    "zone": zone_name,
+                    "machine_type": _machine_type(model),
+                    "boot_disk": {"initialize_params": {
+                        "image": region.vars.get("image", "ubuntu-2204-lts"),
+                        "size": model.disk_gb if model else 100}},
+                    "network_interface": {
+                        "subnetwork": region.vars.get("subnetwork", "default"),
+                        "network_ip": h.ip,
+                    },
+                }
+        tf: dict = {
+            "terraform": {"required_providers": {
+                "google": {"source": "hashicorp/google"}}},
+            "provider": {"google": {"project": project,
+                                    "region": region.vars.get("gce_region", region.name)}},
+            "resource": {},
+        }
+        if instances:
+            tf["resource"]["google_compute_instance"] = instances
+        if tpu_vms:
+            tf["resource"]["google_tpu_v2_vm"] = tpu_vms
+        return tf
+
+
+def _machine_type(model) -> str:
+    if model is None:
+        return "e2-standard-4"
+    return f"custom-{model.cpu}-{model.memory_gb * 1024}"

@@ -1,0 +1,181 @@
+"""Shared Terraform-IaaS provider machinery.
+
+The reference duplicates its create/scale compute-resource flow across
+vSphere and OpenStack clients behind ``get_cloud_client``
+(``cloud_client.py:10-19``, ``kubeops_api/cloud_provider.py:12-114``).
+Here the flow lives once: desired-state expansion from the plan (+ op
+params), zone round-robin with pooled IP allocation, Host/Node rows,
+drain-before-shrink, terraform-JSON converge, fact gathering. Concrete
+providers implement only ``render_tf`` — the part that actually differs
+per IaaS."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.engine import adhoc
+from kubeoperator_tpu.providers.base import (
+    CloudProvider, ProviderError, allocate_ip, remove_auto_host,
+)
+from kubeoperator_tpu.providers.terraform import TerraformDriver
+from kubeoperator_tpu.resources.entities import (
+    AcceleratorType, Host, Node, Plan, Region, TpuPool, Zone,
+)
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+class TerraformIaasProvider(CloudProvider):
+    """Converge-style provider over a TerraformDriver. Subclasses provide
+    ``render_tf(name, region, zones, plan, hosts, ctx) -> tf-json``."""
+
+    def __init__(self, terraform: TerraformDriver):
+        self.terraform = terraform
+
+    # ------------------------------------------------------------------
+    def converge(self, ctx) -> dict:
+        store, cluster = ctx.store, ctx.cluster
+        plan = store.get(Plan, cluster.plan_id, scoped=False)
+        if plan is None:
+            raise ProviderError(f"cluster {cluster.name} has no plan")
+        region = store.get(Region, plan.region_id, scoped=False)
+        zones = [z for z in (store.get(Zone, zid, scoped=False) for zid in plan.zone_ids) if z]
+        if not zones:
+            raise ProviderError(f"plan {plan.name} has no zones")
+
+        desired = self._desired(ctx, plan)
+        existing = {h.name: h for h in store.find(Host, scoped=False, project=cluster.name,
+                                                  auto_created=True)}
+
+        created, removed = [], []
+        # -- grow: create missing hosts, round-robin zones (reference zone RR)
+        rr = 0
+        for spec in desired:
+            if spec["name"] in existing:
+                continue
+            zone = zones[rr % len(zones)]
+            rr += 1
+            ip = allocate_ip(store, zone)
+            host = Host(
+                name=spec["name"], ip=ip, project=cluster.name, auto_created=True,
+                zone_id=zone.id, status="CREATING",
+                accelerator=spec.get("accelerator", AcceleratorType.NONE),
+                tpu_type=spec.get("tpu_type", ""),
+                tpu_worker_id=spec.get("tpu_worker_id", -1),
+                tpu_slice_id=spec.get("tpu_slice_id", ""),
+            )
+            store.save(host)
+            # during scale, stage new nodes in the new_node group so the
+            # scale steps (prepare-new/join-worker) pick them up (reference
+            # add_to_new_node, cluster.py:166-168)
+            roles = [spec["role"]]
+            if ctx.operation == "scale":
+                roles.append("new_node")
+            node = Node(name=spec["name"], host_id=host.id, project=cluster.name,
+                        roles=roles)
+            store.save(node)
+            created.append(spec["name"])
+
+        # -- shrink: remove surplus auto-created workers (drain first —
+        # reference cloud_provider.py:51-64)
+        desired_names = {s["name"] for s in desired}
+        surplus = [h for name, h in existing.items() if name not in desired_names]
+        if surplus:
+            self._drain_surplus(ctx, surplus)
+            for h in surplus:
+                remove_auto_host(store, store.get_by_name(Node, h.name), h)
+                removed.append(h.name)
+
+        # -- terraform converge to the full desired set
+        hosts = store.find(Host, scoped=False, project=cluster.name, auto_created=True)
+        tf = self.render_tf(cluster.name, region, zones, plan, hosts, ctx)
+        state = self.terraform.apply(cluster.name, tf)
+
+        # -- gather facts on new hosts (reference host.gather_info retry=5)
+        for h in hosts:
+            if h.status == "CREATING":
+                self._gather(ctx, h)
+        log.info("provider converge %s: +%d -%d hosts", cluster.name,
+                 len(created), len(removed))
+        return {"created": created, "removed": removed,
+                "terraform": state.get("fake") and "fake" or "applied"}
+
+    def destroy(self, ctx) -> dict:
+        store, cluster = ctx.store, ctx.cluster
+        hosts = store.find(Host, scoped=False, project=cluster.name, auto_created=True)
+        state = self.terraform.destroy(cluster.name)
+        for h in hosts:
+            remove_auto_host(store, store.get_by_name(Node, h.name), h)
+        return {**state, "removed": sorted(h.name for h in hosts)}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _effective_pools(ctx, plan: Plan) -> list[TpuPool]:
+        """Operation params may override the plan's pools (e.g. scale adds a
+        pool type the plan never had); every consumer must agree on the set."""
+        pools = ctx.params.get("tpu_pools")
+        return [TpuPool(**p) for p in pools] if pools is not None else plan.pools()
+
+    def _desired(self, ctx, plan: Plan) -> list[dict]:
+        """Expand plan (+operation params) into named host specs. TPU pools
+        only materialise on providers that support them (supports_tpu)."""
+        cluster = ctx.cluster
+        cat = ctx.catalog
+        masters = cat.template(plan.template)["masters"]
+        out = []
+        for i in range(masters):
+            out.append({"name": f"{cluster.name}-master-{i + 1}", "role": "master"})
+        worker_size = int(ctx.params.get("worker_size", plan.worker_size))
+        for i in range(worker_size):
+            out.append({"name": f"{cluster.name}-worker-{i + 1}", "role": "worker"})
+        pools = self._effective_pools(ctx, plan)
+        if pools and not self.supports_tpu:
+            raise ProviderError(
+                f"provider {self.name!r} cannot provision TPU pools "
+                f"({[p.slice_type for p in pools]}); use the gce provider")
+        for pool in pools:
+            topo = cat.slice(pool.slice_type)
+            for s in range(pool.count):
+                slice_id = f"{cluster.name}-{pool.slice_type}-{s + 1}"
+                for w in range(topo.hosts):
+                    out.append({
+                        "name": f"{slice_id}-w{w}", "role": "tpu-worker",
+                        "accelerator": AcceleratorType.TPU,
+                        "tpu_type": pool.slice_type, "tpu_worker_id": w,
+                        "tpu_slice_id": slice_id,
+                    })
+        return out
+
+    supports_tpu = False
+
+    def _drain_surplus(self, ctx, surplus: list[Host]) -> None:
+        masters = ctx.inventory.masters()
+        if not masters:
+            return
+        from kubeoperator_tpu.engine.steps import k8s
+        o = ctx.ops(masters[0])
+        for h in surplus:
+            o.sh(f"{k8s.KUBECTL} drain {h.name} --ignore-daemonsets --force "
+                 f"--delete-emptydir-data --timeout=120s", check=False, timeout=180)
+            o.sh(f"{k8s.KUBECTL} delete node {h.name} --ignore-not-found", check=False)
+
+    def _gather(self, ctx, host: Host) -> None:
+        from kubeoperator_tpu.engine.executor import Conn
+        conn = Conn(ip=host.ip)
+        facts = adhoc.gather_facts(ctx.executor, conn)
+        # the provider is authoritative for slice topology; facts fill the rest
+        tpu_fields = {k: getattr(host, k) for k in
+                      ("accelerator", "tpu_type", "tpu_worker_id", "tpu_slice_id")}
+        adhoc.apply_facts(host, facts)
+        if tpu_fields["accelerator"] == AcceleratorType.TPU:
+            for k, v in tpu_fields.items():
+                setattr(host, k, v)
+        ctx.store.save(host)
+
+    # ------------------------------------------------------------------
+    def render_tf(self, name: str, region: Region, zones: list[Zone], plan: Plan,
+                  hosts: list[Host], ctx) -> dict:
+        raise NotImplementedError
+
+
+def machine_role(host: Host) -> str:
+    return "master" if "-master-" in host.name else "worker"

@@ -1,0 +1,79 @@
+"""OpenStack provider (reference ``cloud_provider/clients/openstack.py`` +
+``resource/clouds/openstack/terraform/terraform.tf.j2``: a neutron port
+with a fixed IP plus an instance per host; optional floating IPs).
+
+Region vars: auth_url, username, password, project (tenant), domain,
+image. Zone vars: network_id, subnet_id, availability_zone,
+floating_network_id (optional → allocate + associate a floating IP).
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.providers.iaas import TerraformIaasProvider, machine_role
+from kubeoperator_tpu.resources.entities import Host, Plan, Region, Zone
+
+
+class OpenstackProvider(TerraformIaasProvider):
+    name = "openstack"
+    supports_tpu = False
+
+    def render_tf(self, name: str, region: Region, zones: list[Zone], plan: Plan,
+                  hosts: list[Host], ctx) -> dict:
+        cat = ctx.catalog
+        models = {"master": cat.compute_models.get(plan.master_model),
+                  "worker": cat.compute_models.get(plan.worker_model)}
+        zone_by_id = {z.id: z for z in zones}
+
+        ports: dict = {}
+        instances: dict = {}
+        fips: dict = {}
+        fip_assocs: dict = {}
+        for h in hosts:
+            zone = zone_by_id.get(h.zone_id)
+            zvars = zone.vars if zone else {}
+            key = h.name.replace(".", "-")
+            model = models[machine_role(h)]
+            ports[key] = {
+                "name": f"{h.name}-port",
+                "network_id": zvars.get("network_id", ""),
+                "fixed_ip": {"subnet_id": zvars.get("subnet_id", ""),
+                             "ip_address": h.ip},
+            }
+            instances[key] = {
+                "name": h.name,
+                "image_name": region.vars.get("image", "ubuntu-22.04"),
+                "flavor_name": _flavor(model),
+                "availability_zone": zvars.get("availability_zone",
+                                               zone.name if zone else "nova"),
+                "network": {"port": f"${{openstack_networking_port_v2.{key}.id}}"},
+            }
+            if zvars.get("floating_network_id"):
+                fips[key] = {"pool": zvars["floating_network_id"]}
+                fip_assocs[key] = {
+                    "floating_ip": f"${{openstack_networking_floatingip_v2.{key}.address}}",
+                    "port_id": f"${{openstack_networking_port_v2.{key}.id}}",
+                }
+        resource: dict = {}
+        if ports:
+            resource["openstack_networking_port_v2"] = ports
+            resource["openstack_compute_instance_v2"] = instances
+        if fips:
+            resource["openstack_networking_floatingip_v2"] = fips
+            resource["openstack_networking_floatingip_associate_v2"] = fip_assocs
+        return {
+            "terraform": {"required_providers": {
+                "openstack": {"source": "terraform-provider-openstack/openstack"}}},
+            "provider": {"openstack": {
+                "auth_url": region.vars.get("auth_url", ""),
+                "user_name": region.vars.get("username", ""),
+                "password": region.vars.get("password", ""),
+                "tenant_name": region.vars.get("project", ""),
+                "domain_name": region.vars.get("domain", "Default")}},
+            "resource": resource,
+        }
+
+
+def _flavor(model) -> str:
+    if model is None:
+        return "m1.large"
+    return model.name

@@ -1,0 +1,89 @@
+"""vSphere provider (reference ``cloud_provider/clients/vsphere.py`` +
+``resource/clouds/vsphere/terraform/terraform.tf.j2``: per-zone
+resource-pool/network/datastore data sources, one cloned VM per host with
+static-IP customization).
+
+Region vars: vcenter (host), username, password, datacenter, template
+(VM image to clone). Zone vars: cluster, network, datastore, gateway,
+netmask_prefix.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.providers.iaas import TerraformIaasProvider, machine_role
+from kubeoperator_tpu.resources.entities import Host, Plan, Region, Zone
+
+
+class VsphereProvider(TerraformIaasProvider):
+    name = "vsphere"
+    supports_tpu = False           # TPUs are GCE-only; plans with pools are rejected
+
+    def render_tf(self, name: str, region: Region, zones: list[Zone], plan: Plan,
+                  hosts: list[Host], ctx) -> dict:
+        cat = ctx.catalog
+        models = {"master": cat.compute_models.get(plan.master_model),
+                  "worker": cat.compute_models.get(plan.worker_model)}
+        zone_by_id = {z.id: z for z in zones}
+
+        # per-zone data sources (reference tf.j2 lines 1-40)
+        data: dict = {
+            "vsphere_datacenter": {"dc": {
+                "name": region.vars.get("datacenter", region.name)}},
+        }
+        for z in zones:
+            suffix = z.name.replace("-", "_")
+            data.setdefault("vsphere_compute_cluster", {})[f"cluster_{suffix}"] = {
+                "name": z.vars.get("cluster", z.name),
+                "datacenter_id": "${data.vsphere_datacenter.dc.id}"}
+            data.setdefault("vsphere_network", {})[f"net_{suffix}"] = {
+                "name": z.vars.get("network", "VM Network"),
+                "datacenter_id": "${data.vsphere_datacenter.dc.id}"}
+            data.setdefault("vsphere_datastore", {})[f"ds_{suffix}"] = {
+                "name": z.vars.get("datastore", "datastore1"),
+                "datacenter_id": "${data.vsphere_datacenter.dc.id}"}
+        data["vsphere_virtual_machine"] = {"template": {
+            "name": region.vars.get("template", "ubuntu-2204-template"),
+            "datacenter_id": "${data.vsphere_datacenter.dc.id}"}}
+
+        vms: dict = {}
+        for h in hosts:
+            zone = zone_by_id.get(h.zone_id)
+            suffix = (zone.name if zone else "default").replace("-", "_")
+            model = models[machine_role(h)]
+            vms[h.name.replace(".", "-")] = {
+                "name": h.name,
+                "resource_pool_id":
+                    f"${{data.vsphere_compute_cluster.cluster_{suffix}.resource_pool_id}}",
+                "datastore_id": f"${{data.vsphere_datastore.ds_{suffix}.id}}",
+                "num_cpus": model.cpu if model else 4,
+                "memory": (model.memory_gb if model else 8) * 1024,
+                "guest_id": "${data.vsphere_virtual_machine.template.guest_id}",
+                "network_interface": {
+                    "network_id": f"${{data.vsphere_network.net_{suffix}.id}}"},
+                "disk": {"label": "disk0",
+                         "size": model.disk_gb if model else 100},
+                "clone": {
+                    "template_uuid": "${data.vsphere_virtual_machine.template.id}",
+                    "customize": {
+                        "linux_options": {"host_name": h.name,
+                                          "domain": "cluster.local"},
+                        "network_interface": {
+                            "ipv4_address": h.ip,
+                            "ipv4_netmask": int((zone.vars.get("netmask_prefix", 24)
+                                                 if zone else 24))},
+                        "ipv4_gateway": (zone.vars.get("gateway", "")
+                                         if zone else ""),
+                    },
+                },
+            }
+        return {
+            "terraform": {"required_providers": {
+                "vsphere": {"source": "hashicorp/vsphere"}}},
+            "provider": {"vsphere": {
+                "vsphere_server": region.vars.get("vcenter", ""),
+                "user": region.vars.get("username", ""),
+                "password": region.vars.get("password", ""),
+                "allow_unverified_ssl": True}},
+            "data": data,
+            "resource": {"vsphere_virtual_machine": vms} if vms else {},
+        }

@@ -1,0 +1,25 @@
+"""Typed resource model + persistence.
+
+Replaces the reference's Django ORM domain layer
+(``core/apps/kubeops_api/models/``, ``cloud_provider/models.py``,
+``ansible_api/models/``) with plain dataclasses persisted in a sqlite
+document store. Multi-tenant scoping (the reference's thread-local
+``ProjectResourceManager``, ``ansible_api/ctx.py`` + ``models/mixins.py``)
+is provided by ``scope.current_project``.
+"""
+
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, DeployType, Credential, Host, Node, Region, Zone,
+    Plan, TpuPool, DeployExecution, ExecutionStep, Package, Item, ItemResource,
+    User, Setting, Message, BackupStorage, BackupStrategy, ClusterBackup,
+    HealthRecord,
+)
+
+__all__ = [
+    "Store", "Cluster", "ClusterStatus", "DeployType", "Credential", "Host",
+    "Node", "Region", "Zone", "Plan", "TpuPool", "DeployExecution",
+    "ExecutionStep", "Package", "Item", "ItemResource", "User", "Setting",
+    "Message", "BackupStorage", "BackupStrategy", "ClusterBackup",
+    "HealthRecord",
+]

@@ -1,0 +1,441 @@
+"""Domain entities.
+
+Dataclass equivalents of the reference's Django models, with the TPU
+additions that BASELINE.json's north star requires (TPU pools, slice
+topology, accelerator detection fields).
+
+Reference parity map (model -> reference file):
+* Cluster        -> core/apps/kubeops_api/models/cluster.py
+* DeployExecution-> core/apps/kubeops_api/models/deploy.py
+* Host           -> core/apps/kubeops_api/models/host.py
+* Node           -> core/apps/kubeops_api/models/node.py
+* Credential     -> core/apps/kubeops_api/models/credential.py
+* Region/Zone/Plan -> core/apps/cloud_provider/models.py
+* Package        -> core/apps/kubeops_api/models/package.py
+* Item/ItemResource -> core/apps/kubeops_api/models/item.py, item_resource.py
+* User           -> core/apps/users/models.py
+* Setting        -> core/apps/kubeops_api/models/setting.py
+* Message        -> core/apps/message_center/models.py
+* BackupStorage/ClusterBackup/BackupStrategy -> models/backup_*.py
+* HealthRecord   -> models/cluster_health_history.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubeoperator_tpu.utils.ids import new_id
+from kubeoperator_tpu.utils.timeutil import iso
+
+
+# ---------------------------------------------------------------------------
+# enums (string constants — keep JSON round-trips trivial)
+# ---------------------------------------------------------------------------
+
+class ClusterStatus:
+    """8 statuses, reference ``cluster.py:31-55``."""
+    READY = "READY"
+    RUNNING = "RUNNING"
+    ERROR = "ERROR"
+    WARNING = "WARNING"
+    INSTALLING = "INSTALLING"
+    DELETING = "DELETING"
+    UPGRADING = "UPGRADING"
+    RESTORING = "RESTORING"
+    BACKUP = "BACKUP"
+    ALL = (READY, RUNNING, ERROR, WARNING, INSTALLING, DELETING, UPGRADING, RESTORING, BACKUP)
+
+
+class DeployType:
+    MANUAL = "MANUAL"          # pre-existing hosts
+    AUTOMATIC = "AUTOMATIC"    # provider-created (terraform/GCE)
+
+
+class StepState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCESS = "success"
+    ERROR = "error"
+    SKIPPED = "skipped"            # converged in a prior run (retry resume)
+
+
+class ExecutionState:
+    PENDING = "PENDING"
+    STARTED = "STARTED"
+    SUCCESS = "SUCCESS"
+    FAILURE = "FAILURE"
+
+
+class AcceleratorType:
+    NONE = "none"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+# ---------------------------------------------------------------------------
+# inventory / credentials
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Credential:
+    KIND = "credential"
+    name: str = ""
+    username: str = "root"
+    password: str = ""            # stored encrypted via SecretBox by services
+    private_key: str = ""
+    type: str = "password"        # password | key
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    size_gb: float = 0.0
+
+
+@dataclass
+class Host:
+    """Inventory host. ``accelerator``/``tpu_*`` replace the reference's
+    GPU-only fields (``host.py:38,46-48``); facts come from the gather step
+    (reference ``host.gather_info`` ``host.py:96-142``)."""
+    KIND = "host"
+    name: str = ""
+    ip: str = ""
+    port: int = 22
+    credential_id: str = ""
+    status: str = "PENDING"       # PENDING|RUNNING|ERROR|CREATING
+    # gathered facts
+    memory_mb: int = 0
+    cpu_core: int = 0
+    os: str = ""
+    os_version: str = ""
+    volumes: list[dict] = field(default_factory=list)
+    # accelerator facts (gpu: lspci probe parity; tpu: metadata probe)
+    accelerator: str = AcceleratorType.NONE
+    gpu_num: int = 0
+    gpu_info: str = ""
+    tpu_type: str = ""            # e.g. v5e-16 — the slice this host belongs to
+    tpu_worker_id: int = -1       # worker index within the slice
+    tpu_slice_id: str = ""        # pool/slice identity (one slice = many hosts)
+    # placement
+    zone_id: str = ""
+    project: str | None = None    # owning cluster name (None = unassigned)
+    auto_created: bool = False
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+    @property
+    def memory_gb(self) -> int:
+        return round(self.memory_mb / 1024)
+
+    @property
+    def has_tpu(self) -> bool:
+        return self.accelerator == AcceleratorType.TPU
+
+    @property
+    def has_gpu(self) -> bool:
+        return self.accelerator == AcceleratorType.GPU
+
+
+@dataclass
+class Node:
+    """Cluster node = host bound to k8s roles. Role groups drive which steps
+    run where; accelerator node-vars propagate like ``node.py:40-50``."""
+    KIND = "node"
+    name: str = ""
+    host_id: str = ""
+    roles: list[str] = field(default_factory=list)   # master|worker|etcd|new_node|...
+    vars: dict[str, Any] = field(default_factory=dict)
+    project: str | None = None
+    status: str = "READY"
+    id: str = field(default_factory=new_id)
+
+
+# ---------------------------------------------------------------------------
+# cluster & executions
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Cluster:
+    KIND = "cluster"
+    name: str = ""
+    version: str = ""               # k8s version from package
+    template: str = "SINGLE"        # SINGLE | MULTIPLE (3-master HA)
+    deploy_type: str = DeployType.MANUAL
+    status: str = ClusterStatus.READY
+    network_plugin: str = "calico"
+    network_config: dict[str, Any] = field(default_factory=dict)
+    storage_provider: str = "local-volume"
+    storage_config: dict[str, Any] = field(default_factory=dict)
+    plan_id: str = ""               # AUTOMATIC only
+    package: str = ""               # offline package name
+    item: str = ""                  # tenancy workspace
+    configs: dict[str, Any] = field(default_factory=dict)  # merged vars (ref cluster.py:188-226)
+    project: str | None = None      # == name; a cluster IS a project (ref cluster.py:20)
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+    def __post_init__(self):
+        if self.project is None:
+            self.project = self.name
+
+
+@dataclass
+class ExecutionStep:
+    name: str = ""
+    status: str = StepState.PENDING
+    message: str = ""
+    started_at: str = ""
+    finished_at: str = ""
+
+
+@dataclass
+class DeployExecution:
+    """Day-1/Day-2 operation record with per-step state machine
+    (reference ``deploy.py:31-34,283-287``)."""
+    KIND = "execution"
+    operation: str = "install"
+    project: str | None = None      # cluster name
+    state: str = ExecutionState.PENDING
+    steps: list[dict] = field(default_factory=list)   # serialized ExecutionStep
+    current_step: str = ""
+    progress: float = 0.0
+    result: dict[str, Any] = field(default_factory=dict)
+    params: dict[str, Any] = field(default_factory=dict)  # e.g. {"num": 5} for scale
+    started_at: str = ""
+    finished_at: str = ""
+    name: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+    def step_objects(self) -> list[ExecutionStep]:
+        return [ExecutionStep(**s) for s in self.steps]
+
+
+# ---------------------------------------------------------------------------
+# provisioning (Day 0)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Region:
+    """Provider region (reference: vSphere datacenter / OpenStack region;
+    here: GCE region)."""
+    KIND = "region"
+    name: str = ""
+    provider: str = "gce"           # gce | static | vsphere | openstack
+    vars: dict[str, Any] = field(default_factory=dict)
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
+class Zone:
+    """AZ with an IP pool allocator (reference ``models.py:140-193``)."""
+    KIND = "zone"
+    name: str = ""
+    region_id: str = ""
+    vars: dict[str, Any] = field(default_factory=dict)
+    ip_pool: list[str] = field(default_factory=list)
+    ip_used: list[str] = field(default_factory=list)
+    status: str = "READY"
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
+class TpuPool:
+    """A TPU pod-slice worker pool: ONE schedulable unit spanning
+    ``hosts(slice_type)`` VMs. New concept vs the reference (its planner
+    assumes 1 host = 1 node, ``cloud_provider.py:125-174``)."""
+    slice_type: str = "v5e-8"
+    count: int = 1                   # number of slices
+    zone: str = ""
+    runtime_version: str = "tpu-ubuntu2204-base"
+
+
+@dataclass
+class Plan:
+    """Deploy plan (reference ``models.py:207-259``): template + compute
+    models for masters/workers + TPU pools + zone spread."""
+    KIND = "plan"
+    name: str = ""
+    region_id: str = ""
+    zone_ids: list[str] = field(default_factory=list)
+    template: str = "SINGLE"
+    master_model: str = "medium"
+    worker_model: str = "large"
+    worker_size: int = 1
+    tpu_pools: list[dict] = field(default_factory=list)   # serialized TpuPool
+    vars: dict[str, Any] = field(default_factory=dict)
+    id: str = field(default_factory=new_id)
+
+    def pools(self) -> list[TpuPool]:
+        return [TpuPool(**p) for p in self.tpu_pools]
+
+
+# ---------------------------------------------------------------------------
+# packages / tenancy / users / settings / messages / backup / health
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Package:
+    """Offline package registry entry (reference ``package.py:lookup`` scans
+    ``/data/packages/*/meta.yml``)."""
+    KIND = "package"
+    name: str = ""
+    meta: dict[str, Any] = field(default_factory=dict)
+    id: str = field(default_factory=new_id)
+
+    @property
+    def k8s_version(self) -> str:
+        return self.meta.get("vars", {}).get("kube_version", "")
+
+
+@dataclass
+class Item:
+    """Multi-tenant workspace (reference ``item.py:8-32``)."""
+    KIND = "item"
+    name: str = ""
+    description: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class ItemResource:
+    """Maps a resource (cluster/host/plan/backup-storage) into an item
+    (reference ``item_resource.py:8-25``)."""
+    KIND = "item_resource"
+    item_id: str = ""
+    resource_type: str = ""        # cluster | host | plan | backup_storage
+    resource_id: str = ""
+    name: str = ""
+    id: str = field(default_factory=new_id)
+
+
+class ItemRole:
+    VIEWER = "VIEWER"
+    MANAGER = "MANAGER"
+
+
+@dataclass
+class User:
+    KIND = "user"
+    name: str = ""
+    email: str = ""
+    is_admin: bool = False
+    source: str = "local"          # local | ldap
+    disabled: bool = False         # set by LDAP sync when the entry vanishes
+    password_hash: str = ""
+    salt: str = ""
+    item_roles: dict[str, str] = field(default_factory=dict)  # item name -> ItemRole
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+    def set_password(self, password: str) -> None:
+        self.salt = new_id()[:16]
+        self.password_hash = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), self.salt.encode(), 100_000
+        ).hex()
+
+    def check_password(self, password: str) -> bool:
+        if not self.password_hash:
+            return False
+        want = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), self.salt.encode(), 100_000
+        ).hex()
+        return hmac.compare_digest(want, self.password_hash)
+
+
+@dataclass
+class Setting:
+    KIND = "setting"
+    name: str = ""                 # key
+    value: str = ""
+    tab: str = "general"
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
+class Message:
+    """Message-center record (reference ``message_center/models.py:14-60``)."""
+    KIND = "message"
+    title: str = ""
+    content: dict[str, Any] = field(default_factory=dict)
+    level: str = "INFO"            # INFO | WARNING | ERROR
+    type: str = "SYSTEM"           # SYSTEM | CLUSTER | OPERATION
+    project: str | None = None
+    read_by: list[str] = field(default_factory=list)
+    name: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class StorageBackend:
+    """Managed storage backend (reference ``storage/models.py:20-60``:
+    ``NfsStorage`` — an NFS server the platform itself deploys onto a
+    host — and ``CephStorage`` — credentials for an external Ceph).
+
+    type=nfs  config: {host: <registered host name>, export_path: /export}
+    type=external-ceph  config: {monitors, user, key, pool}
+    """
+    KIND = "storage_backend"
+    name: str = ""
+    type: str = "nfs"              # nfs | external-ceph
+    config: dict[str, Any] = field(default_factory=dict)
+    status: str = "PENDING"        # PENDING | READY | ERROR
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class BackupStorage:
+    KIND = "backup_storage"
+    name: str = ""
+    type: str = "local"            # local | s3 | oss | azure
+    credentials: dict[str, Any] = field(default_factory=dict)
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
+class BackupStrategy:
+    """Daily etcd-backup schedule + retention (reference
+    ``backup_strategy.py``; cron daily 01:00 ``tasks.py:40-45``)."""
+    KIND = "backup_strategy"
+    project: str | None = None
+    backup_storage_id: str = ""
+    save_num: int = 5
+    enabled: bool = False
+    name: str = ""
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
+class ClusterBackup:
+    KIND = "cluster_backup"
+    project: str | None = None
+    folder: str = ""
+    backup_storage_id: str = ""
+    size_bytes: int = 0
+    name: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@dataclass
+class HealthRecord:
+    """Hour-grain health history, aggregated to days (reference
+    ``cluster_health_history.py`` + ``cluster_health_utils.py:11-40``)."""
+    KIND = "health_record"
+    project: str | None = None
+    kind: str = "host"             # host | node | component
+    target: str = ""
+    healthy: bool = True
+    detail: dict[str, Any] = field(default_factory=dict)
+    hour: str = ""                 # YYYY-MM-DDTHH
+    name: str = ""
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)

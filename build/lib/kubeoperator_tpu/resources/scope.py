@@ -1,0 +1,39 @@
+"""Tenancy scoping.
+
+The reference scopes every ansible-side query to a "current project"
+(= cluster) via a werkzeug thread-local (``ansible_api/ctx.py:9-33``) and a
+custom model manager (``models/mixins.py:14-35``). We use a ``contextvars``
+context variable, which also behaves correctly in asyncio and thread pools.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+
+_current: ContextVar[str | None] = ContextVar("ko_current_project", default=None)
+
+
+def current_project() -> str | None:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def project(name: str | None):
+    """``with scope.project(cluster.name): ...`` — the analogue of
+    ``Project.change_to()`` (``ansible_api/models/project.py:93-94``)."""
+    token = _current.set(name)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def root():
+    """Unscoped access — ``change_to_root()`` in the reference."""
+    token = _current.set(None)
+    try:
+        yield
+    finally:
+        _current.reset(token)

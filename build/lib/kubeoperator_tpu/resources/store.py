@@ -1,0 +1,185 @@
+"""Sqlite-backed document store for resource entities.
+
+One table per entity kind: ``(id TEXT PRIMARY KEY, name TEXT, project TEXT,
+data TEXT)`` where ``data`` is the JSON-serialized dataclass. This trades
+rich SQL for zero dependencies and a schema that never needs migrations —
+the control plane's query patterns (get by id/name, list by project/field)
+don't need more. WAL mode + a process-wide lock make it safe for the
+threaded task engine.
+
+Tenancy: queries are automatically filtered by ``scope.current_project()``
+when the entity carries a ``project`` field and a scope is active —
+the rebuilt equivalent of the reference's ``ProjectResourceManager``
+(``ansible_api/models/mixins.py:14-35``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from contextlib import contextmanager
+from dataclasses import asdict, fields, is_dataclass
+from typing import Any, Iterator, Type, TypeVar
+
+from kubeoperator_tpu.resources import scope
+
+T = TypeVar("T")
+
+
+def _table(cls: type) -> str:
+    return getattr(cls, "KIND", cls.__name__.lower())
+
+
+class Store:
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._lock = threading.RLock()
+        self._tables: set[str] = set()
+        self._in_tx = False
+
+    def _ensure(self, cls: type) -> str:
+        t = _table(cls)
+        if t not in self._tables:
+            with self._lock:
+                self._conn.execute(
+                    f"CREATE TABLE IF NOT EXISTS {t} ("
+                    "id TEXT PRIMARY KEY, name TEXT, project TEXT, data TEXT)"
+                )
+                self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_name ON {t}(name)")
+                self._conn.execute(f"CREATE INDEX IF NOT EXISTS idx_{t}_project ON {t}(project)")
+                if not self._in_tx:   # else DDL would commit the open block
+                    self._conn.commit()
+                    # only cache outside a tx: a rollback would drop the
+                    # table but not this cache, bricking the entity kind
+                    self._tables.add(t)
+        return t
+
+    # -- CRUD -------------------------------------------------------------
+    def save(self, entity: Any) -> Any:
+        assert is_dataclass(entity), f"{entity!r} is not a dataclass entity"
+        t = self._ensure(type(entity))
+        doc = asdict(entity)
+        with self._lock:
+            self._conn.execute(
+                f"INSERT INTO {t}(id, name, project, data) VALUES(?,?,?,?) "
+                "ON CONFLICT(id) DO UPDATE SET name=excluded.name, "
+                "project=excluded.project, data=excluded.data",
+                (doc["id"], doc.get("name"), doc.get("project"), json.dumps(doc)),
+            )
+            if not self._in_tx:
+                self._conn.commit()
+        return entity
+
+    def get(self, cls: Type[T], id: str, scoped: bool = True) -> T | None:
+        """Get by id. Honors tenancy scope: inside ``scope.project(p)`` a row
+        owned by a different project is invisible (returns None) unless
+        ``scoped=False`` — closing the cross-tenant id-lookup hole the
+        reference's manager-level filtering also guards against."""
+        t = self._ensure(cls)
+        with self._lock:
+            row = self._conn.execute(f"SELECT data FROM {t} WHERE id=?", (id,)).fetchone()
+        if not row:
+            return None
+        entity = self._load(cls, row[0])
+        proj = scope.current_project()
+        # strict visibility, matching find(): inside a scope, only rows of
+        # that project are visible (including hiding unassigned rows)
+        if (scoped and proj is not None
+                and "project" in {f.name for f in fields(cls)}
+                and getattr(entity, "project", None) != proj):
+            return None
+        return entity
+
+    def get_by_name(self, cls: Type[T], name: str, scoped: bool = True) -> T | None:
+        for e in self.find(cls, scoped=scoped, name=name):
+            return e
+        return None
+
+    def find(self, cls: Type[T], scoped: bool = True, **filters: Any) -> list[T]:
+        return list(self.iter(cls, scoped=scoped, **filters))
+
+    def _where(self, cls: type, scoped: bool, filters: dict) -> tuple[list[str], list]:
+        """Shared WHERE builder for iter()/count(). Ambient scope and an
+        explicit project filter are ANDed — crossing tenants always requires
+        ``scoped=False``. ``project=None`` selects unassigned rows."""
+        clauses: list[str] = []
+        args: list = []
+        proj = scope.current_project()
+        if scoped and proj is not None and "project" in {f.name for f in fields(cls)}:
+            clauses.append("project=?")
+            args.append(proj)
+        if "project" in filters:
+            p = filters.pop("project")
+            if p is None:
+                clauses.append("project IS NULL")
+            else:
+                clauses.append("project=?")
+                args.append(p)
+        if "name" in filters:
+            clauses.append("name=?")
+            args.append(filters.pop("name"))
+        return clauses, args
+
+    def iter(self, cls: Type[T], scoped: bool = True, **filters: Any) -> Iterator[T]:
+        t = self._ensure(cls)
+        sql = f"SELECT data FROM {t}"
+        clauses, args = self._where(cls, scoped, filters)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        with self._lock:
+            rows = self._conn.execute(sql, args).fetchall()
+        for (data,) in rows:
+            e = self._load(cls, data)
+            if all(getattr(e, k, None) == v for k, v in filters.items()):
+                yield e
+
+    def delete(self, cls: type, id: str) -> None:
+        t = self._ensure(cls)
+        with self._lock:
+            self._conn.execute(f"DELETE FROM {t} WHERE id=?", (id,))
+            if not self._in_tx:
+                self._conn.commit()
+
+    def count(self, cls: type, scoped: bool = True, **filters: Any) -> int:
+        if set(filters) <= {"name", "project"}:
+            t = self._ensure(cls)
+            clauses, args = self._where(cls, scoped, filters)
+            sql = f"SELECT COUNT(*) FROM {t}"
+            if clauses:
+                sql += " WHERE " + " AND ".join(clauses)
+            with self._lock:
+                return self._conn.execute(sql, args).fetchone()[0]
+        return len(self.find(cls, scoped=scoped, **filters))
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _load(cls: Type[T], data: str) -> T:
+        doc = json.loads(data)
+        names = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in names})
+
+    @contextmanager
+    def transaction(self):
+        """Serialized AND atomic: the store lock excludes other writers for
+        the whole block, and an exception rolls every write in the block
+        back (reference leans on ``select_for_update`` + Django's atomic,
+        ``cluster.py:279-286``). Reentrant — an inner transaction joins the
+        outer one."""
+        with self._lock:
+            if self._in_tx:
+                yield
+                return
+            self._in_tx = True
+            try:
+                yield
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+            finally:
+                self._in_tx = False

@@ -1,0 +1,6 @@
+"""Service layer (L5 orchestration) — the typed replacement for the
+reference's fat Django models + viewset glue."""
+
+from kubeoperator_tpu.services.platform import Platform
+
+__all__ = ["Platform"]

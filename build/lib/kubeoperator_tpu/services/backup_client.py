@@ -1,0 +1,77 @@
+"""Backup object-storage client (reference: ``storage_client.py:1-53``
+wraps jms_storage for S3/OSS/Azure). The local driver is complete; cloud
+drivers shell out to their CLIs when present and fail loudly otherwise —
+air-gapped deployments (the reference's own target) use local/NFS paths.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+from kubeoperator_tpu.config.loader import Config
+from kubeoperator_tpu.resources.entities import BackupStorage
+
+
+class BackupClientError(RuntimeError):
+    pass
+
+
+class LocalBackupClient:
+    def __init__(self, root: str):
+        self.root = root
+
+    def _p(self, folder: str) -> str:
+        return os.path.join(self.root, folder.replace("/", os.sep))
+
+    def upload(self, local_path: str, folder: str) -> None:
+        dest = self._p(folder)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        if os.path.abspath(local_path) != os.path.abspath(dest):
+            shutil.copy2(local_path, dest)
+
+    def download(self, folder: str, local_path: str) -> None:
+        src = self._p(folder)
+        if not os.path.exists(src):
+            raise BackupClientError(f"backup object missing: {folder}")
+        os.makedirs(os.path.dirname(local_path), exist_ok=True)
+        shutil.copy2(src, local_path)
+
+    def delete(self, folder: str) -> None:
+        p = self._p(folder)
+        if os.path.exists(p):
+            os.remove(p)
+
+
+class CliBackupClient:
+    """S3 (aws/gsutil-style) driver via CLI; used only when the binary
+    exists on the controller."""
+
+    def __init__(self, storage: BackupStorage):
+        self.bucket = storage.credentials.get("bucket", "")
+        self.cli = storage.credentials.get("cli", "aws")
+        if not shutil.which(self.cli):
+            raise BackupClientError(
+                f"backup storage type {storage.type!r} needs the {self.cli!r} CLI")
+
+    def _run(self, *args: str) -> None:
+        p = subprocess.run([self.cli, "s3", *args], capture_output=True, text=True)
+        if p.returncode != 0:
+            raise BackupClientError(p.stderr.strip())
+
+    def upload(self, local_path: str, folder: str) -> None:
+        self._run("cp", local_path, f"s3://{self.bucket}/{folder}")
+
+    def download(self, folder: str, local_path: str) -> None:
+        self._run("cp", f"s3://{self.bucket}/{folder}", local_path)
+
+    def delete(self, folder: str) -> None:
+        self._run("rm", f"s3://{self.bucket}/{folder}")
+
+
+def storage_client(storage: BackupStorage, config: Config):
+    if storage.type == "local":
+        root = storage.credentials.get("path") or config.backups
+        return LocalBackupClient(root)
+    return CliBackupClient(storage)

@@ -1,0 +1,67 @@
+"""Backup-strategy beat (reference: daily crontab 01:00 → ``cluster_backup``
+→ due strategies → run_backup, ``kubeops_api/tasks.py:40-45`` +
+``cluster_backup_utils.py:11-30``; retention itself lives in the
+etcd-backup step)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.resources.entities import (
+    BackupStrategy, Cluster, ClusterBackup, ClusterStatus,
+)
+from kubeoperator_tpu.utils.logs import get_logger
+from kubeoperator_tpu.utils.timeutil import iso
+
+log = get_logger(__name__)
+
+
+def due_strategies(platform, now_iso: str | None = None) -> list[BackupStrategy]:
+    """Enabled strategies whose cluster is RUNNING and has no backup today."""
+    from kubeoperator_tpu.resources.entities import DeployExecution
+
+    now_iso = now_iso or iso()
+    today = now_iso[:10]
+    due = []
+    for strategy in platform.store.find(BackupStrategy, scoped=False):
+        if not strategy.enabled or not strategy.project:
+            continue
+        cluster = platform.store.get_by_name(Cluster, strategy.project, scoped=False)
+        if cluster is None or cluster.status != ClusterStatus.RUNNING:
+            continue
+        # gate on today's backup *executions* (any state), not just completed
+        # ClusterBackup rows — otherwise a running or failed backup gets
+        # re-dispatched every tick for the rest of the day
+        attempts = platform.store.find(DeployExecution, scoped=False,
+                                       project=strategy.project, operation="backup")
+        if any(a.created_at[:10] == today for a in attempts):
+            continue
+        due.append(strategy)
+    return due
+
+
+def backup_tick(platform, now_iso: str | None = None) -> list[str]:
+    """Run once the configured hour has passed (reference crontab 01:00);
+    returns started cluster names. ``>=`` rather than ``==``: the timer
+    drifts (period = interval + run duration) and a restart may skip the
+    exact hour — due_strategies' no-backup-today check keeps this
+    idempotent within a day."""
+    now_iso = now_iso or iso()
+    hour = int(now_iso[11:13])
+    if hour < int(platform.config.backup_hour):
+        return []
+    started = []
+    for strategy in due_strategies(platform, now_iso):
+        try:
+            ex = platform.create_execution(strategy.project, "backup",
+                                           {"backup_storage_id": strategy.backup_storage_id})
+            platform.start_execution(ex)
+            started.append(strategy.project)
+        except Exception as e:  # noqa: BLE001 — per-cluster boundary
+            log.warning("scheduled backup for %s failed to start: %s",
+                        strategy.project, e)
+    return started
+
+
+def schedule(platform) -> None:
+    # 5-minute cadence: cheap no-op outside the window, and drift/restarts
+    # can't skip a day the way an exact-hour match on an hourly timer could
+    platform.tasks.every(300, "backup-strategy", lambda: backup_tick(platform))

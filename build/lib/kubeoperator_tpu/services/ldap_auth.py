@@ -1,0 +1,347 @@
+"""LDAP authentication (reference ``users/authentication/ldap.py`` (121 LoC)
+via django-auth-ldap + periodic sync ``users/sync/ldap.py``).
+
+No LDAP client library ships in this image, and the needed subset is tiny:
+an LDAPv3 *simple bind* is one BER-encoded request/response pair. The DN is
+built from a template setting (django-auth-ldap's ``AUTH_LDAP_USER_DN_TEMPLATE``
+mode — the non-search flow, which is what air-gapped deployments use).
+
+Settings rows (``Setting`` kind):
+  ldap_enabled=true|false, ldap_host, ldap_port (389),
+  ldap_user_dn_template  e.g. "uid={username},ou=people,dc=corp,dc=example"
+  ldap_email_domain      fallback email domain for auto-created users
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable
+
+from kubeoperator_tpu.resources.entities import Setting, User
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+# -- minimal BER ------------------------------------------------------------
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _tlv(tag: int, content: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(content)) + content
+
+
+def _int(value: int) -> bytes:
+    body = value.to_bytes(max(1, (value.bit_length() + 8) // 8), "big", signed=True)
+    return _tlv(0x02, body)
+
+
+def bind_request(message_id: int, dn: str, password: str) -> bytes:
+    """LDAPMessage{ messageID, BindRequest{ version=3, name, simple pw } }"""
+    bind = (_int(3)
+            + _tlv(0x04, dn.encode())              # name: OCTET STRING
+            + _tlv(0x80, password.encode()))       # auth: [0] simple
+    op = _tlv(0x60, bind)                          # [APPLICATION 0] BindRequest
+    return _tlv(0x30, _int(message_id) + op)
+
+
+def parse_bind_result(data: bytes) -> int:
+    """Return the resultCode of a BindResponse (0 == success).
+
+    Walks: SEQUENCE { INTEGER msgid, [APPLICATION 1] { ENUMERATED code ... } }
+    """
+    def read_tlv(buf: bytes, pos: int) -> tuple[int, bytes, int]:
+        tag = buf[pos]
+        length = buf[pos + 1]
+        pos += 2
+        if length & 0x80:
+            n = length & 0x7F
+            length = int.from_bytes(buf[pos:pos + n], "big")
+            pos += n
+        return tag, buf[pos:pos + length], pos + length
+
+    tag, seq, _ = read_tlv(data, 0)
+    if tag != 0x30:
+        raise ValueError("not an LDAPMessage")
+    _, _msgid, pos = read_tlv(seq, 0)
+    op_tag, op, _ = read_tlv(seq, pos)
+    if op_tag != 0x61:                             # [APPLICATION 1] BindResponse
+        raise ValueError(f"not a BindResponse (tag {op_tag:#x})")
+    code_tag, code, _ = read_tlv(op, 0)
+    if code_tag != 0x0A:                           # ENUMERATED
+        raise ValueError("malformed BindResponse")
+    return int.from_bytes(code, "big")
+
+
+# -- client -----------------------------------------------------------------
+
+def escape_dn(value: str) -> str:
+    """RFC 4514 escaping for an attribute value inside a DN (the reference's
+    django-auth-ldap applies escape_dn_chars in DN-template mode)."""
+    out = []
+    for i, ch in enumerate(value):
+        if ch in ',+"\\<>;=#' or (ch == " " and i in (0, len(value) - 1)):
+            out.append("\\" + ch)
+        elif ord(ch) < 0x20:
+            out.append(f"\\{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _recv_message(sock: socket.socket) -> bytes:
+    """Read one complete BER TLV (the outer LDAPMessage) — responses may
+    arrive split across TCP segments."""
+    data = b""
+    while len(data) < 2:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("LDAP server closed connection")
+        data += chunk
+    # total length = header + encoded length field + content length
+    first = data[1]
+    if first & 0x80:
+        n = first & 0x7F
+        while len(data) < 2 + n:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("truncated LDAP length field")
+            data += chunk
+        total = 2 + n + int.from_bytes(data[2:2 + n], "big")
+    else:
+        total = 2 + first
+    while len(data) < total:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("truncated LDAP response")
+        data += chunk
+    return data
+
+
+def _read_tlv(buf: bytes, pos: int) -> tuple[int, bytes, int]:
+    """(tag, content, next_pos); handles long-form lengths."""
+    tag = buf[pos]
+    length = buf[pos + 1]
+    pos += 2
+    if length & 0x80:
+        n = length & 0x7F
+        length = int.from_bytes(buf[pos:pos + n], "big")
+        pos += n
+    return tag, buf[pos:pos + length], pos + length
+
+
+def search_request(message_id: int, base_dn: str, attr: str = "uid",
+                   attrs: tuple[str, ...] = ("uid", "mail")) -> bytes:
+    """LDAPv3 SearchRequest: wholeSubtree, present-filter ``(attr=*)``
+    (the reference sync's user listing, ``users/sync/ldap.py``)."""
+    enum = lambda v: _tlv(0x0A, bytes([v]))
+    req = (_tlv(0x04, base_dn.encode())
+           + enum(2)                               # scope: wholeSubtree
+           + enum(0)                               # derefAliases: never
+           + _int(0) + _int(0)                     # size/time limits
+           + _tlv(0x01, b"\x00")                   # typesOnly: false
+           + _tlv(0x87, attr.encode())             # filter: present
+           + _tlv(0x30, b"".join(_tlv(0x04, a.encode()) for a in attrs)))
+    return _tlv(0x30, _int(message_id) + _tlv(0x63, req))
+
+
+def parse_search_entry(message: bytes) -> dict | None:
+    """One LDAPMessage → {"dn": ..., "<attr>": [values]} for a
+    SearchResultEntry, None for SearchResultDone/other."""
+    _, seq, _ = _read_tlv(message, 0)
+    _, _, pos = _read_tlv(seq, 0)                  # messageID
+    tag, op, _ = _read_tlv(seq, pos)
+    if tag != 0x64:                                # not SearchResultEntry
+        return None
+    _, dn, pos = _read_tlv(op, 0)
+    entry: dict = {"dn": dn.decode()}
+    _, attrlist, _ = _read_tlv(op, pos)
+    apos = 0
+    while apos < len(attrlist):
+        _, attr_seq, apos = _read_tlv(attrlist, apos)
+        _, atype, vpos = _read_tlv(attr_seq, 0)
+        _, vals_set, _ = _read_tlv(attr_seq, vpos)
+        vals, spos = [], 0
+        while spos < len(vals_set):
+            _, v, spos = _read_tlv(vals_set, spos)
+            vals.append(v.decode())
+        entry[atype.decode()] = vals
+    return entry
+
+
+def ldap_search(host: str, port: int, bind_dn: str, bind_password: str,
+                base_dn: str, attr: str = "uid",
+                attrs: tuple[str, ...] = ("uid", "mail"), timeout: float = 5.0,
+                connector: Callable[..., socket.socket] | None = None) -> list[dict]:
+    """Bind then list directory entries having ``attr`` under ``base_dn``.
+    Reads messages until SearchResultDone (tag 0x65)."""
+    connect = connector or (lambda: socket.create_connection((host, port),
+                                                             timeout=timeout))
+    entries: list[dict] = []
+    with connect() as sock:
+        buf = bytearray()
+
+        def next_message() -> bytes:
+            # _recv_message may not be reused here: search responses arrive
+            # many-messages-per-segment, so keep a running buffer and carve
+            # complete TLVs off the front
+            while True:
+                if len(buf) >= 2:
+                    first = buf[1]
+                    if first & 0x80:
+                        n = first & 0x7F
+                        total = (2 + n + int.from_bytes(buf[2:2 + n], "big")
+                                 if len(buf) >= 2 + n else None)
+                    else:
+                        total = 2 + first
+                    if total is not None and len(buf) >= total:
+                        message = bytes(buf[:total])
+                        del buf[:total]
+                        return message
+                chunk = sock.recv(4096)
+                if not chunk:
+                    raise ConnectionError("LDAP server closed connection")
+                buf.extend(chunk)
+
+        sock.sendall(bind_request(1, bind_dn, bind_password))
+        if parse_bind_result(next_message()) != 0:
+            raise PermissionError("LDAP sync bind rejected")
+        sock.sendall(search_request(2, base_dn, attr, attrs))
+        while True:
+            message = next_message()
+            _, seq, _ = _read_tlv(message, 0)
+            _, _, pos = _read_tlv(seq, 0)
+            tag, op, _ = _read_tlv(seq, pos)
+            if tag == 0x65:                        # SearchResultDone
+                # a non-zero resultCode (noSuchObject, sizeLimitExceeded…)
+                # must NOT read as "empty directory" — sync_users would
+                # mass-disable every LDAP user on a typo'd base DN
+                _, code, _ = _read_tlv(op, 0)
+                result = int.from_bytes(code, "big") if code else 0
+                if result != 0:
+                    raise RuntimeError(f"LDAP search failed: resultCode={result}")
+                break
+            entry = parse_search_entry(message)
+            if entry:
+                entries.append(entry)
+    return entries
+
+
+def simple_bind(host: str, port: int, dn: str, password: str,
+                timeout: float = 5.0,
+                connector: Callable[..., socket.socket] | None = None) -> bool:
+    """True iff the DN/password bind succeeds (resultCode 0)."""
+    connect = connector or (lambda: socket.create_connection((host, port),
+                                                             timeout=timeout))
+    with connect() as sock:
+        sock.sendall(bind_request(1, dn, password))
+        return parse_bind_result(_recv_message(sock)) == 0
+
+
+class LdapAuthenticator:
+    def __init__(self, platform, connector=None):
+        self.platform = platform
+        self.connector = connector
+
+    def _setting(self, name: str, default: str = "") -> str:
+        return self.platform.setting(name, default)
+
+    @property
+    def enabled(self) -> bool:
+        return self._setting("ldap_enabled", "false").lower() == "true"
+
+    def authenticate(self, username: str, password: str) -> User | None:
+        """Bind as the templated DN; on success mirror a local ``source=ldap``
+        user (reference sync creates Profile rows for LDAP users)."""
+        if not self.enabled or not password:
+            return None
+        template = self._setting("ldap_user_dn_template")
+        host = self._setting("ldap_host")
+        if not template or not host:
+            return None
+        # an existing LOCAL account must never be reachable via LDAP —
+        # otherwise a directory entry with the same uid takes over the
+        # local admin
+        user = self.platform.store.get_by_name(User, username, scoped=False)
+        if user is not None and (user.source != "ldap" or user.disabled):
+            return None
+        try:
+            dn = template.format(username=escape_dn(username))
+            ok = simple_bind(host, int(self._setting("ldap_port", "389")), dn,
+                             password, connector=self.connector)
+        except Exception as e:  # noqa: BLE001 — auth boundary: fail closed
+            log.warning("LDAP bind for %s failed: %s", username, e)
+            return None
+        if not ok:
+            return None
+        if user is None:
+            domain = self._setting("ldap_email_domain", "example.com")
+            user = User(name=username, email=f"{username}@{domain}", source="ldap")
+            self.platform.store.save(user)
+        return user
+
+
+# -- periodic sync (reference users/sync/ldap.py:1-75) ----------------------
+
+def sync_users(platform, connector=None) -> dict:
+    """Mirror the directory into the user table: create users for new
+    entries, re-enable returned ones, disable ldap-source users whose
+    entry vanished (the reference deactivates them the same way). Local
+    accounts are never touched.
+
+    Settings: ldap_sync_enabled, ldap_base_dn, ldap_bind_dn,
+    ldap_bind_password, ldap_user_attr (uid), ldap_email_attr (mail).
+    """
+    auth = LdapAuthenticator(platform, connector)
+    if not auth.enabled or \
+            platform.setting("ldap_sync_enabled", "false").lower() != "true":
+        return {"enabled": False}
+    host = platform.setting("ldap_host")
+    base_dn = platform.setting("ldap_base_dn")
+    if not host or not base_dn:
+        return {"enabled": False}
+    uid_attr = platform.setting("ldap_user_attr", "uid")
+    mail_attr = platform.setting("ldap_email_attr", "mail")
+    entries = ldap_search(
+        host, int(platform.setting("ldap_port", "389")),
+        platform.setting("ldap_bind_dn"), platform.setting("ldap_bind_password"),
+        base_dn, attr=uid_attr, attrs=(uid_attr, mail_attr),
+        connector=connector)
+    domain = platform.setting("ldap_email_domain", "example.com")
+    seen: set[str] = set()
+    created, enabled, disabled = [], [], []
+    for entry in entries:
+        names = entry.get(uid_attr) or []
+        if not names:
+            continue
+        name = names[0]
+        seen.add(name)
+        user = platform.store.get_by_name(User, name, scoped=False)
+        if user is None:
+            email = (entry.get(mail_attr) or [f"{name}@{domain}"])[0]
+            platform.store.save(User(name=name, email=email, source="ldap"))
+            created.append(name)
+        elif user.source == "ldap" and user.disabled:
+            user.disabled = False
+            platform.store.save(user)
+            enabled.append(name)
+    for user in platform.store.find(User, scoped=False):
+        if user.source == "ldap" and user.name not in seen and not user.disabled:
+            user.disabled = True
+            platform.store.save(user)
+            disabled.append(user.name)
+    log.info("ldap sync: +%d created, %d re-enabled, %d disabled",
+             len(created), len(enabled), len(disabled))
+    return {"enabled": True, "created": created, "reenabled": enabled,
+            "disabled": disabled}
+
+
+def schedule(platform, connector=None) -> None:
+    """Hourly directory sync beat (reference registers the sync as a
+    periodic celery task)."""
+    platform.tasks.every(3600, "ldap-sync", lambda: sync_users(platform, connector))

@@ -1,0 +1,100 @@
+"""System-log search over the per-task log files.
+
+The reference ships its Django logs to Elasticsearch via CMRESHandler and
+searches them with DSL queries (``core/apps/log/es.py:9-52``,
+``settings.py:248-256``); cluster events get the same treatment
+(``cluster_monitor.py:506-534``). Here the control plane's durable logs
+already live as structured lines in ``<data>/tasks/<task_id>.log``
+(engine/tasks.py), so the search plane is a filtered scan of those files —
+no log database to run, same query surface: free-text match, level filter,
+time ordering, pagination.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+# utils/logs.FORMAT: "%(asctime)s %(levelname)s %(name)s %(message)s"
+LINE_RE = re.compile(
+    r"^(?P<ts>\d{4}-\d{2}-\d{2} [\d:,]+) (?P<level>[A-Z]+) (?P<logger>\S+) "
+    r"(?P<message>.*)$")
+
+LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
+
+
+def _iter_task_logs(log_dir: str):
+    if not os.path.isdir(log_dir):
+        return
+    entries = ((e.name, e.stat().st_mtime) for e in os.scandir(log_dir)
+               if e.name.endswith(".log"))
+    # newest files first so the limit cuts the oldest records
+    for name, _ in sorted(entries, key=lambda p: -p[1]):
+        yield name[:-4], os.path.join(log_dir, name)
+
+
+def search_logs(platform, query: str = "", level: str = "", task_id: str = "",
+                limit: int = 200) -> list[dict[str, Any]]:
+    """Search the task logs (reference ``search_log``/``search_event``,
+    ``log/es.py:9-52``). Matches are case-insensitive substrings over the
+    message; multi-line continuations (tracebacks) attach to their record.
+    Returns newest-first ``{task, ts, level, logger, message}`` dicts."""
+    level = level.upper()
+    if level and level not in LEVELS:
+        raise ValueError(f"unknown level {level!r} (want one of {LEVELS})")
+    needle = query.lower()
+    out: list[dict[str, Any]] = []
+    log_dir = platform.tasks.log_dir
+    for tid, path in _iter_task_logs(log_dir):
+        if task_id and tid != task_id:
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                records: list[dict] = []
+                for line in f:
+                    m = LINE_RE.match(line.rstrip("\n"))
+                    if m:
+                        records.append({"task": tid, **m.groupdict()})
+                    elif records:       # traceback/continuation line
+                        records[-1]["message"] += "\n" + line.rstrip("\n")
+        except OSError:
+            continue
+        for rec in records:
+            if level and rec["level"] != level:
+                continue
+            if needle and needle not in rec["message"].lower() \
+                    and needle not in rec["logger"].lower():
+                continue
+            out.append(rec)
+    # all files are scanned before sorting: file mtime says nothing about
+    # how old individual lines are, so an early cut-off could drop the
+    # newest matches while returning stale ones
+    out.sort(key=lambda r: r["ts"], reverse=True)
+    return out[:limit]
+
+
+def search_events(platform, query: str = "", cluster: str = "",
+                  event_type: str = "", limit: int = 200) -> list[dict[str, Any]]:
+    """Search harvested cluster events (reference ``search_event`` over the
+    ES event index; here events persist as ``<name>:events`` snapshots,
+    monitor.ClusterMonitor.harvest_events)."""
+    from kubeoperator_tpu.services.monitor import MonitorSnapshot
+
+    needle = query.lower()
+    out = []
+    for snap in platform.store.find(MonitorSnapshot, scoped=False):
+        if not snap.name.endswith(":events"):
+            continue
+        cname = snap.name[:-len(":events")]
+        if cluster and cname != cluster:
+            continue
+        for e in snap.data.get("events", []):
+            if event_type and e.get("type") != event_type:
+                continue
+            text = f"{e.get('reason','')} {e.get('message','')} {e.get('object','')}"
+            if needle and needle not in text.lower():
+                continue
+            out.append({"cluster": cname, **e})
+    out.sort(key=lambda e: e.get("time") or "", reverse=True)
+    return out[:limit]

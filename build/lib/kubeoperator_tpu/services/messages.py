@@ -1,0 +1,139 @@
+"""Message-center fan-out (reference ``message_center/message_client.py:22-90``:
+``insert_message`` fans a Message out per-user via LOCAL/EMAIL/DINGTALK/
+WORKWEIXIN using ko_notification_utils).
+
+Channels here: LOCAL (the stored Message itself — users read it in the UI),
+EMAIL (smtplib against the SMTP settings rows), WEBHOOK (DingTalk/WeCom-style
+JSON POST to a configured URL). The outbound senders are injectable so tests
+assert fan-out with no network.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Callable
+
+from kubeoperator_tpu.resources.entities import Message, Setting, User
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+LEVEL_RANK = {"INFO": 0, "WARNING": 1, "ERROR": 2}
+
+
+def _send_email(smtp: dict, to: str, subject: str, body: str) -> None:
+    import smtplib
+    from email.mime.text import MIMEText
+
+    msg = MIMEText(body)
+    msg["Subject"] = subject
+    msg["From"] = smtp.get("sender", smtp.get("username", "kubeoperator"))
+    msg["To"] = to
+    with smtplib.SMTP(smtp["host"], int(smtp.get("port", 25)), timeout=10) as s:
+        if smtp.get("username"):
+            s.starttls()
+            s.login(smtp["username"], smtp.get("password", ""))
+        s.send_message(msg)
+
+
+def _send_webhook(url: str, payload: dict) -> None:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+class MessageCenter:
+    def __init__(self, platform,
+                 email_sender: Callable[[dict, str, str, str], None] | None = None,
+                 webhook_sender: Callable[[str, dict], None] | None = None):
+        self.platform = platform
+        self.email_sender = email_sender or _send_email
+        self.webhook_sender = webhook_sender or _send_webhook
+
+    # -- settings ----------------------------------------------------------
+    def _setting(self, name: str, default: str = "") -> str:
+        return self.platform.setting(name, default)
+
+    def smtp_config(self) -> dict | None:
+        host = self._setting("smtp_host")
+        if not host:
+            return None
+        return {"host": host, "port": self._setting("smtp_port", "25"),
+                "username": self._setting("smtp_username"),
+                "password": self._setting("smtp_password"),
+                "sender": self._setting("smtp_sender")}
+
+    def user_channels(self, user: User) -> list[str]:
+        """Per-user channel subscription, stored as a setting row
+        ``notify.<user>`` = "LOCAL,EMAIL,WEBHOOK" (reference: per-user
+        subscription configs)."""
+        raw = self._setting(f"notify.{user.name}", "LOCAL")
+        return [c.strip().upper() for c in raw.split(",") if c.strip()]
+
+    def min_level(self) -> str:
+        return self._setting("notify_min_level", "INFO").upper()
+
+    # -- dispatch ----------------------------------------------------------
+    def _channel_payload(self, channel: str, message: Message) -> dict:
+        """Native payload shapes per channel (reference ko_notification_utils
+        formats DingTalk and WorkWeixin messages distinctly)."""
+        text = f"[{message.level}] {message.title}"
+        if channel == "DINGTALK":
+            detail = "\n".join(f"- {k}: {v}" for k, v in message.content.items())
+            return {"msgtype": "markdown",
+                    "markdown": {"title": text,
+                                 "text": f"### {text}\n{detail}"}}
+        if channel == "WORKWEIXIN":
+            return {"msgtype": "markdown",
+                    "markdown": {"content": f"**{text}**\n"
+                                 + "\n".join(f"> {k}: {v}"
+                                             for k, v in message.content.items())}}
+        return {"msgtype": "text", "text": {"content": text},
+                "detail": message.content}
+
+    WEBHOOK_CHANNELS = {"WEBHOOK": "webhook_url",
+                        "DINGTALK": "dingtalk_webhook_url",
+                        "WORKWEIXIN": "workweixin_webhook_url"}
+
+    def dispatch(self, message: Message) -> dict[str, list[str]]:
+        """Fan out one stored message. Returns {channel: [recipients]} for
+        observability/tests. LOCAL needs no work: the Message row IS the
+        in-app notification."""
+        sent: dict[str, list[str]] = {"LOCAL": [], "EMAIL": [], "WEBHOOK": [],
+                                      "DINGTALK": [], "WORKWEIXIN": []}
+        if LEVEL_RANK.get(message.level, 0) < LEVEL_RANK.get(self.min_level(), 0):
+            return sent
+        smtp = self.smtp_config()
+        body = json.dumps({"title": message.title, "level": message.level,
+                           "project": message.project, **message.content})
+        hook_subscribed: set[str] = set()
+        for user in self.platform.store.find(User, scoped=False):
+            channels = self.user_channels(user)
+            if "LOCAL" in channels:
+                sent["LOCAL"].append(user.name)
+            hook_subscribed.update(c for c in channels if c in self.WEBHOOK_CHANNELS)
+            if "EMAIL" in channels and smtp and user.email:
+                try:
+                    self.email_sender(smtp, user.email,
+                                      f"[kubeoperator] {message.title}", body)
+                    sent["EMAIL"].append(user.email)
+                except Exception as e:  # noqa: BLE001 — channel boundary
+                    log.warning("email to %s failed: %s", user.email, e)
+        for channel in sorted(hook_subscribed):
+            url = self._setting(self.WEBHOOK_CHANNELS[channel])
+            if not url:
+                continue
+            try:
+                self.webhook_sender(url, self._channel_payload(channel, message))
+                sent[channel].append(url)
+            except Exception as e:  # noqa: BLE001
+                log.warning("%s webhook failed: %s", channel, e)
+        return sent
+
+    def mark_read(self, message_id: str, username: str) -> None:
+        msg = self.platform.store.get(Message, message_id, scoped=False)
+        if msg and username not in msg.read_by:
+            msg.read_by.append(username)
+            self.platform.store.save(msg)

@@ -1,0 +1,106 @@
+"""Offline-package management — the air-gapped install story.
+
+The reference scans ``/data/packages/*/meta.yml`` into Package rows
+(``core/apps/kubeops_api/models/package.py`` ``lookup``) and runs a nexus3
+container per package as the offline yum/docker/raw repo
+(``package_manage.py:31-53``); every kubeasz role then pulls binaries from
+that nexus. Here the control plane itself serves each package directory
+over ``/repo/<package>/...`` (a "nexus-lite" static file repo — no Java
+sidecar to manage), and ``create_cluster`` points the cluster's
+``repo_url`` at it, so the engine steps' ``curl $repo_url/...`` pulls from
+the controller with zero external infrastructure.
+
+meta.yml schema (ours, TPU-extended):
+
+    name: k8s-v1.28-tpu          # package identity (defaults to dir name)
+    version: "1.28.2"
+    vars:                        # merged into cluster configs at create
+      kube_version: v1.28.2
+      libtpu_version: "0.9"
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from kubeoperator_tpu.resources.entities import Package
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+def _parse_meta(path: str) -> dict[str, Any]:
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: meta.yml must be a mapping")
+    return data
+
+
+def scan_packages(platform) -> list[Package]:
+    """Scan ``<package_dir>/*/meta.yml`` into Package rows (reference
+    ``Package.lookup``). Upserts by name; packages whose directory vanished
+    are dropped so the registry mirrors the disk."""
+    pkg_dir = platform.config.packages
+    found: dict[str, Package] = {}
+    if os.path.isdir(pkg_dir):
+        for entry in sorted(os.scandir(pkg_dir), key=lambda e: e.name):
+            meta_path = os.path.join(entry.path, "meta.yml")
+            if not entry.is_dir() or not os.path.isfile(meta_path):
+                continue
+            try:
+                meta = _parse_meta(meta_path)
+            except Exception as e:  # noqa: BLE001 — per-package boundary
+                log.warning("skipping package %s: %s", entry.name, e)
+                continue
+            name = str(meta.get("name") or entry.name)
+            meta["dir"] = entry.name
+            existing = platform.store.get_by_name(Package, name, scoped=False)
+            pkg = existing or Package(name=name)
+            pkg.meta = meta
+            platform.store.save(pkg)
+            found[name] = pkg
+    for pkg in platform.store.find(Package, scoped=False):
+        # only prune rows that came from a scan (have a dir); API-created
+        # registry entries without backing files are left alone
+        if pkg.name not in found and pkg.meta.get("dir"):
+            platform.store.delete(Package, pkg.id)
+            log.info("package %s removed (directory gone)", pkg.name)
+    return list(found.values())
+
+
+def package_root(platform, package: Package) -> str:
+    return os.path.join(platform.config.packages,
+                        package.meta.get("dir", package.name))
+
+
+def repo_url(platform, package: Package) -> str:
+    """URL nodes use to pull from this package's repo. ``repo_host`` must be
+    an address the nodes can reach; a wildcard bind address cannot be
+    baked into node commands, so that misconfiguration fails at cluster
+    creation rather than as an obscure mid-install download error."""
+    host = platform.config.get("repo_host") or platform.config.bind_host
+    if host in ("0.0.0.0", "::", ""):
+        raise ValueError(
+            "cannot derive a node-reachable package repo URL from wildcard "
+            f"bind address {platform.config.bind_host!r}; set KO_REPO_HOST "
+            "to the controller address nodes can reach")
+    return f"http://{host}:{platform.config.bind_port}/repo/{package.name}"
+
+
+def resolve_file(platform, package_name: str, rel_path: str) -> str:
+    """Map a ``/repo/<package>/<path>`` request to a file on disk;
+    traversal-safe. Raises FileNotFoundError / PermissionError."""
+    pkg = platform.store.get_by_name(Package, package_name, scoped=False)
+    if pkg is None:
+        raise FileNotFoundError(f"package {package_name!r} not registered")
+    root = os.path.realpath(package_root(platform, pkg))
+    full = os.path.realpath(os.path.join(root, rel_path))
+    if not (full == root or full.startswith(root + os.sep)):
+        raise PermissionError("path escapes the package root")
+    if not os.path.isfile(full):
+        raise FileNotFoundError(rel_path)
+    return full

@@ -1,0 +1,6 @@
+"""In-cluster training entrypoints (the workload charts' exec target).
+
+``python -m kubeoperator_tpu.train.jobs <subcommand>`` is the command every
+bundled workload chart runs (apps/manifests.py) — the role the kubeapps
+charts play in the reference (``roles/kubeapps/tasks/main.yml:1-20``).
+"""

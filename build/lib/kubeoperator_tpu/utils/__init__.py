@@ -1,0 +1,6 @@
+"""Shared utilities for the control plane (no jax imports here)."""
+
+from kubeoperator_tpu.utils.ids import new_id, short_id
+from kubeoperator_tpu.utils.timeutil import utcnow, iso
+
+__all__ = ["new_id", "short_id", "utcnow", "iso"]

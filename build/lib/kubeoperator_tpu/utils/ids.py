@@ -1,0 +1,11 @@
+import uuid
+
+
+def new_id() -> str:
+    """Random primary key for resource rows (reference uses UUID pks
+    throughout, e.g. ``kubeops_api/models/cluster.py``)."""
+    return uuid.uuid4().hex
+
+
+def short_id(n: int = 8) -> str:
+    return uuid.uuid4().hex[:n]

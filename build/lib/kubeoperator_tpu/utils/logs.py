@@ -1,0 +1,61 @@
+"""Logging helpers.
+
+Per-task log files mirror the reference's celery task log capture
+(``core/apps/celery_api/logger.py:82-160`` writes every record of a task to
+``data/celery/<task_id>.log``). Here the task engine attaches a
+``TaskLogHandler`` around each task run.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import os
+import threading
+
+FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+# Which task the current execution context belongs to. Set by TaskEngine._run
+# and propagated into step fan-out worker threads via contextvars.copy_context
+# so concurrent tasks' records land only in their own log file.
+CURRENT_TASK: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ko_current_task", default="")
+_initialized = False
+_init_lock = threading.Lock()
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _initialized
+    if not _initialized:
+        with _init_lock:
+            if not _initialized:
+                root = logging.getLogger("kubeoperator_tpu")
+                h = logging.StreamHandler()
+                h.setFormatter(logging.Formatter(FORMAT))
+                root.addHandler(h)
+                level = os.environ.get("KO_LOG_LEVEL", "INFO").upper()
+                try:
+                    root.setLevel(level)
+                except ValueError:
+                    root.setLevel(logging.INFO)
+                _initialized = True
+    return logging.getLogger(name)
+
+
+class TaskLogHandler(logging.FileHandler):
+    """File handler scoped to one task id; the engine installs it on the
+    ``kubeoperator_tpu`` logger tree for the duration of a task. With a
+    ``task_id`` it only accepts records emitted from that task's context
+    (CURRENT_TASK), so concurrent tasks on the worker pool don't interleave
+    into each other's files."""
+
+    def __init__(self, path: str, task_id: str = ""):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        super().__init__(path, encoding="utf-8")
+        self.setFormatter(logging.Formatter(FORMAT))
+        self.task_id = task_id
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not self.task_id:
+            return True
+        return CURRENT_TASK.get() == self.task_id

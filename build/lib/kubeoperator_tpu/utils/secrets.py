@@ -1,0 +1,80 @@
+"""Reversible at-rest obfuscation for stored credentials.
+
+The reference encrypts credential fields with an ``EncryptCharField``
+(``core/apps/common/models.py``). We provide the same capability with a
+stdlib-only scheme: an HMAC-SHA256 keystream XOR cipher with a random nonce
+and an integrity tag. This protects secrets at rest in the sqlite store from
+casual disclosure; for production deployments the ``SecretBox`` key should
+come from a KMS via ``KO_SECRET_KEY``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+
+_PREFIX = "enc:v1:"
+
+
+_warned_default_key = False
+
+
+class SecretBox:
+    def __init__(self, key: str | None = None):
+        key = key or os.environ.get("KO_SECRET_KEY")
+        if key is None:
+            global _warned_default_key
+            if not _warned_default_key:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "KO_SECRET_KEY is not set; credentials at rest use a "
+                    "well-known development key. Set KO_SECRET_KEY in production."
+                )
+                _warned_default_key = True
+            key = "kubeoperator-tpu-dev-key"
+        self._key = hashlib.sha256(key.encode()).digest()
+
+    def _stream(self, nonce: bytes, n: int) -> bytes:
+        out = b""
+        counter = 0
+        while len(out) < n:
+            out += hmac.new(self._key, nonce + counter.to_bytes(8, "big"), hashlib.sha256).digest()
+            counter += 1
+        return out[:n]
+
+    def encrypt(self, plaintext: str) -> str:
+        if plaintext is None:
+            return plaintext
+        data = plaintext.encode()
+        nonce = secrets.token_bytes(16)
+        ct = bytes(a ^ b for a, b in zip(data, self._stream(nonce, len(data))))
+        tag = hmac.new(self._key, nonce + ct, hashlib.sha256).digest()[:16]
+        return _PREFIX + base64.urlsafe_b64encode(nonce + tag + ct).decode()
+
+    def decrypt(self, token: str) -> str:
+        if token is None or not token.startswith(_PREFIX):
+            return token  # legacy / already-plaintext value
+        raw = base64.urlsafe_b64decode(token[len(_PREFIX):])
+        nonce, tag, ct = raw[:16], raw[16:32], raw[32:]
+        want = hmac.new(self._key, nonce + ct, hashlib.sha256).digest()[:16]
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("secret integrity check failed")
+        return bytes(a ^ b for a, b in zip(ct, self._stream(nonce, len(ct)))).decode()
+
+
+_default_box: SecretBox | None = None
+_default_key_env: str | None = None
+
+
+def default_box() -> SecretBox:
+    """Process-wide box, built lazily so KO_SECRET_KEY set during startup
+    (e.g. loaded from a KMS) is honored; rebuilt if the env value changes."""
+    global _default_box, _default_key_env
+    env = os.environ.get("KO_SECRET_KEY")
+    if _default_box is None or env != _default_key_env:
+        _default_box = SecretBox()
+        _default_key_env = env
+    return _default_box

@@ -1,0 +1,15 @@
+"""TPU workload layer — the performance-critical code this framework authors.
+
+The reference (KubeOperator) ships GPU workloads only as third-party charts
+in its app store (`README.md:17-18`); the GPU-specific code it *authors* is
+the driver/runtime/device-plugin role triple. Here the equivalent authored
+surface is JAX/XLA training programs that the bundled charts execute on TPU
+slices: a ResNet50 image-classification trainer (BASELINE configs 1/2/5) and
+a long-context transformer LM with ring attention, both built pjit-first
+over `jax.sharding.Mesh` so the same program runs on one chip or a multi-host
+pod slice (ICI within slice, DCN across slices).
+"""
+
+from kubeoperator_tpu.workloads.sharding import (
+    MeshSpec, build_mesh, batch_sharding, replicated, logical_axis_rules,
+)

@@ -1,0 +1,57 @@
+"""Workload checkpoint/resume via orbax (SURVEY §5 "Checkpoint/resume":
+control-plane parity is the etcd backup; *workload*-level checkpointing
+belongs here, in the trainers the charts run).
+
+Works with sharded arrays: orbax saves each shard from its device and
+restores into the sharding given by the abstract target, so the same
+checkpoint moves between mesh shapes (e.g. save on v5e-16, restore on
+v5p-64) — the TPU equivalent of the reference's backup portability.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+class WorkloadCheckpointer:
+    """Thin CheckpointManager wrapper with retention (reference
+    ``save_num`` semantics, ``cluster_backup_utils.py:26-28``)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """``abstract_state``: a pytree of ShapeDtypeStruct (with shardings)
+        or a concrete state to mirror — e.g. ``jax.eval_shape`` of init plus
+        ``jax.tree.map(lambda s, sh: s.update(sharding=sh), ...)``."""
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self.manager.restore(step,
+                                    args=ocp.args.StandardRestore(abstract_state))
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()

@@ -1,0 +1,137 @@
+"""Input pipeline: host data → sharded device arrays, with prefetch.
+
+The reference has no training data plane at all (its charts are opaque);
+a real TPU trainer lives or dies by keeping the MXU fed. Design:
+
+* sources are plain iterators of host numpy batches — synthetic
+  (deterministic, for benches/smoke) or memmapped ``.npy`` pairs (no
+  framework dependency, air-gap friendly);
+* ``prefetch_to_device`` double-buffers ``jax.device_put`` onto the batch
+  sharding so host→HBM copies overlap the previous step's compute — the
+  role ``tf.data``'s device prefetch plays in TF TPU pipelines;
+* on multi-host meshes each process feeds only its local shard:
+  ``jax.make_array_from_process_local_data`` assembles the global array
+  (the jobs entrypoint passes per-process batches).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+
+
+def synthetic_image_batches(batch: int, image_size: int, num_classes: int,
+                            seed: int = 0, dtype: Any = np.float32,
+                            steps: int | None = None,
+                            start: int = 0) -> Iterator[tuple]:
+    """Deterministic fake ImageNet-shaped stream. Step N's batch is keyed
+    by ``(seed, N)``, so a checkpoint-resumed run passing ``start=N``
+    continues the stream instead of replaying it from the beginning."""
+    i = start
+    while steps is None or i < start + steps:
+        rng = np.random.default_rng((seed, i))
+        images = rng.standard_normal((batch, image_size, image_size, 3),
+                                     dtype=np.float32).astype(dtype)
+        labels = rng.integers(0, num_classes, (batch,), dtype=np.int32)
+        yield images, labels
+        i += 1
+
+
+def synthetic_token_batches(batch: int, seq_len: int, vocab: int,
+                            seed: int = 0, steps: int | None = None) -> Iterator[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    i = 0
+    while steps is None or i < steps:
+        yield rng.integers(0, vocab, (batch, seq_len), dtype=np.int32)
+        i += 1
+
+
+class NpyDataset:
+    """Memmapped ``.npy`` pair (``images.npy`` + ``labels.npy``) with
+    shuffled epochs — the minimal durable dataset format that needs
+    nothing but numpy on the workload image."""
+
+    def __init__(self, directory: str, images: str = "images.npy",
+                 labels: str = "labels.npy"):
+        self.images = np.load(os.path.join(directory, images), mmap_mode="r")
+        self.labels = np.load(os.path.join(directory, labels), mmap_mode="r")
+        if len(self.images) != len(self.labels):
+            raise ValueError(f"images ({len(self.images)}) and labels "
+                             f"({len(self.labels)}) disagree")
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def batches(self, batch: int, seed: int = 0, epochs: int | None = None,
+                shard_id: int = 0, num_shards: int = 1,
+                skip_batches: int = 0) -> Iterator[tuple]:
+        """Shuffled epochs; incomplete trailing batches are dropped so
+        shapes stay static for XLA. On multi-process runs every process
+        passes the SAME seed with its own ``shard_id``: all share one
+        per-epoch permutation and take disjoint strided slices of it, so
+        the global batch has no duplicated examples. ``skip_batches``
+        fast-forwards the stream (checkpoint resume at step N passes N so
+        the run continues where it left off instead of replaying epoch 0
+        — the shuffle is position-derived, so the skip is O(1))."""
+        n = len(self)
+        # every shard uses the same truncated length: uneven shards would
+        # desync multi-process epochs (one process exhausting first hangs
+        # the SPMD collectives; infinite epochs would drift and duplicate)
+        shard_len = n // num_shards
+        if batch > shard_len:
+            raise ValueError(
+                f"batch {batch} exceeds shard size {shard_len} "
+                f"({n} samples / {num_shards} shards) — the loader would "
+                "never yield")
+        per_epoch = shard_len // batch
+        epoch = skip_batches // per_epoch
+        offset = skip_batches % per_epoch
+        while epochs is None or epoch < epochs:
+            order = np.random.default_rng(seed + epoch).permutation(n)
+            shard = order[shard_id::num_shards][:shard_len]
+            for b_i in range(offset, per_epoch):
+                idx = np.sort(shard[b_i * batch:(b_i + 1) * batch])
+                yield (np.asarray(self.images[idx]),
+                       np.asarray(self.labels[idx]))
+            offset = 0
+            epoch += 1
+
+
+def device_put_batch(batch: Any, sharding) -> Any:
+    """Host batch (array or tuple/pytree of arrays) → sharded device
+    arrays. On multi-process runs the local batch is this process's shard
+    of the global array."""
+    def put(x):
+        x = np.asarray(x)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, batch)
+
+
+def prefetch_to_device(batches: Iterable, sharding, depth: int = 2) -> Iterator:
+    """Double-buffered transfer: keep ``depth`` batches in flight on the
+    device so the host→HBM copy of batch N+1 overlaps the compute of
+    batch N (device_put is async; the queue provides the overlap window).
+    """
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    queue: collections.deque = collections.deque()
+    it = iter(batches)
+    try:
+        while len(queue) < depth:
+            queue.append(device_put_batch(next(it), sharding))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(device_put_batch(next(it), sharding))
+        except StopIteration:
+            pass
+        yield out

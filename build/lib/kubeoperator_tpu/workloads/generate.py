@@ -1,0 +1,76 @@
+"""Autoregressive generation with a KV cache — the inference side of the
+LM workload (the reference ships no inference path at all; a complete
+training framework needs one for eval/demo serving).
+
+TPU-first: the cache is a static [B, max_seq_len, H, D] buffer per layer
+(stacked on the scan's layer axis), the decode loop is a ``lax.scan`` over
+token positions (one compiled step, no per-token dispatch), and sampling
+is temperature/greedy over f32 logits. Prefill processes the prompt one
+token at a time inside the same scan — simple and shape-static; a
+chunked-prefill variant is a future optimization, not a correctness
+change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeoperator_tpu.workloads.transformer import Transformer, TransformerConfig
+
+
+def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: jax.Array | None = None, mesh: Any = None) -> jnp.ndarray:
+    """Greedy (temperature=0) or temperature sampling.
+
+    prompt: [B, P] int32 (P >= 1). Returns [B, P + max_new_tokens] int32.
+    Total length must fit cfg.max_seq_len.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(f"prompt ({p}) + new tokens ({max_new_tokens}) "
+                         f"exceed max_seq_len ({cfg.max_seq_len})")
+    decode_cfg = replace(cfg, decode=True, remat=False)
+    model = Transformer(decode_cfg, mesh=mesh)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    # zero caches from shapes only — a real init would materialize (and
+    # immediately discard) a full second parameter set
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((b, 1), jnp.int32),
+                           jnp.zeros((1,), jnp.int32))["cache"])
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract)
+
+    buf = jnp.zeros((b, total), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def step(carry, pos):
+        buf, cache, rng = carry
+        token = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token,
+            jnp.full((1,), pos, jnp.int32), mutable=["cache"])
+        cache = mutated["cache"]
+        logits = logits[:, 0, :]                       # [B, V] f32
+        rng, sub = jax.random.split(rng)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # within the prompt, the "next" token is the given one, not ours
+        keep_prompt = pos + 1 < p
+        given = jax.lax.dynamic_slice(
+            buf, (0, jnp.minimum(pos + 1, total - 1)), (b, 1))[:, 0]
+        chosen = jnp.where(keep_prompt, given, nxt.astype(jnp.int32))
+        buf = jax.lax.dynamic_update_slice(
+            buf, chosen[:, None], (0, jnp.minimum(pos + 1, total - 1)))
+        return (buf, cache, rng), None
+
+    (buf, _, _), _ = jax.lax.scan(step, (buf, cache, rng),
+                                  jnp.arange(total - 1))
+    return buf

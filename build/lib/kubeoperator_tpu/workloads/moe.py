@@ -1,0 +1,101 @@
+"""Mixture-of-Experts FFN with expert parallelism — the ``ep`` mesh axis.
+
+TPU-first design (GShard/Mesh-TensorFlow dense-dispatch formulation): the
+router's top-k choices become dense one-hot dispatch/combine tensors with a
+fixed per-expert capacity, so every shape is static and every op is an
+einsum the MXU eats directly — no ragged gathers, no host-side bucketing.
+Expert weights carry the ``expert`` logical axis (→ ``ep`` mesh axis,
+sharding.logical_axis_rules); the [tokens → experts] regroup einsum then
+forces GSPMD to insert the all-to-all over ICI, exactly where a
+hand-written NCCL MoE would put it (reference has no MoE — this extends
+the workload layer the charts exec, jobs.py llm).
+
+Aux load-balancing loss (Shazeer et al.): sown as ``intermediates/moe_aux``
+for the trainer to add (lm.py picks it up when moe is enabled).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+with_parts = nn.with_logical_partitioning
+
+
+class MoEMlp(nn.Module):
+    """Drop-in replacement for the dense SwiGLU Mlp: top-k routed experts,
+    each a SwiGLU of the same d_ff."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    aux_weight: float = 1e-2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg, E, K = self, self.n_experts, self.top_k
+        B, T, D = x.shape
+        capacity = max(1, int(cfg.capacity_factor * K * T / E))
+
+        # router in f32: tiny matmul, and gate precision decides convergence
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router",
+                          kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                                 ("embed", "expert")))(
+            x.astype(jnp.float32))                       # [B,T,E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)     # [B,T,K]
+        gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+        # dense dispatch/combine with capacity. GShard-style cumulative
+        # priority: a token's slot in its expert's queue counts every
+        # assignment from earlier top-k slots too, so two tokens reaching
+        # the same expert via different slots can never share a capacity
+        # slot (they would otherwise be summed and both receive the
+        # expert's output for the mixed vector).
+        combine = jnp.zeros((B, T, E, capacity), jnp.float32)
+        counts = jnp.zeros((B, E), jnp.float32)        # queue depth per expert
+        for slot in range(K):
+            onehot_e = jax.nn.one_hot(gate_idx[..., slot], E)          # [B,T,E]
+            pos_in_slot = jnp.cumsum(onehot_e, axis=1) - onehot_e
+            pos = (pos_in_slot + counts[:, None, :]).astype(jnp.int32)
+            within = (pos < capacity).astype(jnp.float32)
+            slot_combine = (gate_vals[..., slot, None, None]
+                            * (onehot_e * within)[..., None]
+                            * jax.nn.one_hot(pos, capacity))           # [B,T,E,C]
+            combine = combine + slot_combine
+            counts = counts + onehot_e.sum(axis=1)
+        dispatch = (combine > 0).astype(cfg.dtype)
+
+        # regroup tokens by expert — THE all-to-all: expert dim is ep-sharded
+        # via the weights below, batch dim is dp/fsdp-sharded
+        expert_in = jnp.einsum("btec,btd->ebcd", dispatch,
+                               x.astype(cfg.dtype))                    # [E,B,C,D]
+
+        init = with_parts(nn.initializers.lecun_normal(),
+                          ("expert", "embed", "mlp"))
+        init_out = with_parts(nn.initializers.lecun_normal(),
+                              ("expert", "mlp", "embed"))
+        w_gate = self.param("w_gate", init, (E, D, cfg.d_ff)).astype(cfg.dtype)
+        w_up = self.param("w_up", init, (E, D, cfg.d_ff)).astype(cfg.dtype)
+        w_down = self.param("w_down", init_out, (E, cfg.d_ff, D)).astype(cfg.dtype)
+
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)) \
+            * jnp.einsum("ebcd,edf->ebcf", expert_in, w_up)
+        out_e = jnp.einsum("ebcf,efd->ebcd", h, w_down)                # [E,B,C,D]
+
+        # combine back to token order (the return all-to-all)
+        y = jnp.einsum("btec,ebcd->btd", combine.astype(cfg.dtype), out_e)
+
+        # load-balancing aux loss: E · Σ_e (token_fraction_e · prob_mass_e)
+        token_frac = jax.nn.one_hot(gate_idx[..., 0], E).mean(axis=(0, 1))
+        prob_mass = probs.mean(axis=(0, 1))
+        aux = cfg.aux_weight * E * jnp.sum(token_frac * prob_mass)
+        self.sow("intermediates", "moe_aux", aux)
+        return y.astype(cfg.dtype)

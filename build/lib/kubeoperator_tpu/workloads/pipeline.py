@@ -1,0 +1,74 @@
+"""Pipeline parallelism, the TPU-idiomatic way: scan over stacked stages.
+
+On GPU clusters pipeline parallelism assigns layer ranges to different
+devices and streams microbatches between them (GPipe/1F1B) because
+cross-device bandwidth is scarce. On a TPU mesh the same memory goal —
+don't hold every layer's activations at once — is met *inside* the
+fsdp/tp mesh, with no ``pp`` axis at all (sharding.py's documented
+stance):
+
+* stage parameters are stacked on a leading axis and the forward is a
+  single ``lax.scan`` over it → one compiled stage body regardless of
+  depth (compile time O(1) in depth);
+* ``jax.checkpoint`` (remat) on the stage body gives the
+  activation-memory profile pipelining buys, trading recompute on the
+  backward pass instead of bubble time on the forward;
+* the stacked parameters still shard over ``fsdp``/``tp`` like any other
+  weight, so ZeRO-3 gathers and megatron splits compose with it.
+
+There is no pipeline bubble and no microbatch schedule to tune — XLA sees
+one dense loop. The transformer (transformer.py) uses exactly this shape
+via ``nn.scan``; this module exposes the raw primitive for non-flax
+pytrees plus a reference two-phase (embed → stages → head) runner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_stages(stage_params: list[Any]) -> Any:
+    """Stack per-stage pytrees (same treedef) on a new leading axis —
+    the layout ``scan_stages`` consumes, and the layout the trainers shard
+    over fsdp (the leading stage axis is never the sharded one, so stacking
+    does not change any per-stage sharding decision)."""
+    if not stage_params:
+        raise ValueError("need at least one stage")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def unstack_stages(stacked: Any) -> list[Any]:
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    return [jax.tree.map(lambda x, i=i: x[i], stacked) for i in range(n)]
+
+
+def scan_stages(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                stacked_params: Any, x: jnp.ndarray,
+                remat: bool = True) -> jnp.ndarray:
+    """Run ``x`` through N stages: ``lax.scan`` over the stacked params.
+
+    ``stage_fn(params_i, activations) -> activations`` is traced ONCE;
+    with ``remat`` the stage body is rematerialized on the backward pass,
+    so peak activation memory is one stage's worth plus the carried
+    activations — the pipeline-parallel memory profile without the
+    bubble.
+    """
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(carry, params):
+        return fn(params, carry), None
+
+    out, _ = jax.lax.scan(body, x, stacked_params)
+    return out
+
+
+def pipeline_forward(embed_fn: Callable, stage_fn: Callable, head_fn: Callable,
+                     params: dict, x: jnp.ndarray, remat: bool = True) -> jnp.ndarray:
+    """embed → scanned stages → head, the standard three-phase LM/ResNet
+    shape. ``params`` = {"embed": ..., "stages": stacked, "head": ...}."""
+    h = embed_fn(params["embed"], x)
+    h = scan_stages(stage_fn, params["stages"], h, remat=remat)
+    return head_fn(params["head"], h)

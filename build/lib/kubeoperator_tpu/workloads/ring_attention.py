@@ -1,0 +1,186 @@
+"""Ring attention — sequence/context parallelism for long-context training.
+
+The sequence dim is sharded over the mesh's ``sp`` axis. Each device keeps
+its Q shard resident and rotates K/V shards one hop around the ring with
+``lax.ppermute`` (nearest-neighbour ICI traffic, fully overlappable with the
+block compute), accumulating results with an online-softmax (flash-style
+running max/sum), so attention over a sequence of length S costs each chip
+O(S·S/sp) FLOPs and O(S/sp) memory — the TPU-native equivalent of the
+reference's absent long-context story (SURVEY §5 "Long-context": charts, not
+control plane).
+
+Pure `lax` implementation: works on CPU meshes for CI and compiles to
+collective-permute + MXU matmuls on TPU. Written for use inside
+``shard_map`` with batch/seq/head dims already partitioned.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
+    """One Q-shard × one K/V-shard block. Returns unnormalised (o, l, m).
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; positions are global indices.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        mask = q_pos[None, None, :, None] >= kv_pos[None, None, None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    # all-masked rows: keep m finite so exp() below is well-defined
+    m = jnp.where(jnp.isfinite(m), m, jnp.float32(-1e30))
+    p = jnp.exp(s - m[..., None])                             # [B,H,Tq,Tk]
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, l, m
+
+
+def _merge(o1, l1, m1, o2, l2, m2):
+    """Combine two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[..., None]
+    return o, l, m
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str | None, causal: bool = True) -> jnp.ndarray:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Args (per-device shards, inside shard_map):
+      q, k, v: [B, T_local, H, D]
+      axis_name: mesh axis the sequence is split over (None → plain attn).
+    Returns [B, T_local, H, D] in q.dtype.
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    t_local = q.shape[1]
+    if axis_name is None:
+        pos = jnp.arange(t_local)
+        o, l, m = _block_attn(q, k, v, pos, pos, scale, causal)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    def body(i, carry):
+        o, l, m, kv = carry
+        k_blk, v_blk = kv
+        # after i hops of "send to next", we hold the shard of rank my_idx - i
+        kv_idx = (my_idx - i) % axis_size
+        kv_pos = kv_idx * t_local + jnp.arange(t_local)
+        bo, bl, bm = _block_attn(q, k_blk, v_blk, q_pos, kv_pos, scale, causal)
+        o, l, m = _merge(o, l, m, bo, bl, bm)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        kv = lax.ppermute(kv, axis_name, perm)
+        return o, l, m, kv
+
+    b, _, h, d = q.shape
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    m0 = jnp.full((b, h, t_local), -1e30, jnp.float32)
+    o, l, m, _ = lax.fori_loop(0, axis_size, body, (o0, l0, m0, (k, v)))
+    l = jnp.where(l == 0.0, 1.0, l)          # fully-masked rows (shouldn't occur causally)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def sharded_ring_attention(mesh: Mesh, q, k, v, causal: bool = True):
+    """shard_map wrapper: batch over data axes, sequence over ``sp``."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    sp = "sp" if "sp" in mesh.axis_names else None
+    spec = P(data_axes, sp, "tp" if "tp" in mesh.axis_names else None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=sp, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def blockwise_attention(q, k, v, causal: bool = True,
+                        chunk: int = 1024) -> jnp.ndarray:
+    """Unsharded attention with K/V processed in chunks (online softmax):
+    O(T·chunk) score memory instead of the reference's O(T²). Used for the
+    local computation inside Ulysses, where each device holds the FULL
+    gathered sequence for its head group."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, t, h, d = q.shape
+    chunk = min(chunk, t)
+    pos = jnp.arange(t)
+    o = jnp.zeros((b, t, h, d), jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    m = jnp.full((b, h, t), -1e30, jnp.float32)
+    for start in range(0, t, chunk):          # static python loop: t is traced-static
+        kv_pos = pos[start:start + chunk]
+        bo, bl, bm = _block_attn(q, k[:, start:start + chunk],
+                                 v[:, start:start + chunk], pos, kv_pos,
+                                 scale, causal)
+        o, l, m = _merge(o, l, m, bo, bl, bm)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: instead of rotating
+    K/V around a ring, two ``all_to_all``s re-partition [seq-sharded, all
+    heads] → [full seq, head-sharded], run ordinary local attention per
+    head group, and re-partition back.
+
+    Trade-off vs the ring: 2 all-to-alls of the full activations instead
+    of sp ppermute hops of K/V — fewer, larger collectives (better when sp
+    is small and heads ≥ sp), but heads must divide by sp. Per-device
+    shards inside shard_map: q/k/v [B, T/sp, H, D] → out [B, T/sp, H, D].
+    """
+    sp = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if h % sp:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by sp ({sp})")
+
+    def seq_to_heads(x):
+        # [B, T/sp, H, D] → [B, T, H/sp, D]: tiled all-to-all splits the
+        # head dim into sp chunks and concatenates the received sequence
+        # chunks in device order (= global order; the sequence is sharded
+        # contiguously)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # [B, T, H/sp, D] → [B, T/sp, H, D]: the inverse regroup
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = blockwise_attention(seq_to_heads(q), seq_to_heads(k),
+                              seq_to_heads(v), causal=causal)
+    return heads_to_seq(out)
+
+
+def sharded_ulysses_attention(mesh: Mesh, q, k, v, causal: bool = True):
+    """shard_map wrapper mirroring sharded_ring_attention."""
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    sp = "sp" if "sp" in mesh.axis_names else None
+    spec = P(data_axes, sp, "tp" if "tp" in mesh.axis_names else None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=sp, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
+    """Unsharded O(S²)-memory attention, for tests and single-chip paths."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(q.dtype)

@@ -1,0 +1,184 @@
+"""Device-mesh construction and sharding policy.
+
+The scaling recipe: pick a mesh whose axes map model-parallel traffic onto
+ICI and data-parallel traffic onto DCN, annotate arrays with
+`NamedSharding`s, and let XLA/GSPMD insert the collectives. This module is
+the single place that policy lives; trainers only name logical axes.
+
+Axes (any may be size 1 and is then omitted from the mesh):
+
+* ``dp``   — pure data parallel; gradients all-reduce (DCN-friendly).
+* ``fsdp`` — data parallel with parameter/optimizer sharding (ZeRO-3 style);
+             params all-gather + grads reduce-scatter ride ICI.
+* ``tp``   — tensor parallel (megatron-style) for transformer blocks; the
+             highest-traffic axis, innermost so it maps to the torus.
+* ``sp``   — sequence/context parallel for long-context attention (ring
+             attention over ``ppermute``); shares traffic profile with tp.
+* ``ep``   — expert parallel for MoE layers: experts shard over ``ep`` and
+             token dispatch/combine is an all-to-all GSPMD derives from the
+             expert-weight shardings, so it belongs on ICI like tp/sp.
+
+There is no ``pp`` mesh axis: pipeline parallelism on TPU is expressed as a
+``jax.lax.scan`` over stacked layer params inside the fsdp/tp mesh (see
+``workloads/pipeline.py``), not as a separate device dimension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Parallelism degrees. Product must equal the device count."""
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(n for n, s in self.sizes() if s > 1) or ("dp",)
+
+    def sizes(self) -> tuple[tuple[str, int], ...]:
+        return (("dp", self.dp), ("fsdp", self.fsdp), ("ep", self.ep),
+                ("tp", self.tp), ("sp", self.sp))
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.fsdp * self.ep * self.tp * self.sp
+
+    @property
+    def data_axes(self) -> tuple[str, ...]:
+        """Mesh axes the global batch is split over."""
+        return tuple(n for n in ("dp", "fsdp") if dict(self.sizes())[n] > 1) or ("dp",)
+
+    @staticmethod
+    def for_devices(n: int, *, model_parallel: int = 1,
+                    sequence_parallel: int = 1, expert_parallel: int = 1,
+                    zero3: bool = True) -> "MeshSpec":
+        """Fill the data axes with whatever devices remain after model axes."""
+        model = model_parallel * sequence_parallel * expert_parallel
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by tp={model_parallel} × "
+                             f"sp={sequence_parallel} × ep={expert_parallel}")
+        data = n // model
+        return MeshSpec(dp=1 if zero3 else data, fsdp=data if zero3 else 1,
+                        ep=expert_parallel, tp=model_parallel,
+                        sp=sequence_parallel)
+
+
+def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
+    """Build a Mesh with axes ordered outer→inner as (dp, fsdp, ep, tp, sp).
+
+    ``create_device_mesh`` lays contiguous inner axes onto the ICI torus, so
+    tp/sp (highest traffic) get nearest-neighbour links while dp (lowest
+    traffic, gradient all-reduce once per step) spans DCN on multi-slice
+    topologies. Size-1 axes are kept out of the mesh entirely — GSPMD then
+    never materialises collectives for them.
+
+    Multi-slice pods (devices spanning >1 ``slice_index``): the hybrid mesh
+    puts ONLY the outermost data axis on DCN — model-parallel collectives
+    must never cross the inter-slice network — and requires dp (or fsdp
+    when dp==1) to be a multiple of the slice count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec.n_devices != len(devices):
+        raise ValueError(f"MeshSpec wants {spec.n_devices} devices, got {len(devices)}")
+    names = [n for n, s in spec.sizes() if s > 1]
+    shape = [s for _, s in spec.sizes() if s > 1]
+    if not names:                       # single device
+        names, shape = ["dp"], [1]
+    slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    n_slices = len(slices)
+    if n_slices > 1:
+        # config errors raise OUTSIDE the try: the reshape fallback below
+        # must never paper over a layout that puts model axes on DCN
+        if names[0] not in ("dp", "fsdp"):
+            raise ValueError(
+                f"multi-slice mesh: outermost axis is {names[0]!r} but only a "
+                "data axis (dp/fsdp) may span slices — model-parallel "
+                "collectives must stay on ICI")
+        if shape[0] % n_slices:
+            raise ValueError(
+                f"multi-slice mesh: outermost axis {names[0]}={shape[0]} "
+                f"must be a multiple of the slice count {n_slices}")
+    try:
+        from jax.experimental import mesh_utils
+        if n_slices > 1:
+            dcn_shape = [n_slices] + [1] * (len(shape) - 1)
+            ici_shape = [shape[0] // n_slices] + shape[1:]
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        else:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:                   # virtual/CPU devices with no topology info
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def batch_sharding(mesh: Mesh, spec: MeshSpec) -> NamedSharding:
+    """Global-batch arrays: leading dim split over every data axis present."""
+    axes = tuple(a for a in spec.data_axes if a in mesh.axis_names)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def logical_axis_rules(spec: MeshSpec) -> tuple[tuple[str, str | None], ...]:
+    """flax ``logical_to_mesh`` rules used by the transformer trainer.
+
+    Logical names → mesh axes; a rule maps to None (replicate) when its mesh
+    axis is size 1 so the same model code runs at any scale.
+    """
+    has = {n for n, s in spec.sizes() if s > 1}
+    pick = lambda a: a if a in has else None
+    return (
+        ("batch", tuple(a for a in ("dp", "fsdp") if a in has) or None),
+        ("embed", pick("fsdp")),       # ZeRO-3: shard params along fsdp
+        ("mlp", pick("tp")),           # megatron column/row split
+        ("heads", pick("tp")),
+        ("kv", None),
+        ("seq", pick("sp")),           # ring-attention sequence shards
+        ("vocab", pick("tp")),
+        ("expert", pick("ep")),        # MoE experts shard over ep
+    )
+
+
+def place_by_shape(x: Any, mesh: Mesh, spec: MeshSpec, min_size: int = 2 ** 14) -> NamedSharding:
+    """ZeRO-3 placement rule for one array: shard the largest fsdp-divisible
+    dim of big arrays along fsdp, replicate everything else. Shape-only, so
+    applying it to the whole train state gives momentum buffers the same
+    sharding as their parameters for free."""
+    if "fsdp" not in mesh.axis_names:
+        return replicated(mesh)
+    shape = tuple(getattr(x, "shape", ()) or ())
+    if not shape or int(np.prod(shape)) < min_size:
+        return replicated(mesh)
+    # largest dim divisible by fsdp, ties → last (contraction dims last
+    # keeps all-gathers fusable with the matmul)
+    best = None
+    for i, d in enumerate(shape):
+        if d % spec.fsdp == 0 and (best is None or d >= shape[best]):
+            best = i
+    if best is None:
+        return replicated(mesh)
+    pspec: list[str | None] = [None] * len(shape)
+    pspec[best] = "fsdp"
+    return NamedSharding(mesh, P(*pspec))
+
+
+def shard_params_fsdp(params: Any, mesh: Mesh, spec: MeshSpec, min_size: int = 2 ** 14) -> Any:
+    """ZeRO-3 parameter placement over a whole pytree (see ``place_by_shape``).
+    Works for any model (ResNet convs, transformer dense) without per-layer
+    annotations; XLA inserts all-gathers next to use and reduce-scatters next
+    to the gradient — exactly the ZeRO-3 schedule."""
+    return jax.tree.map(lambda x: place_by_shape(x, mesh, spec, min_size), params)

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Build the ko-workloads image and package it (with the controller wheel)
+# into an offline package directory the controller serves over /repo/.
+#
+# Usage: scripts/build_workloads_package.sh [PACKAGE_DIR]
+#   PACKAGE_DIR defaults to ./data/packages/ko-workloads
+#
+# Produces:
+#   PACKAGE_DIR/meta.yml                      (images + checksums)
+#   PACKAGE_DIR/images/ko-workloads.tar      (docker save)
+#   PACKAGE_DIR/wheels/kubeoperator_tpu-*.whl
+#
+# The install flow's load-images step (engine/steps/load_images.py) then
+# pulls the tarball onto every node, verifies the sha256, imports it into
+# containerd and tags it {registry}/ko-workloads:latest — no registry
+# server needed (the air-gapped mirror of the reference's nexus pattern,
+# package_manage.py:31-53).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PKG_DIR="${1:-./data/packages/ko-workloads}"
+IMAGE_REF="ko-workloads:latest"
+
+mkdir -p "$PKG_DIR/images" "$PKG_DIR/wheels"
+
+echo ">> building controller wheel"
+pip wheel --no-deps -w "$PKG_DIR/wheels" . >/dev/null
+
+echo ">> building $IMAGE_REF"
+docker build -f Dockerfile.workloads -t "$IMAGE_REF" .
+
+echo ">> saving image tarball"
+docker save "$IMAGE_REF" -o "$PKG_DIR/images/ko-workloads.tar"
+
+echo ">> writing meta.yml"
+sha_img=$(sha256sum "$PKG_DIR/images/ko-workloads.tar" | cut -d' ' -f1)
+wheel=$(basename "$PKG_DIR"/wheels/kubeoperator_tpu-*.whl)
+sha_whl=$(sha256sum "$PKG_DIR/wheels/$wheel" | cut -d' ' -f1)
+cat > "$PKG_DIR/meta.yml" <<EOF
+name: ko-workloads
+version: "$(python -c 'import tomllib;print(tomllib.load(open("pyproject.toml","rb"))["project"]["version"])')"
+vars: {}
+images:
+  - file: images/ko-workloads.tar
+    ref: $IMAGE_REF
+    sha256: $sha_img
+checksums:
+  wheels/$wheel: $sha_whl
+EOF
+echo ">> done: $PKG_DIR"
