@@ -385,6 +385,34 @@ async def webkubectl_token(request: web.Request) -> web.Response:
     return web.json_response({"token": token, "cluster": name,
                               "ws": f"/ws/webkubectl/{token}"})
 
+async def provider_discover(request: web.Request) -> web.Response:
+    """Day-0 browse: list the provider's datacenters/clusters/AZs/flavors
+    so Region/Zone rows can be imported instead of hand-typed (reference
+    ``clients/vsphere.py:20-61``, ``clients/openstack.py``). Credentials in
+    the body are used for this call only — never stored."""
+    require_admin(request)
+    from kubeoperator_tpu.providers import discovery
+    body = await request.json()
+    try:
+        payload = await _sync(request, discovery.discover,
+                              request.match_info["provider"], body)
+    except discovery.DiscoveryError as e:
+        return json_error(400, str(e))
+    except KeyError as e:
+        return json_error(400, f"missing parameter {e}")
+    return web.json_response(payload)
+
+
+async def provider_import(request: web.Request) -> web.Response:
+    """Persist a discovery payload as Region/Zone rows (upsert by name)."""
+    require_admin(request)
+    from kubeoperator_tpu.providers import discovery
+    platform: Platform = request.app["platform"]
+    body = await request.json()
+    result = await _sync(request, discovery.import_discovery, platform, body)
+    return web.json_response(result, status=201)
+
+
 async def list_cluster_apps(request: web.Request) -> web.Response:
     """App-store state for one cluster: installable charts, what's
     installed (with its vars), and the TPU slice picker choices (reference:
@@ -820,6 +848,8 @@ def create_app(platform: Platform) -> web.Application:
     r.add_post("/api/v1/hosts/import", import_hosts)
 
     register_crud(app, "/api/v1/credentials", Credential, create=_create_credential)
+    r.add_post("/api/v1/providers/{provider}/discover", provider_discover)
+    r.add_post("/api/v1/providers/{provider}/import", provider_import)
     register_crud(app, "/api/v1/regions", Region)
     register_crud(app, "/api/v1/zones", Zone)
     register_crud(app, "/api/v1/plans", Plan)

@@ -145,6 +145,14 @@ data:
       tls_config: {{insecure_skip_verify: true}}
     - job_name: node
       kubernetes_sd_configs: [{{role: node}}]
+    - job_name: node-exporter
+      # the DaemonSet below runs hostNetwork, so every node answers :9100
+      kubernetes_sd_configs: [{{role: node}}]
+      relabel_configs:
+      - source_labels: [__address__]
+        regex: "(.*):10250"
+        replacement: "$1:9100"
+        target_label: __address__
     - job_name: tpu
       # libtpu exposes tensorcore utilization on :8431 (tpu-device-plugin)
       kubernetes_sd_configs: [{{role: pod}}]
@@ -152,6 +160,31 @@ data:
       - source_labels: [__meta_kubernetes_pod_label_ko_accelerator]
         regex: tpu
         action: keep
+      - source_labels: [__address__]
+        # pods without a declared containerPort surface a bare IP — match
+        # with or without an existing port so every TPU pod lands on :8431
+        regex: '([^:]+)(?::\\d+)?'
+        replacement: "$1:8431"
+        target_label: __address__
+---
+apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: node-exporter, namespace: monitoring}}
+spec:
+  selector: {{matchLabels: {{app: node-exporter}}}}
+  template:
+    metadata: {{labels: {{app: node-exporter}}}}
+    spec:
+      hostNetwork: true
+      hostPID: true
+      tolerations: [{{operator: Exists}}]
+      containers:
+      - name: node-exporter
+        image: "{registry}/node-exporter:v1.7"
+        args: ["--path.rootfs=/host", "--web.listen-address=:9100"]
+        ports: [{{containerPort: 9100, hostPort: 9100}}]
+        volumeMounts: [{{name: root, mountPath: /host, readOnly: true}}]
+      volumes: [{{name: root, hostPath: {{path: /}}}}]
 ---
 apiVersion: v1
 kind: Service
@@ -182,8 +215,14 @@ spec:
       containers:
       - name: grafana
         image: "{registry}/grafana:10"
-        volumeMounts: [{{name: datasources, mountPath: /etc/grafana/provisioning/datasources}}]
-      volumes: [{{name: datasources, configMap: {{name: grafana-datasources}}}}]
+        volumeMounts:
+        - {{name: datasources, mountPath: /etc/grafana/provisioning/datasources}}
+        - {{name: dashboards-provider, mountPath: /etc/grafana/provisioning/dashboards}}
+        - {{name: dashboards, mountPath: /var/lib/grafana/dashboards}}
+      volumes:
+      - {{name: datasources, configMap: {{name: grafana-datasources}}}}
+      - {{name: dashboards-provider, configMap: {{name: grafana-dashboards-provider}}}}
+      - {{name: dashboards, configMap: {{name: grafana-dashboards}}}}
 ---
 apiVersion: v1
 kind: ConfigMap
@@ -192,8 +231,37 @@ data:
   ds.yaml: |
     apiVersion: 1
     datasources:
-    - {{name: Prometheus, type: prometheus, url: "http://prometheus:9090"}}
+    - {{name: Prometheus, type: prometheus, url: "http://prometheus:9090", isDefault: true}}
     - {{name: Loki, type: loki, url: "http://loki:3100"}}
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: grafana-dashboards-provider, namespace: monitoring}}
+data:
+  provider.yaml: |
+    apiVersion: 1
+    providers:
+    - {{name: ko, folder: KubeOperator, type: file,
+        options: {{path: /var/lib/grafana/dashboards}}}}
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: grafana-dashboards, namespace: monitoring}}
+data:
+  # panels use the same PromQL families the control-plane monitor queries
+  # (services/monitor.py snapshot) — one source of truth for metric names
+  cluster-overview.json: |
+    {{"title": "Cluster Overview", "uid": "ko-cluster", "panels": [
+      {{"title": "CPU busy", "type": "timeseries", "gridPos": {{"x":0,"y":0,"w":8,"h":8}},
+        "targets": [{{"expr": "sum(rate(node_cpu_seconds_total{{mode!=\\"idle\\"}}[5m]))"}}]}},
+      {{"title": "Memory used", "type": "timeseries", "gridPos": {{"x":8,"y":0,"w":8,"h":8}},
+        "targets": [{{"expr": "sum(node_memory_MemTotal_bytes - node_memory_MemAvailable_bytes)"}}]}},
+      {{"title": "TPU tensorcore %", "type": "timeseries", "gridPos": {{"x":16,"y":0,"w":8,"h":8}},
+        "targets": [{{"expr": "100 * avg(tpu_tensorcore_utilization)"}}]}},
+      {{"title": "Error log rate", "type": "timeseries", "gridPos": {{"x":0,"y":8,"w":12,"h":8}},
+        "datasource": "Loki",
+        "targets": [{{"expr": "sum(rate({{namespace=~\\".+\\"}} |~ \\"(?i)error\\" [5m]))"}}]}}
+    ]}}
 ---
 apiVersion: v1
 kind: Service
@@ -252,6 +320,67 @@ apiVersion: v1
 kind: Service
 metadata: {{name: loki, namespace: monitoring}}
 spec: {{selector: {{app: loki}}, ports: [{{port: 3100}}]}}
+---
+apiVersion: v1
+kind: ServiceAccount
+metadata: {{name: promtail, namespace: monitoring}}
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata: {{name: promtail}}
+rules:
+- apiGroups: [""]
+  resources: [pods, nodes]
+  verbs: [get, list, watch]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata: {{name: promtail}}
+roleRef: {{apiGroup: rbac.authorization.k8s.io, kind: ClusterRole, name: promtail}}
+subjects: [{{kind: ServiceAccount, name: promtail, namespace: monitoring}}]
+---
+apiVersion: apps/v1
+kind: DaemonSet
+metadata: {{name: promtail, namespace: monitoring}}
+spec:
+  selector: {{matchLabels: {{app: promtail}}}}
+  template:
+    metadata: {{labels: {{app: promtail}}}}
+    spec:
+      serviceAccountName: promtail
+      tolerations: [{{operator: Exists}}]
+      containers:
+      - name: promtail
+        image: "{registry}/promtail:2.9"
+        args: ["-config.file=/etc/promtail/promtail.yml"]
+        volumeMounts:
+        - {{name: config, mountPath: /etc/promtail}}
+        - {{name: pods, mountPath: /var/log/pods, readOnly: true}}
+      volumes:
+      - {{name: config, configMap: {{name: promtail}}}}
+      - {{name: pods, hostPath: {{path: /var/log/pods}}}}
+---
+apiVersion: v1
+kind: ConfigMap
+metadata: {{name: promtail, namespace: monitoring}}
+data:
+  promtail.yml: |
+    server: {{http_listen_port: 9080}}
+    clients:
+    - url: http://loki:3100/loki/api/v1/push
+    scrape_configs:
+    - job_name: pods
+      kubernetes_sd_configs: [{{role: pod}}]
+      pipeline_stages: [{{cri: {{}}}}]
+      relabel_configs:
+      - source_labels: [__meta_kubernetes_pod_name]
+        target_label: pod
+      - source_labels: [__meta_kubernetes_namespace]
+        target_label: namespace
+      - source_labels: [__meta_kubernetes_pod_uid, __meta_kubernetes_pod_container_name]
+        separator: /
+        replacement: /var/log/pods/*$1/*.log
+        target_label: __path__
 ---
 apiVersion: networking.k8s.io/v1
 kind: Ingress

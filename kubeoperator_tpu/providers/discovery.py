@@ -1,0 +1,267 @@
+"""Day-0 IaaS discovery — browse the provider APIs so Region/Zone rows can
+be imported instead of hand-typed.
+
+Reference parity: ``cloud_provider/clients/vsphere.py:20-61`` lists
+datacenters/clusters/networks/datastores as regions/zones over pyVmomi
+SOAP; ``clients/openstack.py`` lists flavors/AZs. Rebuilt here over the
+providers' plain REST APIs (vSphere Automation API, Keystone/Nova/Neutron)
+with the same injectable-transport seam the monitor uses
+(``services/monitor.py``) so tests replay canned responses with zero
+infrastructure. The reference's template image upload (NFC lease,
+``clients/vsphere.py:84-131``) is intentionally NOT mirrored: in this
+stack images are delivered by the offline-package flow
+(``engine/steps/load_images.py``) and cloud templates are referenced by
+name in Region vars.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import urllib.request
+from typing import Any, Callable
+
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+# transport(method, url, headers, body, timeout)
+#   -> (status, body_text, response_headers)
+# response headers matter: Keystone v3 returns the token ONLY in
+# X-Subject-Token, never in the body
+Transport = Callable[[str, str, dict, bytes | None, float],
+                     tuple[int, str, dict]]
+
+
+class DiscoveryError(RuntimeError):
+    pass
+
+
+def make_transport(verify: bool = True) -> Transport:
+    """urllib transport; ``verify=False`` (explicit opt-in, e.g. lab
+    vCenters on self-signed certs) disables TLS verification — never the
+    default, these requests carry IaaS admin credentials."""
+
+    def transport(method: str, url: str, headers: dict,
+                  body: bytes | None, timeout: float) -> tuple[int, str, dict]:
+        req = urllib.request.Request(url, method=method, headers=headers,
+                                     data=body)
+        ctx = ssl.create_default_context()
+        if not verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        try:
+            with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+                return (resp.status, resp.read().decode("utf-8", "replace"),
+                        dict(resp.headers))
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8", "replace"), dict(e.headers)
+
+    return transport
+
+
+class VSphereDiscovery:
+    """vSphere Automation REST API browse: datacenters → regions,
+    compute clusters → zones (with network/datastore choices)."""
+
+    def __init__(self, host: str, username: str, password: str,
+                 transport: Transport | None = None, timeout: float = 20.0):
+        self.base = f"https://{host}"
+        self.username, self.password = username, password
+        self.transport = transport or make_transport()
+        self.timeout = timeout
+        self._session: str | None = None
+
+    def _login(self) -> str:
+        if self._session is None:
+            basic = base64.b64encode(
+                f"{self.username}:{self.password}".encode()).decode()
+            status, body, _ = self.transport(
+                "POST", f"{self.base}/rest/com/vmware/cis/session",
+                {"Authorization": f"Basic {basic}"}, None, self.timeout)
+            if status != 200:
+                raise DiscoveryError(f"vCenter login failed ({status})")
+            self._session = json.loads(body)["value"]
+        return self._session
+
+    def _get(self, path: str) -> Any:
+        status, body, _ = self.transport(
+            "GET", f"{self.base}{path}",
+            {"vmware-api-session-id": self._login()}, None, self.timeout)
+        if status != 200:
+            raise DiscoveryError(f"GET {path} failed ({status})")
+        return json.loads(body)["value"]
+
+    def datacenters(self) -> list[dict]:
+        return self._get("/rest/vcenter/datacenter")
+
+    def clusters(self, datacenter: str) -> list[dict]:
+        return self._get(f"/rest/vcenter/cluster?filter.datacenters={datacenter}")
+
+    def networks(self, datacenter: str) -> list[dict]:
+        return self._get(f"/rest/vcenter/network?filter.datacenters={datacenter}")
+
+    def datastores(self, datacenter: str) -> list[dict]:
+        return self._get(f"/rest/vcenter/datastore?filter.datacenters={datacenter}")
+
+    def discover(self) -> dict:
+        """Region/Zone shaped browse result (reference ``get_regions`` /
+        ``get_zones`` / ``get_networks`` / ``get_datastores``)."""
+        regions = []
+        for dc in self.datacenters():
+            nets = [n["name"] for n in self.networks(dc["datacenter"])]
+            stores = [d["name"] for d in self.datastores(dc["datacenter"])]
+            zones = [{
+                "name": c["name"],
+                "vars": {"cluster": c["name"],
+                         "network": nets[0] if nets else "VM Network",
+                         "datastore": stores[0] if stores else "datastore1"},
+                "choices": {"networks": nets, "datastores": stores},
+            } for c in self.clusters(dc["datacenter"])]
+            regions.append({"name": dc["name"], "provider": "vsphere",
+                            "vars": {"datacenter": dc["name"]},
+                            "zones": zones})
+        return {"provider": "vsphere", "regions": regions}
+
+
+class OpenStackDiscovery:
+    """Keystone v3 + Nova/Neutron browse: project region → region,
+    availability zones → zones, flavors → compute-model choices."""
+
+    def __init__(self, auth_url: str, username: str, password: str,
+                 project: str, domain: str = "Default",
+                 transport: Transport | None = None, timeout: float = 20.0):
+        self.auth_url = auth_url.rstrip("/")
+        self.username, self.password = username, password
+        self.project, self.domain = project, domain
+        self.transport = transport or make_transport()
+        self.timeout = timeout
+        self._token: str | None = None
+        self._catalog: list[dict] = []
+
+    def _login(self) -> str:
+        if self._token is None:
+            payload = {"auth": {
+                "identity": {"methods": ["password"], "password": {"user": {
+                    "name": self.username, "password": self.password,
+                    "domain": {"name": self.domain}}}},
+                "scope": {"project": {"name": self.project,
+                                      "domain": {"name": self.domain}}}}}
+            status, body, resp_headers = self.transport(
+                "POST", f"{self.auth_url}/auth/tokens",
+                {"Content-Type": "application/json"},
+                json.dumps(payload).encode(), self.timeout)
+            if status not in (200, 201):
+                raise DiscoveryError(f"keystone auth failed ({status})")
+            # Keystone v3 returns the token ONLY in X-Subject-Token
+            token = next((v for k, v in resp_headers.items()
+                          if k.lower() == "x-subject-token"), "")
+            if not token:
+                raise DiscoveryError("keystone response has no X-Subject-Token")
+            self._token = token
+            self._catalog = json.loads(body).get("token", {}).get("catalog", [])
+        return self._token
+
+    def _endpoint(self, service: str) -> str:
+        self._login()
+        for entry in self._catalog:
+            if entry.get("type") == service:
+                for ep in entry.get("endpoints", []):
+                    if ep.get("interface") == "public":
+                        return ep["url"].rstrip("/")
+        raise DiscoveryError(f"no {service} endpoint in the keystone catalog")
+
+    def _get(self, service: str, path: str) -> Any:
+        status, body, _ = self.transport(
+            "GET", f"{self._endpoint(service)}{path}",
+            {"X-Auth-Token": self._login()}, None, self.timeout)
+        if status != 200:
+            raise DiscoveryError(f"GET {service}{path} failed ({status})")
+        return json.loads(body)
+
+    def flavors(self) -> list[dict]:
+        return self._get("compute", "/flavors/detail").get("flavors", [])
+
+    def availability_zones(self) -> list[str]:
+        data = self._get("compute", "/os-availability-zone")
+        return [z["zoneName"] for z in data.get("availabilityZoneInfo", [])
+                if z.get("zoneState", {}).get("available", True)]
+
+    def networks(self) -> list[dict]:
+        return self._get("network", "/v2.0/networks").get("networks", [])
+
+    def discover(self) -> dict:
+        nets = [n["name"] for n in self.networks()]
+        flavors = [{"name": f["name"], "cpu": f.get("vcpus"),
+                    "memory_gb": round(f.get("ram", 0) / 1024, 1),
+                    "disk_gb": f.get("disk")} for f in self.flavors()]
+        zones = [{
+            "name": az,
+            "vars": {"availability_zone": az,
+                     "network": nets[0] if nets else "private"},
+            "choices": {"networks": nets},
+        } for az in self.availability_zones()]
+        return {"provider": "openstack",
+                "regions": [{"name": self.project, "provider": "openstack",
+                             "vars": {"auth_url": self.auth_url,
+                                      "project": self.project},
+                             "zones": zones}],
+                "flavors": flavors}
+
+
+def discover(provider: str, params: dict,
+             transport: Transport | None = None) -> dict:
+    """Entry point the API route calls. ``params`` carries the endpoint and
+    credentials (they are used for this browse only — never stored).
+    ``params["verify"]: false`` opts out of TLS verification for lab
+    endpoints on self-signed certs."""
+    if transport is None:
+        transport = make_transport(verify=bool(params.get("verify", True)))
+    if provider == "vsphere":
+        client = VSphereDiscovery(params["host"], params["username"],
+                                  params["password"], transport=transport)
+    elif provider == "openstack":
+        client = OpenStackDiscovery(params["auth_url"], params["username"],
+                                    params["password"],
+                                    params.get("project", "admin"),
+                                    params.get("domain", "Default"),
+                                    transport=transport)
+    else:
+        raise DiscoveryError(f"provider {provider!r} has no discovery client")
+    return client.discover()
+
+
+def import_discovery(platform, payload: dict) -> dict:
+    """Create/refresh Region and Zone rows from a discovery payload
+    (reference: regions/zones pages save what the browse returned). Upserts
+    by name; existing rows keep their id (plans keep referencing them) and
+    IP pools are never touched."""
+    from kubeoperator_tpu.resources.entities import Region, Zone
+
+    created, updated = [], []
+    for reg in payload.get("regions", []):
+        region = platform.store.get_by_name(Region, reg["name"], scoped=False)
+        if region is None:
+            region = Region(name=reg["name"], provider=reg.get("provider", ""))
+            created.append(reg["name"])
+        else:
+            updated.append(reg["name"])
+        region.provider = reg.get("provider", region.provider)
+        region.vars = {**region.vars, **reg.get("vars", {})}
+        platform.store.save(region)
+        for z in reg.get("zones", []):
+            # scope the upsert by region: two datacenters may both contain
+            # a "Cluster01", and a same-named zone of ANOTHER region must
+            # not be stolen (it would drag its IP pool and plans along)
+            matches = platform.store.find(Zone, scoped=False, name=z["name"],
+                                          region_id=region.id)
+            zone = matches[0] if matches else None
+            if zone is None:
+                zone = Zone(name=z["name"], region_id=region.id)
+                created.append(z["name"])
+            else:
+                updated.append(z["name"])
+            zone.vars = {**zone.vars, **z.get("vars", {})}
+            platform.store.save(zone)
+    return {"created": created, "updated": updated}

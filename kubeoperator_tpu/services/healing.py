@@ -9,9 +9,15 @@ re-converges the provider — terraform recreates the VM and the scale
 steps rejoin it. Guard rails:
 
 * opt-in via the ``auto_heal`` setting ("true"/"false", default off);
-* only auto-created plain workers are replaced; masters and TPU slice
-  members only raise an ERROR notification (a slice must be replaced as a
-  unit, a master by an operator);
+* only auto-created plain workers are replaced; masters only raise an
+  ERROR notification (a master is replaced by an operator);
+* TPU slices heal **as a unit** behind the separate ``auto_heal_slices``
+  setting: a slice member consistently down drains the whole slice's
+  nodes, removes every member host from desired state, and re-converges —
+  the provider models the slice as one atomic terraform resource
+  (``gce_tpu.py`` ``google_tpu_v2_vm``), so the converge recreates the
+  whole slice and the scale steps rejoin it at preserved pool size. With
+  the setting off (default) slice members stay notify-only;
 * one heal operation per cluster per tick, and never while another
   execution is running.
 """
@@ -81,6 +87,76 @@ def _alerted(platform) -> set:
     return platform._heal_alerted
 
 
+def _drop_health_history(platform, cluster: Cluster, host_name: str) -> None:
+    """The replacement reuses the name: stale unhealthy records must not
+    re-trigger a heal against the new host."""
+    for rec in platform.store.find(HealthRecord, scoped=False,
+                                   project=cluster.name, kind="host",
+                                   target=host_name):
+        platform.store.delete(HealthRecord, rec.id)
+
+
+def _heal_slice(platform, cluster: Cluster, host: Host) -> list[str] | None:
+    """Replace a whole TPU slice whose member is consistently down.
+
+    Returns the replaced host names, ``[]`` when the heal could not be
+    scheduled this tick (retry next tick), or ``None`` when the slice is
+    not eligible (hand-registered members / a master inside the slice) —
+    the caller falls back to notify-only.
+    """
+    slice_id = host.tpu_slice_id
+    members: list[tuple[Node, Host]] = []
+    for n in platform.store.find(Node, scoped=False, project=cluster.name):
+        h = platform.store.get(Host, n.host_id, scoped=False)
+        if h is None or h.tpu_slice_id != slice_id:
+            continue
+        if not h.auto_created or "master" in n.roles:
+            return None
+        members.append((n, h))
+    if not members:
+        return None
+    # schedule the converge FIRST (same refusal-safety order as the plain
+    # worker path) — a preflight refusal must not leave the slice deleted
+    # with nothing scheduled to recreate it
+    try:
+        ex = platform.create_execution(cluster.name, "scale",
+                                       _current_sizing(platform, cluster))
+    except Exception as e:  # noqa: BLE001 — per-cluster boundary
+        log.warning("[%s] slice auto-heal for %s could not schedule: %s",
+                    cluster.name, slice_id, e)
+        return []
+    # best-effort drain of every member from the first master: the gang's
+    # pods must stop cleanly before the slice VMs vanish (dead members
+    # won't answer, but eviction runs on the master, not the member)
+    from kubeoperator_tpu.engine.steps import k8s
+
+    try:
+        conn = platform._master_conn(cluster.name)
+        for n, _ in members:
+            platform.executor.run(conn, f"{k8s.KUBECTL} cordon {n.name}")
+            platform.executor.run(
+                conn, f"{k8s.KUBECTL} drain {n.name} --ignore-daemonsets "
+                      f"--delete-emptydir-data --force --timeout=120s",
+                timeout=180)
+            platform.executor.run(conn, f"{k8s.KUBECTL} delete node {n.name}")
+    except Exception as e:  # noqa: BLE001 — drain is best-effort
+        log.warning("[%s] slice %s drain incomplete: %s",
+                    cluster.name, slice_id, e)
+    for n, h in members:
+        remove_auto_host(platform.store, n, h)
+        _drop_health_history(platform, cluster, h.name)
+    platform.start_execution(ex)
+    names = [h.name for _, h in members]
+    platform.notify(
+        title=f"cluster {cluster.name}: auto-heal replacing TPU slice "
+              f"{slice_id} ({len(names)} hosts)",
+        level="WARNING", project=cluster.name,
+        content={"slice": slice_id, "hosts": names, "execution": ex.id})
+    log.warning("[%s] auto-heal: replacing slice %s (%s)",
+                cluster.name, slice_id, ", ".join(names))
+    return names
+
+
 def heal_tick(platform) -> list[str]:
     """Returns the hosts replaced this tick (for tests/observability)."""
     if platform.setting("auto_heal", "false").lower() != "true":
@@ -100,6 +176,16 @@ def heal_tick(platform) -> list[str]:
                 _alerted(platform).discard((cluster.name, host.name))
                 continue
             if "master" in node.roles or host.has_tpu:
+                if ("master" not in node.roles and host.tpu_slice_id
+                        and platform.setting("auto_heal_slices",
+                                             "false").lower() == "true"):
+                    replaced = _heal_slice(platform, cluster, host)
+                    if replaced:
+                        healed += replaced
+                        break        # one heal per cluster per tick
+                    if replaced is not None:
+                        continue     # schedule refused — retry next tick
+                    # None: ineligible slice → notify-only below
                 if (cluster.name, host.name) not in _alerted(platform):
                     _alerted(platform).add((cluster.name, host.name))
                     platform.notify(
@@ -107,8 +193,9 @@ def heal_tick(platform) -> list[str]:
                               f"and needs operator action",
                         level="ERROR", project=cluster.name,
                         content={"host": host.name,
-                                 "reason": "masters and TPU slice members are "
-                                           "not auto-replaced",
+                                 "reason": "masters (and TPU slices unless "
+                                           "auto_heal_slices=true) are not "
+                                           "auto-replaced",
                                  "slice": host.tpu_slice_id})
                 continue
             # create the scale execution FIRST (it can refuse — preflight,
@@ -129,12 +216,7 @@ def heal_tick(platform) -> list[str]:
             log.warning("[%s] auto-heal: replacing dead worker %s",
                         cluster.name, host.name)
             remove_auto_host(platform.store, node, host)
-            # the replacement reuses the name: drop the dead host's health
-            # history so stale records can't re-trigger a heal
-            for rec in platform.store.find(HealthRecord, scoped=False,
-                                           project=cluster.name, kind="host",
-                                           target=host.name):
-                platform.store.delete(HealthRecord, rec.id)
+            _drop_health_history(platform, cluster, host.name)
             platform.start_execution(ex)
             platform.notify(
                 title=f"cluster {cluster.name}: auto-heal replacing {host.name}",

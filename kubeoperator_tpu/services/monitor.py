@@ -31,6 +31,30 @@ log = get_logger(__name__)
 # transport(method, url, headers, timeout) -> (status_code, body_text)
 Transport = Callable[[str, str, dict, float], tuple[int, str]]
 
+# Every Prometheus metric family the control plane queries, mapped to the
+# in-cluster exporter that serves it. tests/test_monitoring_stack.py
+# cross-checks this table two ways: (a) every metric name appearing in this
+# module's PromQL is listed here, and (b) every exporter named here is
+# actually deployed by the shipped manifests (apps/manifests.py) — closing
+# the round-2 gap where the monitor queried node-exporter metrics no
+# manifest deployed (the dashboard would silently flatline in production).
+QUERIED_METRICS = {
+    "node_cpu_seconds_total": "node-exporter",
+    "node_memory_MemTotal_bytes": "node-exporter",
+    "node_memory_MemAvailable_bytes": "node-exporter",
+    "tpu_tensorcore_utilization": "tpu-workload",   # libtpu :8431, tpu job
+}
+
+# The dashboard-snapshot PromQL, in one table so the exporter cross-check
+# sees exactly what production queries (snapshot() reads from here).
+PROMQL = {
+    "cpu_usage": 'sum(rate(node_cpu_seconds_total{mode!="idle"}[5m]))',
+    "cpu_total": "count(node_cpu_seconds_total{mode='idle'})",
+    "mem_used": "sum(node_memory_MemTotal_bytes - node_memory_MemAvailable_bytes)",
+    "mem_total": "sum(node_memory_MemTotal_bytes)",
+    "tpu_util": "avg(tpu_tensorcore_utilization)",
+}
+
 
 def urllib_transport(method: str, url: str, headers: dict, timeout: float) -> tuple[int, str]:
     req = urllib.request.Request(url, method=method, headers=headers)
@@ -217,13 +241,11 @@ class ClusterMonitor:
                                    "namespace": meta.get("namespace"),
                                    "phase": phase})
         prom = self.prom()
-        cpu_usage = prom.scalar(
-            'sum(rate(node_cpu_seconds_total{mode!="idle"}[5m]))')
-        cpu_total = prom.scalar("count(node_cpu_seconds_total{mode='idle'})")
-        mem_used = prom.scalar(
-            "sum(node_memory_MemTotal_bytes - node_memory_MemAvailable_bytes)")
-        mem_total = prom.scalar("sum(node_memory_MemTotal_bytes)")
-        tpu_util = prom.scalar("avg(tpu_tensorcore_utilization)", default=-1.0)
+        cpu_usage = prom.scalar(PROMQL["cpu_usage"])
+        cpu_total = prom.scalar(PROMQL["cpu_total"])
+        mem_used = prom.scalar(PROMQL["mem_used"])
+        mem_total = prom.scalar(PROMQL["mem_total"])
+        tpu_util = prom.scalar(PROMQL["tpu_util"], default=-1.0)
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -242,6 +264,8 @@ class ClusterMonitor:
         self._save_snapshot(data)
         return data
 
+    HISTORY_POINTS = 288          # 24 h at the 5-minute beat
+
     def _save_snapshot(self, data: dict) -> None:
         store = self.platform.store
         # filter by name, not just project: the "<name>:events" snapshot
@@ -252,6 +276,23 @@ class ClusterMonitor:
         snap.data = data
         snap.created_at = iso_now()
         store.save(snap)
+        # rolling time series for the dashboard charts (reference: echarts
+        # panels read the Redis history; here a capped :history snapshot)
+        found = store.find(MonitorSnapshot, scoped=False,
+                           name=f"{self.cluster.name}:history")
+        hist = found[0] if found else MonitorSnapshot(
+            project=self.cluster.name, name=f"{self.cluster.name}:history")
+        points = list(hist.data.get("points", []))
+        points.append({"time": data["time"],
+                       "cpu_usage": data["cpu_usage"],
+                       "cpu_total": data["cpu_total"],
+                       "mem_used_bytes": data["mem_used_bytes"],
+                       "mem_total_bytes": data["mem_total_bytes"],
+                       "tpu_utilization": data["tpu_utilization"],
+                       "pod_count": data["pod_count"]})
+        hist.data = {"points": points[-self.HISTORY_POINTS:]}
+        hist.created_at = iso_now()
+        store.save(hist)
 
     # -- events (reference put_event_data_to_es, :506-534) -----------------
     def harvest_events(self) -> list[dict]:
@@ -501,11 +542,15 @@ def dashboard_data(platform, item: str = "") -> dict[str, Any]:
         allowed = {r.name for r in platform.store.find(
             ItemResource, scoped=False, item_id=it.id, resource_type="cluster")} if it else set()
         clusters = [c for c in clusters if c.name in allowed]
-    snaps, error_logs, bad_slices = [], [], []
+    snaps, error_logs, bad_slices, history = [], [], [], {}
     for c in clusters:
         found = platform.store.find(MonitorSnapshot, scoped=False, name=c.name)
         snaps.append(found[0].data if found else {"cluster": c.name,
                                                   "status": c.status})
+        hist = platform.store.find(MonitorSnapshot, scoped=False,
+                                   name=f"{c.name}:history")
+        if hist:
+            history[c.name] = hist[0].data.get("points", [])
         logsnap = platform.store.find(MonitorSnapshot, scoped=False,
                                       name=f"{c.name}:errorlogs")
         if logsnap:
@@ -535,6 +580,7 @@ def dashboard_data(platform, item: str = "") -> dict[str, Any]:
         "error_logs": error_logs[:20],
         "degraded_slices": bad_slices,
         "clusters": snaps,
+        "history": history,
     }
 
 

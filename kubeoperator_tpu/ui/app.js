@@ -83,6 +83,49 @@ async function doLogin() {
 
 /* ---------------- dashboard ---------------- */
 
+/* Small-multiple utilization line charts (one measure per chart, shared
+   0-100% scale — never a dual axis). Single series each, so the panel title
+   names it and no legend is needed; hover shows time + value. */
+function lineChart(title, points, fmt) {
+  const W = 250, H = 64, P = 6;
+  const vals = points.map(p => p.v), times = points.map(p => p.t);
+  if (!vals.some(v => v != null)) return "";
+  const x = i => P + i * (W - 2 * P) / Math.max(1, vals.length - 1);
+  const y = v => H - P - Math.max(0, Math.min(100, v)) / 100 * (H - 2 * P);
+  const path = vals.map((v, i) => v == null ? null : `${x(i)},${y(v)}`)
+                   .filter(Boolean).join(" ");
+  const last = [...vals].reverse().find(v => v != null);
+  return `<div class="spark">
+    <span class="dim small">${esc(title)}</span>
+    <svg viewBox="0 0 ${W} ${H}" width="${W}" height="${H}"
+         data-times="${esc(JSON.stringify(times))}"
+         data-vals="${esc(JSON.stringify(vals))}" data-fmt="${esc(fmt)}">
+      ${[0, 50, 100].map(g => `<line x1="${P}" x2="${W - P}" y1="${y(g)}"
+          y2="${y(g)}" stroke="var(--line)" stroke-width="1"/>`).join("")}
+      <polyline points="${path}" fill="none" stroke="var(--accent)"
+          stroke-width="2" stroke-linejoin="round"/>
+    </svg>
+    <b>${last == null ? "–" : last.toFixed(0) + "%"}</b></div>`;
+}
+
+function utilizationCharts(history) {
+  const pct = (n, d) => (n == null || !d || d <= 0) ? null : 100 * n / d;
+  const rows = Object.entries(history || {}).map(([name, points]) => {
+    const t = points.map(p => (p.time || "").slice(11, 16));
+    const series = (f) => points.map((p, i) => ({t: t[i], v: f(p)}));
+    const charts = [
+      lineChart("CPU busy", series(p => pct(p.cpu_usage, p.cpu_total)), "CPU"),
+      lineChart("Memory used", series(p => pct(p.mem_used_bytes, p.mem_total_bytes)), "Memory"),
+      lineChart("TPU tensorcore", series(p => p.tpu_utilization >= 0 ?
+        100 * p.tpu_utilization : null), "TPU"),
+    ].filter(Boolean).join("");
+    return charts ? `<div><span class="small">${esc(name)}</span>
+      <div class="row sparkrow">${charts}</div></div>` : "";
+  }).filter(Boolean).join("");
+  return rows ? `<div class="card"><h3>Utilization (24 h)</h3>${rows}
+    <div id="charttip" class="charttip" style="display:none"></div></div>` : "";
+}
+
 async function renderDashboard() {
   const d = await api("/dashboard/all");
   $("#view").innerHTML = `<div class="card"><div class="grid">
@@ -91,6 +134,7 @@ async function renderDashboard() {
        ["deployments", d.deployment_count]]
       .map(([k, v]) => `<div class="stat"><b>${v}</b><span>${k}</span></div>`).join("")}
     </div></div>
+    ${utilizationCharts(d.history)}
     ${(d.degraded_slices || []).length ? `<div class="card">
       <h3 style="color:var(--err)">Degraded TPU slices</h3>
       <table><tr><th>cluster</th><th>slice</th><th>members</th><th>down</th></tr>
@@ -372,12 +416,26 @@ async function clusterKubectl(name) {
     term.scrollTop = term.scrollHeight;
   };
   ws.onclose = () => { term.textContent += "\n[session closed]\n"; };
+  // shell-style line editing: Enter sends, ArrowUp/Down walk history,
+  // Ctrl-L clears — the ergonomic slice of the reference's xterm sidecar
+  const hist = []; let hi = 0;
   $("#kcmd").addEventListener("keydown", e => {
-    if (e.key === "Enter" && ws.readyState === 1) {
-      term.textContent += "$ kubectl " + $("#kcmd").value + "\n";
-      ws.send($("#kcmd").value); $("#kcmd").value = "";
+    const inp = $("#kcmd");
+    if (e.key === "Enter" && ws.readyState === 1 && inp.value.trim()) {
+      term.textContent += "$ kubectl " + inp.value + "\n";
+      ws.send(inp.value);
+      hist.push(inp.value); hi = hist.length;
+      inp.value = "";
+    } else if (e.key === "ArrowUp" && hi > 0) {
+      hi -= 1; inp.value = hist[hi]; e.preventDefault();
+    } else if (e.key === "ArrowDown") {
+      hi = Math.min(hist.length, hi + 1);
+      inp.value = hist[hi] ?? ""; e.preventDefault();
+    } else if (e.key === "l" && e.ctrlKey) {
+      term.textContent = ""; e.preventDefault();
     }
   });
+  $("#kcmd").focus();
 }
 
 async function retryEx(id) {
@@ -457,7 +515,45 @@ async function renderPlanning() {
           <option>v4-8</option><option>v5e-8</option><option>v5e-16</option><option>v5p-64</option></select>
         <input id="pslices" placeholder="slice count" value="1">
         <button onclick="addPlan()">Create plan</button></div></div>
-      <div id="perr" style="color:var(--err)"></div></div>`;
+      <div id="perr" style="color:var(--err)"></div></div>
+    <div class="card"><h3>Discover (Day-0 browse)</h3>
+      <p class="dim small">Browse the IaaS and import its datacenters /
+        clusters / availability zones as regions and zones instead of
+        typing them. Credentials are used for this call only.</p>
+      <div class="row"><div>
+        <select id="dprov"><option>vsphere</option><option>openstack</option></select>
+        <input id="dhost" placeholder="vCenter host / keystone auth URL">
+        <input id="duser" placeholder="username">
+        <input id="dpass" type="password" placeholder="password">
+        <input id="dproj" placeholder="project (openstack)">
+        <button onclick="discoverIaas()">Discover</button></div>
+      <div id="dresult" class="small"></div></div></div>`;
+}
+async function discoverIaas() {
+  const prov = $("#dprov").value;
+  const params = prov === "vsphere"
+    ? {host: $("#dhost").value, username: $("#duser").value, password: $("#dpass").value}
+    : {auth_url: $("#dhost").value, username: $("#duser").value,
+       password: $("#dpass").value, project: $("#dproj").value || "admin"};
+  try {
+    const found = await api(`/providers/${prov}/discover`,
+                            {method: "POST", body: JSON.stringify(params)});
+    state.discovered = found;
+    $("#dresult").innerHTML = `<table><tr><th>region</th><th>zones</th></tr>
+      ${(found.regions || []).map(r => `<tr><td>${esc(r.name)}</td>
+        <td class="dim">${esc((r.zones || []).map(z => z.name).join(", "))}</td></tr>`).join("")}
+      </table>
+      <button data-act="importDiscovered">Import ${(found.regions || []).length}
+        region(s)</button>`;
+  } catch (e) { alert(e.message); }
+}
+async function importDiscovered() {
+  try {
+    const r = await api(`/providers/${state.discovered.provider}/import`,
+                        {method: "POST", body: JSON.stringify(state.discovered)});
+    alert(`imported: ${r.created.length} created, ${r.updated.length} updated`);
+    renderPlanning();
+  } catch (e) { alert(e.message); }
 }
 async function addRegion() {
   try {
@@ -791,7 +887,25 @@ document.addEventListener("click", e => {
     addStrategy: () => addStrategy(d.n), deployBackend: () => deployBackend(d.n),
     watch: () => watch(d.n), markRead: () => markRead(d.n),
     appAdd: () => appAdd(d.n, d.app), appDel: () => appDel(d.n, d.app),
+    importDiscovered: () => importDiscovered(),
     retryEx: () => retryEx(d.n)}[d.act] || (() => {}))();
+});
+
+// chart hover layer: nearest-point tooltip over the utilization sparklines
+document.addEventListener("mousemove", e => {
+  const tip = document.getElementById("charttip");
+  if (!tip) return;
+  const svg = e.target.closest ? e.target.closest("svg[data-vals]") : null;
+  if (!svg) { tip.style.display = "none"; return; }
+  const vals = JSON.parse(svg.dataset.vals), times = JSON.parse(svg.dataset.times);
+  const rect = svg.getBoundingClientRect();
+  const i = Math.max(0, Math.min(vals.length - 1,
+    Math.round((e.clientX - rect.left) / rect.width * (vals.length - 1))));
+  if (vals[i] == null) { tip.style.display = "none"; return; }
+  tip.textContent = `${svg.dataset.fmt} · ${times[i] || ""} · ${vals[i].toFixed(1)}%`;
+  tip.style.display = "block";
+  tip.style.left = (e.pageX + 14) + "px";
+  tip.style.top = (e.pageY - 12) + "px";
 });
 
 window.addEventListener("hashchange", render);

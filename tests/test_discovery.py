@@ -1,0 +1,168 @@
+"""Day-0 IaaS discovery (VERDICT r2 missing #4): browse vSphere/OpenStack
+over canned REST responses and import the result as Region/Zone rows."""
+
+import json
+
+import pytest
+
+from kubeoperator_tpu.providers import discovery
+from kubeoperator_tpu.resources.entities import Region, Zone
+
+
+class VCenterTransport:
+    """Replays the vSphere Automation REST shapes the client consumes."""
+
+    def __init__(self, datacenters=None):
+        self.calls = []
+        self.dcs = datacenters or [
+            {"datacenter": "datacenter-2", "name": "DC-East"}]
+
+    def __call__(self, method, url, headers, body, timeout):
+        self.calls.append((method, url))
+        if url.endswith("/rest/com/vmware/cis/session"):
+            assert headers.get("Authorization", "").startswith("Basic ")
+            return 200, json.dumps({"value": "sess-123"}), {}
+        assert headers.get("vmware-api-session-id") == "sess-123"
+        if "/rest/vcenter/datacenter" in url:
+            return 200, json.dumps({"value": self.dcs}), {}
+        if "/rest/vcenter/cluster" in url:
+            assert "filter.datacenters=datacenter-" in url
+            return 200, json.dumps({"value": [
+                {"cluster": "domain-c7", "name": "compute-a"},
+                {"cluster": "domain-c9", "name": "compute-b"}]}), {}
+        if "/rest/vcenter/network" in url:
+            return 200, json.dumps({"value": [
+                {"network": "net-1", "name": "VM Network"},
+                {"network": "net-2", "name": "DVS-Prod"}]}), {}
+        if "/rest/vcenter/datastore" in url:
+            return 200, json.dumps({"value": [
+                {"datastore": "ds-1", "name": "vsanDatastore"}]}), {}
+        return 404, "{}", {}
+
+
+class KeystoneTransport:
+    """Keystone v3 auth + nova/neutron browse shapes. The token rides the
+    X-Subject-Token response header, exactly like real keystone."""
+
+    def __call__(self, method, url, headers, body, timeout):
+        if url.endswith("/auth/tokens"):
+            payload = json.loads(body)
+            assert payload["auth"]["scope"]["project"]["name"] == "ml-platform"
+            return 201, json.dumps({"token": {"catalog": [
+                {"type": "compute", "endpoints": [
+                    {"interface": "public", "url": "http://nova:8774/v2.1"}]},
+                {"type": "network", "endpoints": [
+                    {"interface": "public", "url": "http://neutron:9696"}]},
+            ]}}), {"X-Subject-Token": "tok-9"}
+        assert headers.get("X-Auth-Token") == "tok-9"
+        if url.endswith("/flavors/detail"):
+            return 200, json.dumps({"flavors": [
+                {"name": "m1.large", "vcpus": 4, "ram": 8192, "disk": 80},
+                {"name": "m1.xlarge", "vcpus": 8, "ram": 16384, "disk": 160}]}), {}
+        if url.endswith("/os-availability-zone"):
+            return 200, json.dumps({"availabilityZoneInfo": [
+                {"zoneName": "az1", "zoneState": {"available": True}},
+                {"zoneName": "az2", "zoneState": {"available": False}}]}), {}
+        if url.endswith("/v2.0/networks"):
+            return 200, json.dumps({"networks": [{"name": "provider-net"}]}), {}
+        return 404, "{}", {}
+
+
+def test_vsphere_discover_maps_dc_to_region_clusters_to_zones():
+    found = discovery.discover(
+        "vsphere", {"host": "vc.lab", "username": "u", "password": "p"},
+        transport=VCenterTransport())
+    assert found["provider"] == "vsphere"
+    (region,) = found["regions"]
+    assert region["name"] == "DC-East"
+    assert region["vars"]["datacenter"] == "DC-East"
+    assert [z["name"] for z in region["zones"]] == ["compute-a", "compute-b"]
+    z = region["zones"][0]
+    assert z["vars"] == {"cluster": "compute-a", "network": "VM Network",
+                         "datastore": "vsanDatastore"}
+    assert z["choices"]["networks"] == ["VM Network", "DVS-Prod"]
+
+
+def test_openstack_discover_lists_azs_and_flavors():
+    found = discovery.discover(
+        "openstack", {"auth_url": "http://keystone:5000/v3", "username": "u",
+                      "password": "p", "project": "ml-platform"},
+        transport=KeystoneTransport())
+    (region,) = found["regions"]
+    assert region["name"] == "ml-platform"
+    assert [z["name"] for z in region["zones"]] == ["az1"]   # az2 unavailable
+    assert region["zones"][0]["vars"]["network"] == "provider-net"
+    assert {f["name"] for f in found["flavors"]} == {"m1.large", "m1.xlarge"}
+    assert found["flavors"][0]["memory_gb"] == 8.0
+
+
+def test_unknown_provider_rejected():
+    with pytest.raises(discovery.DiscoveryError, match="no discovery client"):
+        discovery.discover("aws", {})
+
+
+def test_import_creates_and_upserts_rows(platform):
+    found = discovery.discover(
+        "vsphere", {"host": "vc.lab", "username": "u", "password": "p"},
+        transport=VCenterTransport())
+    result = discovery.import_discovery(platform, found)
+    assert set(result["created"]) == {"DC-East", "compute-a", "compute-b"}
+    region = platform.store.get_by_name(Region, "DC-East", scoped=False)
+    assert region.provider == "vsphere"
+    zone = platform.store.get_by_name(Zone, "compute-a", scoped=False)
+    assert zone.region_id == region.id
+    assert zone.vars["datastore"] == "vsanDatastore"
+    # re-import: upsert by name, ids stable, IP pools untouched
+    zone.ip_pool = ["10.9.0.5"]
+    platform.store.save(zone)
+    result2 = discovery.import_discovery(platform, found)
+    assert set(result2["updated"]) == {"DC-East", "compute-a", "compute-b"}
+    zone2 = platform.store.get_by_name(Zone, "compute-a", scoped=False)
+    assert zone2.id == zone.id and zone2.ip_pool == ["10.9.0.5"]
+
+
+def test_same_named_zones_in_two_regions_do_not_collide(platform):
+    """Two datacenters each containing a 'compute-a' cluster: each region
+    keeps its own zone row (no cross-region steal of IP pools/plans)."""
+    t = VCenterTransport(datacenters=[
+        {"datacenter": "datacenter-2", "name": "DC-East"},
+        {"datacenter": "datacenter-3", "name": "DC-West"}])
+    found = discovery.discover(
+        "vsphere", {"host": "vc.lab", "username": "u", "password": "p"},
+        transport=t)
+    discovery.import_discovery(platform, found)
+    zones = platform.store.find(Zone, scoped=False, name="compute-a")
+    assert len(zones) == 2
+    assert len({z.region_id for z in zones}) == 2
+
+
+def test_discovery_routes(platform):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeoperator_tpu.api.app import create_app, ensure_admin
+    from test_api import login
+
+    ensure_admin(platform)
+
+    async def scenario():
+        app = create_app(platform)
+        async with TestClient(TestServer(app)) as client:
+            hdrs = await login(client)
+            # a bad endpoint fails as a 400 DiscoveryError, not a 500
+            r = await client.post("/api/v1/providers/aws/discover",
+                                  json={}, headers=hdrs)
+            assert r.status == 400
+            # import path creates rows
+            payload = {"provider": "vsphere", "regions": [
+                {"name": "DC-X", "provider": "vsphere", "vars": {},
+                 "zones": [{"name": "cl-1", "vars": {"cluster": "cl-1"}}]}]}
+            r = await client.post("/api/v1/providers/vsphere/import",
+                                  json=payload, headers=hdrs)
+            assert r.status == 201
+            assert (await r.json())["created"] == ["DC-X", "cl-1"]
+            r = await client.get("/api/v1/zones", headers=hdrs)
+            assert any(z["name"] == "cl-1" for z in await r.json())
+
+    asyncio.run(scenario())
