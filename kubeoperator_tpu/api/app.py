@@ -24,8 +24,8 @@ from aiohttp import WSMsgType, web
 from kubeoperator_tpu.api import auth
 from kubeoperator_tpu.resources.entities import (
     BackupStorage, BackupStrategy, Cluster, ClusterBackup, Credential,
-    DeployExecution, HealthRecord, Host, Item, ItemResource, Message, Node,
-    Package, Plan, Region, StorageBackend, User, Zone,
+    CustomChart, DeployExecution, HealthRecord, Host, Item, ItemResource,
+    Message, Node, Package, Plan, Region, StorageBackend, User, Zone,
 )
 from kubeoperator_tpu.resources.entities import Setting
 from kubeoperator_tpu.services.platform import (
@@ -426,8 +426,9 @@ async def list_cluster_apps(request: web.Request) -> web.Response:
     if cluster is None:
         return json_error(404, "cluster not found")
     slices = await _sync(request, platform.cluster_slices, name)
+    customs = await _sync(request, platform.store.find, CustomChart, scoped=False)
     return web.json_response({
-        "available": manifests.list_apps(),
+        "available": manifests.list_apps() + sorted(c.name for c in customs),
         "installed": cluster.configs.get("installed_apps") or {},
         "slices": slices,
     })
@@ -811,6 +812,11 @@ def _create_item(platform: Platform, body: dict) -> Item:
     return platform.create_item(body["name"], body.get("description", ""))
 
 
+def _create_chart(platform: Platform, body: dict) -> CustomChart:
+    return platform.create_chart(body["name"], body.get("template", ""),
+                                 body.get("description", ""))
+
+
 def create_app(platform: Platform) -> web.Application:
     app = web.Application(middlewares=[error_middleware, auth_middleware])
     app["platform"] = platform
@@ -854,6 +860,7 @@ def create_app(platform: Platform) -> web.Application:
     register_crud(app, "/api/v1/zones", Zone)
     register_crud(app, "/api/v1/plans", Plan)
     register_crud(app, "/api/v1/packages", Package)
+    register_crud(app, "/api/v1/charts", CustomChart, create=_create_chart)
     r.add_post("/api/v1/packages/scan", scan_packages_route)
     r.add_get("/repo/{package}/{path:.+}", repo_file)
     register_crud(app, "/api/v1/items", Item, create=_create_item)

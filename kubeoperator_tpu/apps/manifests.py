@@ -532,6 +532,27 @@ def list_apps() -> list[str]:
     return sorted(_SYSTEM) + sorted(_WORKLOADS)
 
 
+def render_custom(template: str, registry: str,
+                  vars: dict[str, Any] | None = None) -> str:
+    """Render a user-authored chart (CustomChart row) with the same
+    parameter set the built-ins get, plus any scalar vars supplied at
+    install time. Substitution is regex-based — only bare
+    ``{identifier}`` placeholders are touched, so YAML flow mappings
+    (``{name: x}``) and anything unknown pass through untouched
+    (str.format would raise on them)."""
+    import re
+
+    params: dict[str, Any] = {"registry": registry,
+                              "slice_hosts": (vars or {}).get("slice_hosts", 1),
+                              "slice_id": (vars or {}).get("slice_id", "")}
+    for k, v in (vars or {}).items():
+        if isinstance(v, (str, int, float)):
+            params[k] = v
+    return re.sub(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}",
+                  lambda m: str(params.get(m.group(1), m.group(0))),
+                  template)
+
+
 def render_app(name: str, registry: str, vars: dict[str, Any] | None = None) -> str | None:
     vars = vars or {}
     params = {

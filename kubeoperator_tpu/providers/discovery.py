@@ -57,6 +57,10 @@ def make_transport(verify: bool = True) -> Transport:
                         dict(resp.headers))
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode("utf-8", "replace"), dict(e.headers)
+        except OSError as e:  # URLError, DNS, refused, timeout — a user-input
+            # problem (bad endpoint), surfaced as the 400-mapped error type
+            raise DiscoveryError(f"cannot reach {url.split('/', 3)[2]}: "
+                                 f"{getattr(e, 'reason', e)}") from e
 
     return transport
 

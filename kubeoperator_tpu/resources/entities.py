@@ -280,6 +280,20 @@ class Plan:
 # ---------------------------------------------------------------------------
 
 @dataclass
+class CustomChart:
+    """User-authored app-store chart (reference: users add charts to the
+    kubeapps chartmuseum, ``roles/kubeapps/tasks/main.yml:1-20``; here a
+    chart is a manifest template row rendered by the same runtime app
+    path as the built-ins — ``{registry}``/``{slice_hosts}``/``{slice_id}``
+    placeholders supported)."""
+    KIND = "chart"
+    name: str = ""
+    description: str = ""
+    template: str = ""            # the manifest body (format placeholders)
+    id: str = field(default_factory=new_id)
+
+
+@dataclass
 class Package:
     """Offline package registry entry (reference ``package.py:lookup`` scans
     ``/data/packages/*/meta.yml``)."""

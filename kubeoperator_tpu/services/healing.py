@@ -134,10 +134,14 @@ def _heal_slice(platform, cluster: Cluster, host: Host) -> list[str] | None:
         conn = platform._master_conn(cluster.name)
         for n, _ in members:
             platform.executor.run(conn, f"{k8s.KUBECTL} cordon {n.name}")
+            # short eviction window: these nodes are being destroyed and at
+            # least one is already dead (pods there never evict cleanly) —
+            # a long per-node timeout would serialize into minutes on a
+            # 16-host slice and stall every other cluster's heal tick
             platform.executor.run(
                 conn, f"{k8s.KUBECTL} drain {n.name} --ignore-daemonsets "
-                      f"--delete-emptydir-data --force --timeout=120s",
-                timeout=180)
+                      f"--delete-emptydir-data --force --timeout=20s",
+                timeout=40)
             platform.executor.run(conn, f"{k8s.KUBECTL} delete node {n.name}")
     except Exception as e:  # noqa: BLE001 — drain is best-effort
         log.warning("[%s] slice %s drain incomplete: %s",

@@ -11,6 +11,7 @@ registration with fact gathering (``host.py:96-142``), and message fan-out.
 
 from __future__ import annotations
 
+import re
 from typing import Any
 
 from kubeoperator_tpu.config.catalog import Catalog, load_catalog
@@ -483,8 +484,35 @@ class Platform:
                 out[host.tpu_slice_id] = out.get(host.tpu_slice_id, 0) + 1
         return out
 
-    def _app_cluster(self, name: str, app: str):
+    # chart/app names reach a file path and a shell command on the master —
+    # constrain them to k8s-object-name shape everywhere they're accepted
+    APP_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+    def create_chart(self, name: str, template: str, description: str = ""):
+        """Register a user-authored chart (the chartmuseum-role
+        replacement). Names are validated (they become file paths and
+        kubectl arguments on the master) and may not shadow a built-in."""
         from kubeoperator_tpu.apps import manifests
+        from kubeoperator_tpu.resources.entities import CustomChart
+
+        if not self.APP_NAME_RE.match(name or ""):
+            raise PlatformError(
+                f"invalid chart name {name!r} (lowercase alphanumerics and "
+                "dashes, ≤63 chars)")
+        if name in manifests.list_apps():
+            raise PlatformError(f"{name!r} is a built-in chart")
+        if self.store.get_by_name(CustomChart, name, scoped=False):
+            raise PlatformError(f"chart {name!r} already exists")
+        if not (template or "").strip():
+            raise PlatformError("chart template is empty")
+        chart = CustomChart(name=name, template=template,
+                            description=description)
+        self.store.save(chart)
+        return chart
+
+    def _app_cluster(self, name: str, app: str, allow_installed: bool = False):
+        from kubeoperator_tpu.apps import manifests
+        from kubeoperator_tpu.resources.entities import CustomChart
 
         cluster = self.store.get_by_name(Cluster, name, scoped=False)
         if cluster is None:
@@ -492,16 +520,39 @@ class Platform:
         if cluster.status not in (ClusterStatus.RUNNING, ClusterStatus.WARNING):
             raise PlatformError(
                 f"cluster {name!r} is {cluster.status}; apps need a running cluster")
-        if app not in manifests.list_apps():
+        if not self.APP_NAME_RE.match(app or ""):
+            raise PlatformError(f"invalid app name {app!r}")
+        known = (app in manifests.list_apps()
+                 or self.store.get_by_name(CustomChart, app, scoped=False) is not None)
+        if not known and allow_installed:
+            # a deleted CustomChart must not orphan its installed workload
+            known = app in (cluster.configs.get("installed_apps") or {})
+        if not known:
             raise PlatformError(f"unknown app {app!r}")
         return cluster
+
+    def _render_app_manifest(self, cluster, app: str, vars: dict) -> str:
+        """Built-in chart, or a user-authored CustomChart row (the
+        chartmuseum-role replacement) — same render parameters either way.
+        Built-ins take precedence (create_chart forbids the collision, but
+        a row smuggled in by other means must not shadow system charts)."""
+        from kubeoperator_tpu.apps import manifests
+        from kubeoperator_tpu.resources.entities import CustomChart
+
+        registry = cluster.configs.get("registry", "registry.local:8082")
+        builtin = manifests.render_app(app, registry=registry, vars=vars)
+        if builtin is not None:
+            return builtin
+        chart = self.store.get_by_name(CustomChart, app, scoped=False)
+        if chart is None:
+            raise PlatformError(f"unknown app {app!r}")
+        return manifests.render_custom(chart.template, registry, vars)
 
     def install_app(self, name: str, app: str, vars: dict | None = None) -> dict:
         """Render an app chart and apply it to a *running* cluster. TPU
         workload charts get slice-aware defaults: the slice picker value
         (``slice_id``) resolves to its member count (``slice_hosts``) so the
         gang-scheduled StatefulSet matches the slice shape."""
-        from kubeoperator_tpu.apps import manifests
         from kubeoperator_tpu.engine.steps import k8s
 
         cluster = self._app_cluster(name, app)
@@ -529,8 +580,7 @@ class Platform:
                     f"slice {vars['slice_id']!r} has {slices[vars['slice_id']]} "
                     f"hosts, not {want} — a partial-slice gang cannot run "
                     "(the slice is one schedulable unit)")
-        registry = cluster.configs.get("registry", "registry.local:8082")
-        manifest = manifests.render_app(app, registry=registry, vars=vars)
+        manifest = self._render_app_manifest(cluster, app, vars)
         conn = self._master_conn(name)
         path = f"{k8s.MANIFESTS}/app-{app}.yaml"
         self.executor.put_file(conn, path, manifest.encode())
@@ -542,17 +592,19 @@ class Platform:
         return {"app": app, "vars": vars}
 
     def uninstall_app(self, name: str, app: str) -> dict:
-        from kubeoperator_tpu.apps import manifests
         from kubeoperator_tpu.engine.steps import k8s
 
-        cluster = self._app_cluster(name, app)
+        cluster = self._app_cluster(name, app, allow_installed=True)
         installed = dict(cluster.configs.get("installed_apps") or {})
         vars = installed.pop(app, {})
-        registry = cluster.configs.get("registry", "registry.local:8082")
-        manifest = manifests.render_app(app, registry=registry, vars=vars)
         conn = self._master_conn(name)
         path = f"{k8s.MANIFESTS}/app-{app}.yaml"
-        self.executor.put_file(conn, path, manifest.encode())
+        # prefer the manifest file install_app left on the master: it is
+        # exactly what was applied, and it survives the CustomChart row
+        # being edited or deleted since
+        if not self.executor.run(conn, f"test -e {path}").ok:
+            manifest = self._render_app_manifest(cluster, app, vars)
+            self.executor.put_file(conn, path, manifest.encode())
         self._run_checked(
             conn, f"{k8s.KUBECTL} delete -f {path} --ignore-not-found", timeout=300)
         self.executor.run(conn, f"rm -f {path}")

