@@ -732,6 +732,120 @@ async def ws_webkubectl(request: web.Request) -> web.WebSocketResponse:
         await ws.close()
     return ws
 
+async def ws_webkubectl_tty(request: web.Request) -> web.WebSocketResponse:
+    """Interactive terminal bridge (the reference's webkubectl xterm): the
+    kubectl line from ``?cmd=`` runs under a real local PTY (ssh -tt to the
+    first master), raw output streams down as BINARY frames, and TEXT
+    frames carry ``{"input": ...}`` keystrokes / ``{"resize": [cols,
+    rows]}``. Closing the socket kills the process group."""
+    import fcntl
+    import pty
+    import signal
+    import struct
+    import subprocess
+    import termios
+
+    platform: Platform = request.app["platform"]
+    token = request.match_info["token"]
+    cmd = request.query.get("cmd", "")
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    try:
+        argv = await _sync(request, platform.webkubectl_tty_argv, token, cmd)
+    except (WebkubectlSessionError, PlatformError) as e:
+        await ws.send_json({"error": str(e)})
+        await ws.close()
+        return ws
+
+    master, slave = pty.openpty()
+    proc = subprocess.Popen(argv, stdin=slave, stdout=slave, stderr=slave,
+                            preexec_fn=os.setsid, close_fds=True)
+    os.close(slave)
+    # non-blocking master: a remote that stops reading stdin must drop
+    # keystrokes, not freeze the event loop on os.write
+    os.set_blocking(master, False)
+    loop = asyncio.get_event_loop()
+    # bounded queue + reader backpressure: a firehose command (logs -f,
+    # yes) against a slow client pauses the PTY read instead of growing
+    # controller memory without bound
+    out_q: asyncio.Queue[bytes] = asyncio.Queue(maxsize=256)
+    reading = True
+
+    def on_readable() -> None:
+        nonlocal reading
+        try:
+            data = os.read(master, 4096)
+        except BlockingIOError:
+            return
+        except OSError:
+            data = b""
+        try:
+            out_q.put_nowait(data)
+        except asyncio.QueueFull:
+            loop.remove_reader(master)   # resumed by the pump after a drain
+            reading = False
+            return
+        if not data:
+            loop.remove_reader(master)
+            reading = False
+
+    loop.add_reader(master, on_readable)
+
+    async def pump_out() -> None:
+        nonlocal reading
+        while True:
+            data = await out_q.get()
+            if not data:
+                break
+            await ws.send_bytes(data)
+            if not reading and proc.poll() is None:
+                loop.add_reader(master, on_readable)
+                reading = True
+        await ws.close()
+
+    out_task = asyncio.ensure_future(pump_out())
+    try:
+        async for msg in ws:
+            if msg.type != web.WSMsgType.TEXT:
+                continue
+            try:
+                frame = json.loads(msg.data)
+            except json.JSONDecodeError:
+                continue
+            try:
+                if "input" in frame:
+                    os.write(master, str(frame["input"]).encode())
+                elif "resize" in frame:
+                    cols, rows = (list(frame["resize"]) + [80, 24])[:2]
+                    fcntl.ioctl(master, termios.TIOCSWINSZ,
+                                struct.pack("HHHH", int(rows), int(cols), 0, 0))
+            except (BlockingIOError, OSError, TypeError, ValueError):
+                continue                  # bad frame / full pty: drop, not die
+    finally:
+        out_task.cancel()
+        if reading:
+            try:
+                loop.remove_reader(master)
+            except (OSError, ValueError):
+                pass
+
+        def reap() -> None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()               # no zombie after SIGKILL
+        # reap off-loop: a SIGTERM-ignoring ssh must not stall the server
+        await loop.run_in_executor(None, reap)
+        os.close(master)
+        await ws.close()
+    return ws
+
+
 async def ws_progress(request: web.Request) -> web.WebSocketResponse:
     """Push execution step JSON every second until it finishes
     (reference ``F2OWebsocket``, 1 s cadence, ``ws.py:8-30``)."""
@@ -880,6 +994,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/ws/progress/{id}", ws_progress)
     r.add_get("/ws/tasks/{id}/log", ws_task_log)
     r.add_get("/ws/webkubectl/{token}", ws_webkubectl)
+    r.add_get("/ws/webkubectl/{token}/tty", ws_webkubectl_tty)
 
     ui_dir = os.path.join(os.path.dirname(__file__), "..", "ui")
 

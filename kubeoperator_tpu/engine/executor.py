@@ -91,11 +91,20 @@ class Executor:
         on it for deterministic histories)."""
         return [self.run(conn, cmd, timeout=timeout) for conn, cmd in targets]
 
+    def tty_argv(self, conn: Conn, command: str) -> list[str] | None:
+        """argv for an *interactive* remote command under a local PTY (the
+        webkubectl terminal bridge). None = this transport cannot host a
+        TTY (FakeExecutor — tests drive the PTY pump with a patched argv)."""
+        return None
+
 
 # ---------------------------------------------------------------------------
 
 
 class LocalExecutor(Executor):
+    def tty_argv(self, conn: Conn, command: str) -> list[str] | None:
+        return ["bash", "-lc", command]
+
     def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
         try:
             p = subprocess.run(["bash", "-lc", command], capture_output=True,
@@ -160,6 +169,12 @@ class SSHExecutor(Executor):
             args += ["-i", key]
         args.append(f"{conn.username}@{conn.ip}")
         return args
+
+    def tty_argv(self, conn: Conn, command: str) -> list[str] | None:
+        # -tt forces remote PTY allocation even without a local terminal —
+        # what interactive kubectl (exec -it / top / sh) needs
+        base = self._base(conn)
+        return base[:1] + ["-tt"] + base[1:] + [command]
 
     def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
         try:

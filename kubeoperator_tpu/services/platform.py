@@ -612,11 +612,7 @@ class Platform:
         self.store.save(cluster)
         return {"app": app, "uninstalled": True}
 
-    def webkubectl_exec(self, token: str, command: str) -> str:
-        """Run one kubectl command line for a session token. The line is the
-        *arguments* to kubectl (e.g. ``get pods -A``); shell metacharacters
-        are rejected — the session is a kubectl bridge, not a shell."""
-        import shlex
+    def _webkubectl_session_cluster(self, token: str) -> str:
         import time as _time
 
         sessions = getattr(self, "_webkubectl_sessions", {})
@@ -624,7 +620,15 @@ class Platform:
         if session is None or session[1] <= _time.monotonic():
             sessions.pop(token, None)
             raise WebkubectlSessionError("invalid or expired webkubectl token")
-        name = session[0]
+        return session[0]
+
+    @staticmethod
+    def _kubectl_command(command: str) -> str:
+        """Validate a kubectl argument line and re-quote it. Shell
+        metacharacters are rejected — both the one-shot bridge and the TTY
+        launch line pass through a remote shell."""
+        import shlex
+
         try:
             args = shlex.split(command)
         except ValueError as e:
@@ -636,9 +640,27 @@ class Platform:
         banned = {";", "|", "&", ">", "<", "`", "$("}
         if any(b in tok for tok in args for b in banned):
             raise PlatformError("shell metacharacters are not allowed")
-        cmd = "kubectl " + " ".join(shlex.quote(a) for a in args)
+        return "kubectl " + " ".join(shlex.quote(a) for a in args)
+
+    def webkubectl_exec(self, token: str, command: str) -> str:
+        """Run one kubectl command line for a session token. The line is the
+        *arguments* to kubectl (e.g. ``get pods -A``)."""
+        name = self._webkubectl_session_cluster(token)
+        cmd = self._kubectl_command(command)
         result = self.executor.run(self._master_conn(name), cmd, timeout=60)
         return result.stdout if result.ok else (result.stdout + result.stderr)
+
+    def webkubectl_tty_argv(self, token: str, command: str) -> list[str]:
+        """argv for an *interactive* kubectl under a local PTY (the real
+        terminal the reference's webkubectl sidecar provides — ``exec -it``,
+        ``top``, shells). The WS handler spawns it and pumps bytes."""
+        name = self._webkubectl_session_cluster(token)
+        cmd = self._kubectl_command(command)
+        argv = self.executor.tty_argv(self._master_conn(name), cmd)
+        if argv is None:
+            raise PlatformError(
+                "this executor transport cannot host an interactive TTY")
+        return argv
 
     def create_user(self, name: str, password: str, email: str = "",
                     is_admin: bool = False) -> User:

@@ -32,6 +32,7 @@ const when = s => esc((s || "").slice(0, 19).replace("T", " "));
 function closeWs() {
   (state.ws || []).forEach(w => w.close()); state.ws = null;
   if (state.term) { state.term.close(); state.term = null; }
+  if (state.tty) { state.tty.close(); state.tty = null; }
 }
 function wsUrl(path) {
   const proto = location.protocol === "https:" ? "wss" : "ws";
@@ -420,8 +421,12 @@ async function clusterKubectl(name) {
   $("#tabview").innerHTML = `<div class="card"><h3>webkubectl</h3>
     <pre class="term" id="term">connecting…</pre>
     <input id="kcmd" placeholder="kubectl command, e.g. get pods -A">
+    <div class="row" style="margin-top:6px">
+      <input id="ttycmd" placeholder="interactive, e.g. exec -it mypod -- sh">
+      <button class="ghost" data-act="ttyConnect">open TTY</button></div>
     </div>`;
   const body = await api(`/clusters/${name}/webkubectl/token`);
+  state.kws = body.ws;
   const term = $("#term"); term.textContent = "";
   const ws = new WebSocket(wsUrl(body.ws));
   state.term = ws;
@@ -436,7 +441,12 @@ async function clusterKubectl(name) {
   const hist = []; let hi = 0;
   $("#kcmd").addEventListener("keydown", e => {
     const inp = $("#kcmd");
-    if (e.key === "Enter" && ws.readyState === 1 && inp.value.trim()) {
+    if (e.key === "Enter" && inp.value.trim() && state.tty
+        && state.tty.readyState === 1) {
+      state.tty.send(JSON.stringify({input: inp.value + "\n"}));
+      hist.push(inp.value); hi = hist.length;
+      inp.value = "";
+    } else if (e.key === "Enter" && ws.readyState === 1 && inp.value.trim()) {
       term.textContent += "$ kubectl " + inp.value + "\n";
       ws.send(inp.value);
       hist.push(inp.value); hi = hist.length;
@@ -450,6 +460,35 @@ async function clusterKubectl(name) {
       term.textContent = ""; e.preventDefault();
     }
   });
+  $("#kcmd").focus();
+}
+async function ttyConnect() {
+  /* real PTY over the WS bridge (ssh -tt → kubectl exec -it …): lines from
+     the input box become keystrokes, raw output streams into the term */
+  const cmd = $("#ttycmd").value.trim() || "exec -it shell -- sh";
+  const term = $("#term");
+  if (state.tty) state.tty.close();     // one live TTY at a time
+  const tws = new WebSocket(wsUrl(state.kws + "/tty?cmd=" + encodeURIComponent(cmd)));
+  tws.binaryType = "arraybuffer";
+  state.tty = tws;
+  term.textContent += `\n[tty] kubectl ${cmd}\n`;
+  tws.onopen = () => tws.send(JSON.stringify({resize: [120, 32]}));
+  tws.onmessage = ev => {
+    if (typeof ev.data === "string") {
+      try {
+        const m = JSON.parse(ev.data);
+        if (m.error) term.textContent += "error: " + m.error + "\n";
+      } catch (e) {}
+      return;
+    }
+    term.textContent += new TextDecoder().decode(ev.data)
+      .replace(/\x1b.[0-9;?]*[a-zA-Z]/g, "");    // strip CSI for the <pre>
+    term.scrollTop = term.scrollHeight;
+  };
+  tws.onclose = () => {
+    if (state.tty === tws) state.tty = null;   // a replaced session must
+    term.textContent += "\n[tty closed]\n";    // not null the live one
+  };
   $("#kcmd").focus();
 }
 
@@ -903,6 +942,7 @@ document.addEventListener("click", e => {
     watch: () => watch(d.n), markRead: () => markRead(d.n),
     appAdd: () => appAdd(d.n, d.app), appDel: () => appDel(d.n, d.app),
     importDiscovered: () => importDiscovered(), chartAdd: () => chartAdd(d.n),
+    ttyConnect: () => ttyConnect(),
     retryEx: () => retryEx(d.n)}[d.act] || (() => {}))();
 });
 
