@@ -503,6 +503,28 @@ spec:
         volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
       volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
 """,
+    "jax-vit": """apiVersion: apps/v1
+kind: StatefulSet
+metadata: {{name: jax-vit, namespace: default}}
+spec:
+  serviceName: jax-vit
+  replicas: {slice_hosts}
+  podManagementPolicy: Parallel
+  selector: {{matchLabels: {{app: jax-vit}}}}
+  template:
+    metadata: {{labels: {{app: jax-vit, ko-accelerator: tpu}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: tpu, ko.tpu/slice: "{slice_id}"}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      containers:
+      - name: trainer
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "vit",
+                  "--batch-per-chip", "64", "--steps", "200"]
+        resources: {{limits: {{google.com/tpu: "4"}}}}
+        volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
+      volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
+""",
     "jax-llm-train": """apiVersion: apps/v1
 kind: StatefulSet
 metadata: {{name: jax-llm-train, namespace: default}}

@@ -229,6 +229,70 @@ def cmd_resnet50(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_vit(args: argparse.Namespace) -> int:
+    """Vision Transformer classification (encoder reuse of the LM blocks,
+    causal=False) over a dp mesh — the second vision family next to the
+    ResNet chart."""
+    dist = maybe_initialize_distributed()
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.workloads.sharding import build_mesh
+    from kubeoperator_tpu.workloads.transformer import TransformerConfig
+    from kubeoperator_tpu.workloads.vit import (
+        ViTConfig, VisionTransformer, train_step_fn,
+    )
+
+    devices = jax.devices()
+    spec = parse_mesh(args.mesh, len(devices))
+    mesh = build_mesh(spec, devices)
+    enc = TransformerConfig(
+        d_model=args.d_model, n_heads=args.heads, n_layers=args.layers,
+        d_ff=args.d_model * 4, causal=False,
+        max_seq_len=(args.image_size // args.patch) ** 2)
+    cfg = ViTConfig(num_classes=args.classes, image_size=args.image_size,
+                    patch=args.patch, encoder=enc)
+    model = VisionTransformer(cfg, mesh=mesh)
+    tx = optax.adamw(3e-4, weight_decay=0.05)
+    batch = args.batch_per_chip * len(devices)
+    shape = (batch, args.image_size, args.image_size, 3)
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    batch_shd = NamedSharding(mesh, P(data_axes or None))
+
+    def init(rng):
+        params = model.init(rng, jnp.zeros(shape, jnp.float32), train=False)["params"]
+        return {"step": jnp.zeros((), jnp.int32), "params": params,
+                "opt_state": tx.init(params)}
+
+    state = jax.jit(init)(jax.random.key(0))
+    step = jax.jit(train_step_fn(model, tx), donate_argnums=(0,),
+                   in_shardings=(None, batch_shd, batch_shd))
+    # per-process shards through the shared pipeline (same multi-host path
+    # as resnet50: each host synthesizes/loads only its slice of the batch)
+    from kubeoperator_tpu.workloads import data as data_pipe
+
+    local_batch = batch // jax.process_count()
+    source = data_pipe.synthetic_image_batches(
+        local_batch, args.image_size, args.classes,
+        seed=dist["process_id"], steps=args.steps)
+    stream = data_pipe.prefetch_to_device(source, batch_shd)
+    t0 = time.perf_counter()
+    metrics = {"loss": jnp.inf}
+    for images, labels in stream:
+        state, metrics = step(state, images, labels)
+        s = int(state["step"])
+        if s % max(1, args.steps // 5) == 0 or s == args.steps:
+            emit({"job": "vit", "step": s,
+                  "loss": round(float(metrics["loss"]), 4)})
+    dt = time.perf_counter() - t0
+    emit({"job": "vit", "done": True, "steps": args.steps,
+          "chips": len(devices), "mesh": dict(spec.sizes()),
+          "img_per_sec": round(batch * args.steps / dt, 1), **dist})
+    return 0
+
+
 def cmd_llm(args: argparse.Namespace) -> int:
     """Transformer LM over dp×fsdp×tp×sp (ring attention when sp>1) —
     the long-context workload chart."""
@@ -319,6 +383,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="npy dataset dir (images.npy+labels.npy); "
                          "default: synthetic stream")
 
+    vt = sub.add_parser("vit", help="Vision Transformer classification")
+    vt.add_argument("--steps", type=int, default=50)
+    vt.add_argument("--batch-per-chip", type=int, default=64)
+    vt.add_argument("--image-size", type=int, default=224)
+    vt.add_argument("--patch", type=int, default=16)
+    vt.add_argument("--d-model", type=int, default=768)
+    vt.add_argument("--heads", type=int, default=12)
+    vt.add_argument("--layers", type=int, default=12)
+    vt.add_argument("--classes", type=int, default=1000)
+    vt.add_argument("--mesh", type=str, default=None)
+
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
     lm.add_argument("--steps", type=int, default=100)
     lm.add_argument("--seq-len", type=int, default=2048)
@@ -348,7 +423,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 COMMANDS = {"smoke": cmd_smoke, "mnist": cmd_mnist,
-            "resnet50": cmd_resnet50, "llm": cmd_llm}
+            "resnet50": cmd_resnet50, "vit": cmd_vit, "llm": cmd_llm}
 
 
 def main(argv: list[str] | None = None) -> int:

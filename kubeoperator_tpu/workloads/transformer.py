@@ -46,6 +46,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     decode: bool = False        # KV-cached single-token decode (generate.py)
+    causal: bool = True         # False = bidirectional (encoder use: ViT)
     attention: str = "auto"     # auto | flash | dense — auto picks the pallas
                                 # flash kernel on TPU at seq ≥2048 (with
                                 # causal block-skipping it beats XLA's fused
@@ -158,14 +159,14 @@ class Attention(nn.Module):
             # device its [B, T/sp, H/tp, D] block; K/V ride the ring, or two
             # all-to-alls regroup seq<->heads (Ulysses).
             if cfg.sp_attention == "ulysses":
-                out = ra.sharded_ulysses_attention(self.mesh, q, k, v, causal=True)
+                out = ra.sharded_ulysses_attention(self.mesh, q, k, v, causal=cfg.causal)
             else:
-                out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=True)
+                out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=cfg.causal)
         elif (blk := self._flash_block(q.shape[1])) is not None:
             from kubeoperator_tpu.workloads.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True, block=blk)
+            out = flash_attention(q, k, v, causal=cfg.causal, block=blk)
         else:
-            out = ra.reference_attention(q, k, v, causal=True)
+            out = ra.reference_attention(q, k, v, causal=cfg.causal)
         return dense(features=x.shape[-1], axis=(-2, -1),
                      kernel_init=with_parts(nn.initializers.lecun_normal(),
                                             ("heads", "kv", "embed")), name="o")(out)
@@ -209,6 +210,24 @@ class Block(nn.Module):
         return x, None
 
 
+def stack_blocks(cfg: TransformerConfig, mesh: Any, name: str = "layers"):
+    """The shared block-stacking recipe: ``nn.scan`` puts layer params on a
+    leading 'layers' axis (one traced body for all depths — compile time
+    and HBM stay flat as n_layers grows), optionally under selective remat.
+    Used by the decoder LM and the ViT encoder alike."""
+    block = Block
+    if cfg.remat:
+        block = nn.remat(
+            Block, prevent_cse=False,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+    return nn.scan(
+        block, variable_axes={"params": 0, "cache": 0},
+        split_rngs={"params": True},
+        in_axes=nn.broadcast, length=cfg.n_layers,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg, mesh, name=name)
+
+
 class Transformer(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None
@@ -224,20 +243,7 @@ class Transformer(nn.Module):
             nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.d_model))
         x = emb[tokens].astype(cfg.dtype)
-
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False,
-                             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
-        # nn.scan stacks layer params on a leading 'layers' axis: one traced
-        # body for all depths — compile time and HBM stay flat as n_layers grows
-        stacked = nn.scan(
-            block, variable_axes={"params": 0, "cache": 0},
-            split_rngs={"params": True},
-            in_axes=nn.broadcast, length=cfg.n_layers,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-        )(cfg, self.mesh, name="layers")
-        x, _ = stacked(x, positions)
+        x, _ = stack_blocks(cfg, self.mesh)(x, positions)
         x = RMSNorm(name="ln_f")(x)
         if cfg.logits_bf16:
             # bf16 operands, f32 MXU accumulation: same f32 logits out, 4x

@@ -1,0 +1,101 @@
+"""Vision Transformer — the second vision workload family in the app store.
+
+TPU-first reuse: the encoder IS the LM transformer block stack
+(``transformer.Block`` under ``nn.scan``/``nn.remat``) with
+``causal=False`` — bidirectional attention over the patch sequence, same
+logical-axis sharding rules, same flash/dense attention selection. Images
+are patchified by a single stride-p conv (one MXU-friendly matmul over
+p·p·3-deep patches), position is 1-D RoPE over the flattened patch index
+(applied inside the shared Attention), and the head is mean-pool + Dense.
+
+No reference counterpart (the reference runs vision models only as opaque
+store charts, ``README.md:17-18``); this rounds out the authored workload
+families: ResNet (conv), ViT (encoder attention), LM (decoder attention),
+MoE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeoperator_tpu.workloads.transformer import (
+    RMSNorm, TransformerConfig, stack_blocks,
+)
+
+with_parts = nn.with_logical_partitioning
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    num_classes: int = 1000
+    image_size: int = 224
+    patch: int = 16
+    encoder: TransformerConfig = field(default_factory=lambda: TransformerConfig(
+        d_model=768, n_heads=12, n_layers=12, d_ff=3072, causal=False,
+        max_seq_len=(224 // 16) ** 2))
+
+    @property
+    def seq_len(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+
+class VisionTransformer(nn.Module):
+    cfg: ViTConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        cfg, enc = self.cfg, self.cfg.encoder
+        p = cfg.patch
+        x = nn.Conv(enc.d_model, (p, p), strides=(p, p), padding="VALID",
+                    dtype=enc.dtype, name="patch_embed",
+                    kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                           (None, None, None, "embed")))(
+                        images.astype(enc.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, enc.d_model)            # [B, T=hw/p², d]
+        positions = jnp.arange(x.shape[1])
+        x, _ = stack_blocks(enc, self.mesh)(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=1)  # mean-pool the patches
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head",
+                        kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                               ("embed", None)))(x)
+
+
+def flops_per_image(cfg: ViTConfig) -> float:
+    """Forward FLOPs per image (matmul terms ×2)."""
+    enc, t = cfg.encoder, cfg.seq_len
+    patch_embed = 2 * (cfg.patch ** 2 * 3) * enc.d_model * t
+    per_layer = 2 * 4 * enc.d_model ** 2 + 2 * 3 * enc.d_model * enc.d_ff
+    attn = 2 * 2 * t * enc.d_model                  # qk^T + pv per token
+    head = 2 * enc.d_model * cfg.num_classes
+    return patch_embed + t * enc.n_layers * (per_layer + attn) + head
+
+
+def train_step_fn(model: VisionTransformer, tx) -> Any:
+    """One jittable AdamW classification step (synthetic-data smoke path;
+    the full input pipeline lives in workloads/data.py)."""
+    import optax
+
+    def step(state, images, labels):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return ({"step": state["step"] + 1, "params": params,
+                 "opt_state": opt_state}, {"loss": loss, "accuracy": acc})
+
+    return step

@@ -118,7 +118,8 @@ def test_charts_reference_packaged_image():
     """Every workload chart must point at the image the package delivers."""
     from kubeoperator_tpu.apps import manifests
 
-    for name in ("tf-mnist", "jax-smoke", "jax-resnet50", "jax-llm-train"):
+    for name in ("tf-mnist", "jax-smoke", "jax-resnet50", "jax-vit",
+                 "jax-llm-train"):
         text = manifests.render_app(name, registry="reg.local:8082",
                                     vars={"slice_hosts": 2, "slice_id": "s0"})
         assert 'image: "reg.local:8082/ko-workloads:latest"' in text

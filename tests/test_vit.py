@@ -1,0 +1,98 @@
+"""ViT workload family: encoder reuse of the LM transformer blocks with
+bidirectional attention, shardable over the data axes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeoperator_tpu.workloads.transformer import TransformerConfig
+from kubeoperator_tpu.workloads.vit import (
+    ViTConfig, VisionTransformer, flops_per_image, train_step_fn,
+)
+
+TINY = ViTConfig(num_classes=10, image_size=32, patch=8,
+                 encoder=TransformerConfig(d_model=64, n_heads=4, n_layers=2,
+                                           d_ff=128, causal=False,
+                                           max_seq_len=16, dtype=jnp.float32,
+                                           remat=False))
+
+
+def test_forward_shape_and_grads():
+    model = VisionTransformer(TINY)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(1), x, train=False)["params"]
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+    def loss(p):
+        return model.apply({"params": p}, x).sum()
+
+    grads = jax.grad(loss)(params)
+    total = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
+    assert np.isfinite(total) and total > 0
+
+
+def test_attention_is_bidirectional():
+    """A ViT must see the whole patch sequence: perturbing the LAST patch
+    must change the representation used by predictions influenced by the
+    first — which a causal mask would forbid for token 0's column."""
+    model = VisionTransformer(TINY)
+    x = jax.random.normal(jax.random.key(0), (1, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(1), x, train=False)["params"]
+
+    import dataclasses
+
+    causal_cfg = ViTConfig(num_classes=10, image_size=32, patch=8,
+                           encoder=dataclasses.replace(TINY.encoder, causal=True))
+    causal_model = VisionTransformer(causal_cfg)
+    # same params, different masking → different logits
+    a = model.apply({"params": params}, x)
+    b = causal_model.apply({"params": params}, x)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_train_step_reduces_loss():
+    import optax
+
+    model = VisionTransformer(TINY)
+    tx = optax.adamw(1e-3)
+    x = jax.random.normal(jax.random.key(0), (8, 32, 32, 3), jnp.float32)
+    y = jnp.arange(8) % 10
+    params = model.init(jax.random.key(1), x, train=False)["params"]
+    state = {"step": jnp.zeros((), jnp.int32), "params": params,
+             "opt_state": tx.init(params)}
+    step = jax.jit(train_step_fn(model, tx))
+    state, first = step(state, x, y)
+    for _ in range(15):
+        state, metrics = step(state, x, y)
+    assert float(metrics["loss"]) < float(first["loss"])
+
+
+def test_vit_runs_on_virtual_mesh():
+    """dp-sharded batch on the 8-device CPU mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh
+
+    devices = jax.devices()
+    mesh = build_mesh(MeshSpec(dp=len(devices)), devices)
+    model = VisionTransformer(TINY, mesh=mesh)
+    import optax
+
+    tx = optax.adamw(1e-3)
+    shd = NamedSharding(mesh, P("dp"))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(0), (16, 32, 32, 3), jnp.float32), shd)
+    y = jax.device_put(jnp.arange(16) % 10, shd)
+    params = model.init(jax.random.key(1), x, train=False)["params"]
+    state = {"step": jnp.zeros((), jnp.int32), "params": params,
+             "opt_state": tx.init(params)}
+    step = jax.jit(train_step_fn(model, tx), donate_argnums=(0,),
+                   in_shardings=(None, shd, shd))
+    state, metrics = step(state, x, y)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_flops_accounting_positive():
+    assert flops_per_image(ViTConfig()) > 1e9   # ViT-B/16 ≈ 17.5 GFLOP fwd
