@@ -235,40 +235,21 @@ def cmd_vit(args: argparse.Namespace) -> int:
     ResNet chart."""
     dist = maybe_initialize_distributed()
     import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from kubeoperator_tpu.workloads.sharding import build_mesh
     from kubeoperator_tpu.workloads.transformer import TransformerConfig
-    from kubeoperator_tpu.workloads.vit import (
-        ViTConfig, VisionTransformer, train_step_fn,
-    )
+    from kubeoperator_tpu.workloads.vit import ViTConfig, ViTTrainer
 
     devices = jax.devices()
     spec = parse_mesh(args.mesh, len(devices))
-    mesh = build_mesh(spec, devices)
     enc = TransformerConfig(
         d_model=args.d_model, n_heads=args.heads, n_layers=args.layers,
         d_ff=args.d_model * 4, causal=False,
         max_seq_len=(args.image_size // args.patch) ** 2)
     cfg = ViTConfig(num_classes=args.classes, image_size=args.image_size,
                     patch=args.patch, encoder=enc)
-    model = VisionTransformer(cfg, mesh=mesh)
-    tx = optax.adamw(3e-4, weight_decay=0.05)
+    tr = ViTTrainer(cfg, spec, devices=devices)
+    state = tr.init_state()
     batch = args.batch_per_chip * len(devices)
-    shape = (batch, args.image_size, args.image_size, 3)
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    batch_shd = NamedSharding(mesh, P(data_axes or None))
-
-    def init(rng):
-        params = model.init(rng, jnp.zeros(shape, jnp.float32), train=False)["params"]
-        return {"step": jnp.zeros((), jnp.int32), "params": params,
-                "opt_state": tx.init(params)}
-
-    state = jax.jit(init)(jax.random.key(0))
-    step = jax.jit(train_step_fn(model, tx), donate_argnums=(0,),
-                   in_shardings=(None, batch_shd, batch_shd))
     # per-process shards through the shared pipeline (same multi-host path
     # as resnet50: each host synthesizes/loads only its slice of the batch)
     from kubeoperator_tpu.workloads import data as data_pipe
@@ -277,11 +258,10 @@ def cmd_vit(args: argparse.Namespace) -> int:
     source = data_pipe.synthetic_image_batches(
         local_batch, args.image_size, args.classes,
         seed=dist["process_id"], steps=args.steps)
-    stream = data_pipe.prefetch_to_device(source, batch_shd)
+    stream = data_pipe.prefetch_to_device(source, tr.batch_shd)
     t0 = time.perf_counter()
-    metrics = {"loss": jnp.inf}
     for images, labels in stream:
-        state, metrics = step(state, images, labels)
+        state, metrics = tr.train_step(state, images, labels)
         s = int(state["step"])
         if s % max(1, args.steps // 5) == 0 or s == args.steps:
             emit({"job": "vit", "step": s,

@@ -78,6 +78,62 @@ def flops_per_image(cfg: ViTConfig) -> float:
     return patch_embed + t * enc.n_layers * (per_layer + attn) + head
 
 
+class ViTTrainer:
+    """Sharded ViT classification trainer — same state/sharding discipline
+    as LMTrainer: params init from a batch-1 dummy (param shapes don't
+    depend on batch), logical axis names map through the one rules table
+    (fsdp = ZeRO-3, tp = megatron splits), the batch splits over the data
+    axes via the shared ``batch_sharding`` helper."""
+
+    def __init__(self, cfg: ViTConfig, spec=None, devices=None,
+                 learning_rate: float = 3e-4):
+        import optax
+
+        from kubeoperator_tpu.workloads.sharding import (
+            MeshSpec, batch_sharding, build_mesh, logical_axis_rules,
+        )
+
+        devices = devices if devices is not None else jax.devices()
+        self.spec = spec or MeshSpec(dp=len(devices))
+        self.mesh = build_mesh(self.spec, devices)
+        self.cfg = cfg
+        self.model = VisionTransformer(cfg, mesh=self.mesh)
+        self.tx = optax.adamw(learning_rate, weight_decay=0.05)
+        self.rules = logical_axis_rules(self.spec) + (("layers", None),)
+        self.batch_shd = batch_sharding(self.mesh, self.spec)
+        self._step = None
+
+    def init_state(self, rng=None) -> dict:
+        from kubeoperator_tpu.workloads.sharding import replicated
+
+        rng = rng if rng is not None else jax.random.key(0)
+        dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3),
+                          jnp.float32)
+
+        def init(r):
+            params = nn.unbox(self.model.init(r, dummy, train=False)["params"])
+            return {"step": jnp.zeros((), jnp.int32), "params": params,
+                    "opt_state": self.tx.init(params)}
+
+        boxed = jax.eval_shape(
+            lambda r: self.model.init(r, dummy, train=False)["params"], rng)
+        param_shardings = nn.logical_to_mesh_sharding(
+            nn.get_partition_spec(boxed), self.mesh, self.rules)
+        out_shardings = {"step": replicated(self.mesh),
+                         "params": param_shardings, "opt_state": None}
+        state = jax.jit(init, out_shardings=out_shardings)(rng)
+        self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
+        return state
+
+    def train_step(self, state, images, labels):
+        if self._step is None:
+            self._step = jax.jit(train_step_fn(self.model, self.tx),
+                                 donate_argnums=(0,),
+                                 in_shardings=(None, self.batch_shd,
+                                               self.batch_shd))
+        return self._step(state, images, labels)
+
+
 def train_step_fn(model: VisionTransformer, tx) -> Any:
     """One jittable AdamW classification step (synthetic-data smoke path;
     the full input pipeline lives in workloads/data.py)."""
