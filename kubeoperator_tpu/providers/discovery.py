@@ -35,7 +35,9 @@ Transport = Callable[[str, str, dict, bytes | None, float],
 
 
 class DiscoveryError(RuntimeError):
-    pass
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status
 
 
 def make_transport(verify: bool = True) -> Transport:
@@ -214,6 +216,73 @@ class OpenStackDiscovery:
                 "flavors": flavors}
 
 
+class GCEDiscovery:
+    """GCE/TPU browse: compute zones grouped by region, plus the TPU
+    accelerator types each zone offers (the slice-type picker for plans).
+    Auth is a caller-supplied OAuth access token (``gcloud auth
+    print-access-token``) — used for the browse only, never stored."""
+
+    COMPUTE = "https://compute.googleapis.com/compute/v1"
+    TPU = "https://tpu.googleapis.com/v2"
+
+    def __init__(self, project: str, access_token: str,
+                 transport: Transport | None = None, timeout: float = 20.0):
+        self.project = project
+        self.token = access_token
+        self.transport = transport or make_transport()
+        self.timeout = timeout
+
+    def _get(self, url: str) -> Any:
+        status, body, _ = self.transport(
+            "GET", url, {"Authorization": f"Bearer {self.token}"},
+            None, self.timeout)
+        if status != 200:
+            raise DiscoveryError(f"GET {url} failed ({status})", status=status)
+        return json.loads(body)
+
+    def zones(self) -> list[dict]:
+        data = self._get(f"{self.COMPUTE}/projects/{self.project}/zones")
+        return [{"name": z["name"],
+                 "region": z.get("region", "").rsplit("/", 1)[-1]}
+                for z in data.get("items", [])
+                if z.get("status", "UP") == "UP"]
+
+    def tpu_locations(self) -> set[str]:
+        """Zones with a TPU API presence — one call, so the per-zone
+        acceleratorTypes fetch doesn't hit all ~130 compute zones."""
+        data = self._get(f"{self.TPU}/projects/{self.project}/locations")
+        return {loc.get("locationId") or loc.get("name", "").rsplit("/", 1)[-1]
+                for loc in data.get("locations", [])}
+
+    def accelerator_types(self, zone: str) -> list[str]:
+        data = self._get(f"{self.TPU}/projects/{self.project}"
+                         f"/locations/{zone}/acceleratorTypes")
+        return [t.get("type") or t.get("name", "").rsplit("/", 1)[-1]
+                for t in data.get("acceleratorTypes", [])]
+
+    def discover(self) -> dict:
+        tpu_zones = self.tpu_locations()
+        by_region: dict[str, list[dict]] = {}
+        for z in self.zones():
+            tpus: list[str] = []
+            if z["name"] in tpu_zones:
+                try:
+                    tpus = self.accelerator_types(z["name"])
+                except DiscoveryError as e:
+                    if e.status != 404:   # auth/API-disabled must SURFACE,
+                        raise             # not degrade to an empty picker
+            by_region.setdefault(z["region"], []).append({
+                "name": z["name"],
+                "vars": {"gce_zone": z["name"]},
+                "choices": {"tpu_types": tpus},
+            })
+        return {"provider": "gce",
+                "regions": [{"name": region, "provider": "gce",
+                             "vars": {"project": self.project},
+                             "zones": zones}
+                            for region, zones in sorted(by_region.items())]}
+
+
 def discover(provider: str, params: dict,
              transport: Transport | None = None) -> dict:
     """Entry point the API route calls. ``params`` carries the endpoint and
@@ -222,7 +291,16 @@ def discover(provider: str, params: dict,
     endpoints on self-signed certs."""
     if transport is None:
         transport = make_transport(verify=bool(params.get("verify", True)))
-    if provider == "vsphere":
+    required = {"gce": ("project", "access_token"),
+                "vsphere": ("host", "username", "password"),
+                "openstack": ("auth_url", "username", "password")}
+    for key in required.get(provider, ()):
+        if not str(params.get(key, "")).strip():
+            raise DiscoveryError(f"missing parameter {key!r} for {provider}")
+    if provider == "gce":
+        client = GCEDiscovery(params["project"], params["access_token"],
+                              transport=transport)
+    elif provider == "vsphere":
         client = VSphereDiscovery(params["host"], params["username"],
                                   params["password"], transport=transport)
     elif provider == "openstack":

@@ -575,11 +575,11 @@ async function renderPlanning() {
         clusters / availability zones as regions and zones instead of
         typing them. Credentials are used for this call only.</p>
       <div class="row"><div>
-        <select id="dprov"><option>vsphere</option><option>openstack</option></select>
+        <select id="dprov"><option>gce</option><option>vsphere</option><option>openstack</option></select>
         <input id="dhost" placeholder="vCenter host / keystone auth URL">
         <input id="duser" placeholder="username">
-        <input id="dpass" type="password" placeholder="password">
-        <input id="dproj" placeholder="project (openstack)">
+        <input id="dpass" type="password" placeholder="password / gce access token">
+        <input id="dproj" placeholder="project (gce / openstack)">
         <button onclick="discoverIaas()">Discover</button></div>
       <div id="dresult" class="small"></div></div></div>`;
 }
@@ -587,6 +587,8 @@ async function discoverIaas() {
   const prov = $("#dprov").value;
   const params = prov === "vsphere"
     ? {host: $("#dhost").value, username: $("#duser").value, password: $("#dpass").value}
+    : prov === "gce"
+    ? {project: $("#dproj").value, access_token: $("#dpass").value}
     : {auth_url: $("#dhost").value, username: $("#duser").value,
        password: $("#dpass").value, project: $("#dproj").value || "admin"};
   try {

@@ -166,3 +166,63 @@ def test_discovery_routes(platform):
             assert any(z["name"] == "cl-1" for z in await r.json())
 
     asyncio.run(scenario())
+
+
+class GCETransport:
+    """Canned compute-zones + TPU acceleratorTypes shapes."""
+
+    def __call__(self, method, url, headers, body, timeout):
+        assert headers.get("Authorization") == "Bearer tok-g"
+        if url.endswith("/projects/ml-proj/locations"):
+            return 200, json.dumps({"locations": [
+                {"locationId": "us-central2-b"},
+                {"name": ".../locations/europe-west4-a"}]}), {}
+        if url.endswith("/projects/ml-proj/zones"):
+            return 200, json.dumps({"items": [
+                {"name": "us-central2-b", "status": "UP",
+                 "region": ".../regions/us-central2"},
+                {"name": "us-central2-x", "status": "DOWN",
+                 "region": ".../regions/us-central2"},
+                {"name": "europe-west4-a", "status": "UP",
+                 "region": ".../regions/europe-west4"}]}), {}
+        if "locations/us-central2-b/acceleratorTypes" in url:
+            return 200, json.dumps({"acceleratorTypes": [
+                {"type": "v4-8"}, {"type": "v4-16"}]}), {}
+        if "locations/europe-west4-a/acceleratorTypes" in url:
+            return 200, json.dumps({"acceleratorTypes": [
+                {"name": ".../acceleratorTypes/v5e-16"}]}), {}
+        return 404, "{}", {}
+
+
+def test_gce_discover_zones_and_tpu_types():
+    found = discovery.discover(
+        "gce", {"project": "ml-proj", "access_token": "tok-g"},
+        transport=GCETransport())
+    regions = {r["name"]: r for r in found["regions"]}
+    assert set(regions) == {"us-central2", "europe-west4"}
+    uc = regions["us-central2"]
+    assert [z["name"] for z in uc["zones"]] == ["us-central2-b"]  # DOWN filtered
+    assert uc["zones"][0]["choices"]["tpu_types"] == ["v4-8", "v4-16"]
+    assert uc["vars"]["project"] == "ml-proj"
+    ew = regions["europe-west4"]
+    assert ew["zones"][0]["choices"]["tpu_types"] == ["v5e-16"]
+
+
+def test_gce_auth_failure_surfaces_instead_of_empty_picker():
+    class Denied(GCETransport):
+        def __call__(self, method, url, headers, body, timeout):
+            if "acceleratorTypes" in url:
+                return 403, '{"error": "TPU API not enabled"}', {}
+            return super().__call__(method, url, headers, timeout=timeout,
+                                    body=body)
+
+    with pytest.raises(discovery.DiscoveryError, match="403"):
+        discovery.discover("gce", {"project": "ml-proj", "access_token": "tok-g"},
+                           transport=Denied())
+
+
+def test_missing_params_rejected_before_any_request():
+    with pytest.raises(discovery.DiscoveryError, match="missing parameter 'project'"):
+        discovery.discover("gce", {"project": " ", "access_token": "x"})
+    with pytest.raises(discovery.DiscoveryError, match="missing parameter 'host'"):
+        discovery.discover("vsphere", {"username": "u", "password": "p"})
