@@ -91,6 +91,15 @@ def main() -> None:
                                                     steps=6, warmup=2)
             out["llm_mfu"] = round(lm["mfu"], 4)
             out["llm_tokens_per_sec"] = round(lm["tokens_per_sec"])
+            # long-context point: flash attention made seq 4096 compile on
+            # this chip (dense previously failed the relay, PERF.md r3)
+            import dataclasses
+
+            lm4k_cfg = dataclasses.replace(lm_cfg, max_seq_len=4096)
+            lm4k = LMTrainer(lm4k_cfg, lm_spec).measure(batch=4 * n,
+                                                        seq_len=4096,
+                                                        steps=4, warmup=2)
+            out["llm_mfu_seq4k"] = round(lm4k["mfu"], 4)
         except Exception as e:  # noqa: BLE001 — secondary metric only
             print(f"# llm secondary metric failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

@@ -294,8 +294,12 @@ def discover(provider: str, params: dict,
     required = {"gce": ("project", "access_token"),
                 "vsphere": ("host", "username", "password"),
                 "openstack": ("auth_url", "username", "password")}
+    params = dict(params)
     for key in required.get(provider, ()):
-        if not str(params.get(key, "")).strip():
+        # normalize: a token pasted with its trailing newline would
+        # otherwise blow up urllib's header validation as a 500
+        params[key] = str(params.get(key, "")).strip()
+        if not params[key]:
             raise DiscoveryError(f"missing parameter {key!r} for {provider}")
     if provider == "gce":
         client = GCEDiscovery(params["project"], params["access_token"],

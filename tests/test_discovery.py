@@ -226,3 +226,22 @@ def test_missing_params_rejected_before_any_request():
         discovery.discover("gce", {"project": " ", "access_token": "x"})
     with pytest.raises(discovery.DiscoveryError, match="missing parameter 'host'"):
         discovery.discover("vsphere", {"username": "u", "password": "p"})
+
+
+def test_gce_tolerates_404_tpu_zone_and_strips_token():
+    """A TPU location whose acceleratorTypes 404s yields an empty picker
+    (not a failure), and a token pasted with a trailing newline is
+    normalized before it reaches the Authorization header."""
+    class Partial(GCETransport):
+        def __call__(self, method, url, headers, body, timeout):
+            if "locations/europe-west4-a/acceleratorTypes" in url:
+                return 404, "{}", {}
+            return super().__call__(method, url, headers, body, timeout)
+
+    found = discovery.discover(
+        "gce", {"project": "ml-proj", "access_token": "tok-g\n"},
+        transport=Partial())
+    regions = {r["name"]: r for r in found["regions"]}
+    assert regions["us-central2"]["zones"][0]["choices"]["tpu_types"] == [
+        "v4-8", "v4-16"]
+    assert regions["europe-west4"]["zones"][0]["choices"]["tpu_types"] == []
