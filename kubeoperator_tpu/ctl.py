@@ -173,6 +173,42 @@ def cmd_packages(args) -> int:
     return 0
 
 
+def cmd_apps(args) -> int:
+    """Runtime app store: list / install / uninstall charts on a RUNNING
+    cluster (slice-aware: --slice picks the TPU slice for gang charts)."""
+    c = Client()
+    if args.action == "list":
+        data = c.call("GET", f"/api/v1/clusters/{args.cluster}/apps")
+        installed = data.get("installed", {})
+        # installed-but-no-longer-available (deleted custom chart) rows
+        # must still show — they remain uninstallable
+        names = list(data.get("available", [])) + sorted(
+            set(installed) - set(data.get("available", [])))
+        table([{"app": a, "installed": "yes" if a in installed else "",
+                "vars": json.dumps(installed.get(a, "")) if a in installed else ""}
+               for a in names],
+              ["app", "installed", "vars"])
+        if data.get("slices"):
+            print("slices:", ", ".join(f"{s} ({n} hosts)"
+                                       for s, n in data["slices"].items()))
+        return 0
+    if not args.app:
+        print("error: `ko apps {install,uninstall}` needs an app name",
+              file=sys.stderr)
+        return 2
+    if args.action == "install":
+        vars = {"slice_id": args.slice} if args.slice else {}
+        result = c.call("POST",
+                        f"/api/v1/clusters/{args.cluster}/apps/{args.app}",
+                        {"vars": vars})
+        print(json.dumps(result))
+        return 0
+    result = c.call("DELETE",
+                    f"/api/v1/clusters/{args.cluster}/apps/{args.app}")
+    print(json.dumps(result))
+    return 0
+
+
 def cmd_logs(args) -> int:
     q = f"?query={urllib.parse.quote(args.query)}&level={args.level}&limit={args.limit}"
     for rec in reversed(Client().call("GET", "/api/v1/logs" + q)["logs"]):
@@ -216,6 +252,14 @@ def build_parser(sub) -> None:
     retry.add_argument("id")
     retry.add_argument("--no-wait", action="store_true")
     retry.set_defaults(fn=cmd_retry)
+
+    apps = sub.add_parser("apps", help="runtime app store on a cluster")
+    apps.add_argument("action", choices=("list", "install", "uninstall"))
+    apps.add_argument("cluster")
+    apps.add_argument("app", nargs="?", default="")
+    apps.add_argument("--slice", default="",
+                      help="TPU slice id for gang-scheduled workload charts")
+    apps.set_defaults(fn=cmd_apps)
 
     sub.add_parser("hosts", help="list hosts").set_defaults(fn=cmd_hosts)
     sub.add_parser("packages", help="list offline packages").set_defaults(fn=cmd_packages)

@@ -295,11 +295,17 @@ def discover(provider: str, params: dict,
                 "vsphere": ("host", "username", "password"),
                 "openstack": ("auth_url", "username", "password")}
     params = dict(params)
-    for key in required.get(provider, ()):
-        # normalize: a token pasted with its trailing newline would
-        # otherwise blow up urllib's header validation as a 500
+    # header-bound values (URLs, bearer tokens) get normalized — a token
+    # pasted with its trailing newline would otherwise blow up urllib's
+    # header validation as a 500. Passwords are NOT stripped: edge
+    # whitespace is legal there and they travel in bodies, not headers.
+    header_bound = {"gce": ("project", "access_token"),
+                    "vsphere": ("host",),
+                    "openstack": ("auth_url",)}
+    for key in header_bound.get(provider, ()):
         params[key] = str(params.get(key, "")).strip()
-        if not params[key]:
+    for key in required.get(provider, ()):
+        if not str(params.get(key, "")).strip():
             raise DiscoveryError(f"missing parameter {key!r} for {provider}")
     if provider == "gce":
         client = GCEDiscovery(params["project"], params["access_token"],

@@ -62,3 +62,22 @@ def test_ctl_not_logged_in(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "nope.json"))
     assert ctl.main(["clusters"]) == 1
     assert "not logged in" in capsys.readouterr().err
+
+
+def test_ctl_apps_lifecycle(live_server, tmp_path, monkeypatch, capsys):
+    """ko apps list/install/uninstall drive the runtime app store."""
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+
+    def flow(url):
+        ctl.main(["login", url, "admin", "--password", "KubeOperator@tpu1"])
+        assert ctl.main(["apps", "list", "demo"]) == 0
+        assert ctl.main(["apps", "install", "demo", "jax-smoke"]) == 0
+        assert ctl.main(["apps", "list", "demo"]) == 0
+        assert ctl.main(["apps", "uninstall", "demo", "jax-smoke"]) == 0
+        return True
+
+    assert run_with_server(live_server, flow)
+    out = capsys.readouterr().out
+    assert "jax-smoke" in out
+    assert '"app": "jax-smoke"' in out
