@@ -503,6 +503,36 @@ spec:
         volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
       volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
 """,
+    # KV-cached generation endpoint (inference side of the LM family)
+    "jax-serve": """apiVersion: apps/v1
+kind: Deployment
+metadata: {{name: jax-serve, namespace: default}}
+spec:
+  selector: {{matchLabels: {{app: jax-serve}}}}
+  template:
+    metadata: {{labels: {{app: jax-serve, ko-accelerator: tpu}}}}
+    spec:
+      nodeSelector: {{ko.accelerator: tpu}}
+      tolerations: [{{key: google.com/tpu, operator: Exists, effect: NoSchedule}}]
+      containers:
+      - name: server
+        image: "{registry}/ko-workloads:latest"
+        command: ["python", "-m", "kubeoperator_tpu.train.jobs", "serve",
+                  "--port", "8080", "--ckpt-dir", "/ckpt"]
+        ports: [{{containerPort: 8080}}]
+        readinessProbe: {{httpGet: {{path: /healthz, port: 8080}}}}
+        resources: {{limits: {{google.com/tpu: "4"}}}}
+        volumeMounts: [{{name: ckpt, mountPath: /ckpt}}]
+      volumes: [{{name: ckpt, hostPath: {{path: /var/lib/kubeoperator/ckpt}}}}]
+---
+apiVersion: v1
+kind: Service
+metadata: {{name: jax-serve, namespace: default}}
+spec:
+  type: NodePort
+  selector: {{app: jax-serve}}
+  ports: [{{port: 8080, nodePort: 30980}}]
+""",
     "jax-vit": """apiVersion: apps/v1
 kind: StatefulSet
 metadata: {{name: jax-vit, namespace: default}}
@@ -542,10 +572,16 @@ spec:
       - name: trainer
         image: "{registry}/ko-workloads:latest"
         command: ["python", "-m", "kubeoperator_tpu.train.jobs", "llm",
-                  "--seq-len", "8192", "--mesh", "dp:auto,tp:4"]
+                  "--seq-len", "8192", "--mesh", "dp:auto,tp:4",
+                  "--ckpt-dir", "/ckpt"]
         resources: {{limits: {{google.com/tpu: "4"}}}}
-        volumeMounts: [{{name: tpuenv, mountPath: /etc/kubeoperator}}]
-      volumes: [{{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}]
+        volumeMounts:
+        - {{name: tpuenv, mountPath: /etc/kubeoperator}}
+        - {{name: ckpt, mountPath: /ckpt}}
+      volumes:
+      - {{name: tpuenv, hostPath: {{path: /etc/kubeoperator}}}}
+      # same hostPath the jax-serve chart reads: train here, serve from it
+      - {{name: ckpt, hostPath: {{path: /var/lib/kubeoperator/ckpt}}}}
 """,
 }
 

@@ -273,6 +273,120 @@ def cmd_vit(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Token-generation HTTP endpoint over the KV-cached decode path (the
+    jax-serve chart): POST /generate {"prompt_ids": [...], "max_tokens": N,
+    "temperature": T} -> {"tokens": [...]}. Weights come from --ckpt-dir
+    (orbax, as written by the llm job) or fresh init for smoke serving.
+    Zero-dependency stdlib http.server; one request at a time (the TPU is
+    serial anyway — concurrency belongs to replicas)."""
+    import functools
+    import http.server
+    import json as _json
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.workloads.generate import generate
+    from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
+        n_layers=args.layers,
+        # default mirrors the llm job's SwiGLU recipe (jobs.py cmd_llm) so a
+        # default-trained checkpoint restores with matching shapes
+        d_ff=args.d_ff or int(args.d_model * 8 / 3 / 32) * 32,
+        max_seq_len=args.max_seq_len,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    model_params = None
+    if args.ckpt_dir:
+        from kubeoperator_tpu.workloads.checkpoint import WorkloadCheckpointer
+        from kubeoperator_tpu.workloads.lm import LMTrainer
+
+        ckpt = WorkloadCheckpointer(args.ckpt_dir)
+        if ckpt.latest_step() is not None:
+            # the llm job wrote the full trainer state; mirror its structure
+            # (default spec = dp over however many chips this pod has)
+            lt = LMTrainer(cfg)
+            state = lt.init_state()
+            state = ckpt.restore(_abstract_like(state, lt.state_shardings))
+            model_params = state["params"]
+            emit({"job": "serve",
+                  "weights": f"checkpoint step {int(state['step'])}"})
+            del state, lt   # drop the AdamW moments (~2x params) for serving
+        ckpt.close()
+    if model_params is None:
+        from kubeoperator_tpu.workloads.transformer import Transformer
+
+        model_params = Transformer(cfg).init(
+            jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32))["params"]
+        emit({"job": "serve", "weights": "fresh-init (no checkpoint)"})
+
+    # one compiled decode per (prompt_len, max_tokens, temperature) shape —
+    # generate() rebuilds its scan closure per call, which would re-trace on
+    # every request on the serving hot path
+    @functools.lru_cache(maxsize=16)
+    def decode_fn(prompt_len: int, max_new: int, temp: float):
+        return jax.jit(lambda params, prompt, rng: generate(
+            cfg, params, prompt, max_new, temperature=temp, rng=rng))
+
+    tpu_lock = threading.Lock()   # one generation at a time on the chip
+    decode_fn(4, 4, 0.0)(model_params, jnp.zeros((1, 4), jnp.int32),
+                         jax.random.key(0))   # warm trace+compile
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):  # noqa: N802 — quiet access log
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = _json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._json(200, {"status": "ok", "model": {
+                    "d_model": cfg.d_model, "layers": cfg.n_layers,
+                    "vocab": cfg.vocab_size, "max_seq_len": cfg.max_seq_len}})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/generate":
+                return self._json(404, {"error": "not found"})
+            try:
+                req = _json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))))
+                prompt = jnp.asarray([req["prompt_ids"]], jnp.int32)
+                max_new = int(req.get("max_tokens", 16))
+                temp = float(req.get("temperature", 0.0))
+                if prompt.shape[1] < 1:
+                    raise ValueError("prompt_ids must be non-empty")
+                with tpu_lock:
+                    out = decode_fn(prompt.shape[1], max_new, temp)(
+                        model_params, prompt,
+                        jax.random.key(int(req.get("seed", 0))))
+                self._json(200, {"tokens": out[0].tolist(),
+                                 "new_tokens": out[0, prompt.shape[1]:].tolist()})
+            except (KeyError, ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+
+    # threading server: /healthz (the chart's readinessProbe) must answer
+    # while a long /generate holds the TPU lock — a single-threaded server
+    # would fail the probe mid-request and eject the pod from the Service
+    server = http.server.ThreadingHTTPServer((args.host, args.port), Handler)
+    emit({"job": "serve", "listening": f"{args.host}:{args.port}"})
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_llm(args: argparse.Namespace) -> int:
     """Transformer LM over dp×fsdp×tp×sp (ring attention when sp>1) —
     the long-context workload chart."""
@@ -374,6 +488,20 @@ def build_parser() -> argparse.ArgumentParser:
     vt.add_argument("--classes", type=int, default=1000)
     vt.add_argument("--mesh", type=str, default=None)
 
+    sv = sub.add_parser("serve", help="KV-cached generation HTTP endpoint")
+    sv.add_argument("--host", default="0.0.0.0")
+    sv.add_argument("--port", type=int, default=8080)
+    sv.add_argument("--vocab", type=int, default=32_000)
+    sv.add_argument("--d-model", type=int, default=512)
+    sv.add_argument("--heads", type=int, default=8)
+    sv.add_argument("--layers", type=int, default=4)
+    sv.add_argument("--d-ff", type=int, default=None)
+    sv.add_argument("--max-seq-len", type=int, default=2048)
+    sv.add_argument("--ckpt-dir", type=str, default=None)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--bf16", action="store_true", default=True)
+    sv.add_argument("--no-bf16", dest="bf16", action="store_false")
+
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
     lm.add_argument("--steps", type=int, default=100)
     lm.add_argument("--seq-len", type=int, default=2048)
@@ -403,7 +531,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 COMMANDS = {"smoke": cmd_smoke, "mnist": cmd_mnist,
-            "resnet50": cmd_resnet50, "vit": cmd_vit, "llm": cmd_llm}
+            "resnet50": cmd_resnet50, "vit": cmd_vit, "llm": cmd_llm,
+            "serve": cmd_serve}
 
 
 def main(argv: list[str] | None = None) -> int:
