@@ -103,6 +103,26 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — secondary metric only
             print(f"# llm secondary metric failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+        # third family: ViT-B/16 training (encoder attention), b128/chip
+        try:
+            import jax.numpy as jnp
+
+            from kubeoperator_tpu.workloads.transformer import TransformerConfig
+            from kubeoperator_tpu.workloads.vit import ViTConfig, ViTTrainer
+
+            enc = TransformerConfig(d_model=768, n_heads=12, n_layers=12,
+                                    d_ff=3072, causal=False, max_seq_len=196,
+                                    dtype=jnp.bfloat16, remat=True)
+            vcfg = ViTConfig(num_classes=1000, image_size=224, patch=16,
+                             encoder=enc)
+            vt = ViTTrainer(vcfg, MeshSpec(dp=n) if n > 1 else MeshSpec())
+            vit = vt.measure(batch=128 * n, steps=6, warmup=2)
+            out["vit_mfu"] = round(vit["mfu"], 4)
+            out["vit_img_per_sec_per_chip"] = round(
+                vit["img_per_sec_per_chip"], 1)
+        except Exception as e:  # noqa: BLE001 — secondary metric only
+            print(f"# vit secondary metric failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     print(json.dumps(out))
 
 

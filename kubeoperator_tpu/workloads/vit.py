@@ -133,6 +133,37 @@ class ViTTrainer:
                                                self.batch_shd))
         return self._step(state, images, labels)
 
+    def measure(self, batch: int, steps: int = 6, warmup: int = 2) -> dict:
+        """Timed loop → img/s + MFU (same discipline as Trainer/LMTrainer:
+        host-transfer fences, fwd+bwd ≈ 3× forward FLOPs)."""
+        import time
+
+        from kubeoperator_tpu.workloads.train import peak_flops_per_chip
+
+        state = self.init_state()
+        size = self.cfg.image_size
+        images = jax.device_put(jax.random.normal(
+            jax.random.key(0), (batch, size, size, 3), jnp.float32),
+            self.batch_shd)
+        labels = jax.device_put(jax.random.randint(
+            jax.random.key(1), (batch,), 0, self.cfg.num_classes),
+            self.batch_shd)
+        for _ in range(max(1, warmup)):
+            state, m = self.train_step(state, images, labels)
+        float(m["loss"])                  # fence (see Trainer.measure)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = self.train_step(state, images, labels)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        n_chips = self.mesh.devices.size
+        achieved = 3 * flops_per_image(self.cfg) * batch / dt
+        return {"img_per_sec": batch / dt,
+                "img_per_sec_per_chip": batch / dt / n_chips,
+                "step_time_ms": dt * 1e3,
+                "mfu": achieved / (peak_flops_per_chip() * n_chips),
+                "chips": n_chips}
+
 
 def train_step_fn(model: VisionTransformer, tx) -> Any:
     """One jittable AdamW classification step (synthetic-data smoke path;
