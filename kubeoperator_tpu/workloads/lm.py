@@ -119,20 +119,15 @@ class LMTrainer:
         return jax.device_put(tokens, self.token_shd)
 
     def measure(self, batch: int, seq_len: int, steps: int = 10, warmup: int = 2) -> dict:
+        from kubeoperator_tpu.workloads.train import timed_steps
+
         state = self.init_state()
         tokens = self.synthetic_batch(batch, seq_len)
-        for _ in range(warmup):
-            state, m = self.train_step(state, tokens)
-        float(m["loss"])                       # hard barrier (host transfer)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = self.train_step(state, tokens)
-        float(m["loss"])
-        dt = time.perf_counter() - t0
+        _, dt = timed_steps(self.train_step, state, (tokens,), steps, warmup)
         n_chips = self.mesh.devices.size
         tokens_per_step = batch * seq_len
-        achieved = 3 * flops_per_token(self.cfg, seq_len) * tokens_per_step * steps / dt
-        return {"tokens_per_sec": tokens_per_step * steps / dt,
-                "step_time_ms": dt / steps * 1e3,
+        achieved = 3 * flops_per_token(self.cfg, seq_len) * tokens_per_step / dt
+        return {"tokens_per_sec": tokens_per_step / dt,
+                "step_time_ms": dt * 1e3,
                 "mfu": achieved / (peak_flops_per_chip() * n_chips),
                 "achieved_tflops": achieved / 1e12, "chips": n_chips}

@@ -134,11 +134,11 @@ class ViTTrainer:
         return self._step(state, images, labels)
 
     def measure(self, batch: int, steps: int = 6, warmup: int = 2) -> dict:
-        """Timed loop → img/s + MFU (same discipline as Trainer/LMTrainer:
-        host-transfer fences, fwd+bwd ≈ 3× forward FLOPs)."""
-        import time
-
-        from kubeoperator_tpu.workloads.train import peak_flops_per_chip
+        """Timed loop → img/s + MFU (fwd+bwd ≈ 3× forward FLOPs; the
+        warmup/fence/timing discipline is the shared ``timed_steps``)."""
+        from kubeoperator_tpu.workloads.train import (
+            peak_flops_per_chip, timed_steps,
+        )
 
         state = self.init_state()
         size = self.cfg.image_size
@@ -148,14 +148,8 @@ class ViTTrainer:
         labels = jax.device_put(jax.random.randint(
             jax.random.key(1), (batch,), 0, self.cfg.num_classes),
             self.batch_shd)
-        for _ in range(max(1, warmup)):
-            state, m = self.train_step(state, images, labels)
-        float(m["loss"])                  # fence (see Trainer.measure)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = self.train_step(state, images, labels)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / steps
+        _, dt = timed_steps(self.train_step, state, (images, labels),
+                            steps, warmup)
         n_chips = self.mesh.devices.size
         achieved = 3 * flops_per_image(self.cfg) * batch / dt
         return {"img_per_sec": batch / dt,

@@ -10,23 +10,29 @@ from kubeoperator_tpu.resources.entities import (
 from kubeoperator_tpu.services import healing
 
 
-@pytest.fixture
-def auto_running(platform, fake_executor):
-    region = Region(name="r1", provider="gce", vars={"project": "p"})
+def make_auto_cluster(platform, name, slice_type="v5e-8", worker_size=2,
+                      ip_count=30):
+    """Provision an AUTOMATIC cluster with one TPU slice pool on fakes."""
+    region = Region(name=f"r-{name}", provider="gce", vars={"project": "p"})
     platform.store.save(region)
-    zone = Zone(name="z1", region_id=region.id, vars={},
-                ip_pool=[f"10.5.0.{i}" for i in range(10, 40)])
+    zone = Zone(name=f"z-{name}", region_id=region.id, vars={},
+                ip_pool=[f"10.5.{len(name)}.{i}" for i in range(10, 10 + ip_count)])
     platform.store.save(zone)
-    plan = Plan(name="heal-plan", region_id=region.id, zone_ids=[zone.id],
-                template="SINGLE", worker_size=2,
-                tpu_pools=[{"slice_type": "v5e-8", "count": 1}])
+    plan = Plan(name=f"plan-{name}", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=worker_size,
+                tpu_pools=[{"slice_type": slice_type, "count": 1}])
     platform.store.save(plan)
-    platform.create_cluster("healme", deploy_type=DeployType.AUTOMATIC,
+    platform.create_cluster(name, deploy_type=DeployType.AUTOMATIC,
                             plan_id=plan.id,
                             configs={"registry": "reg.local:8082"})
-    ex = platform.run_operation("healme", "install")
+    ex = platform.run_operation(name, "install")
     assert ex.state == ExecutionState.SUCCESS, ex.result
-    return "healme"
+    return name
+
+
+@pytest.fixture
+def auto_running(platform, fake_executor):
+    return make_auto_cluster(platform, "healme")
 
 
 def put_bad_hours(platform, name, hours=("2026-07-30T01", "2026-07-30T02")):
@@ -162,3 +168,42 @@ def test_slice_heal_leaves_masters_alone(platform, auto_running):
     put_bad_hours(platform, "healme-master-1")
     assert healing.heal_tick(platform) == []
     assert platform.store.get_by_name(Host, "healme-master-1", scoped=False)
+
+
+def test_slice_heal_scales_to_16_host_slice(platform, fake_executor):
+    """v5e-64 = 16 hosts: one dead member replaces all 16 as a unit, the
+    converge restores the full pool, and every drain uses the short
+    eviction window (a long per-node timeout would stall the tick for
+    minutes at this size)."""
+    make_auto_cluster(platform, "big", slice_type="v5e-64", worker_size=1,
+                      ip_count=40)
+    platform.store.save(Setting(name="auto_heal", value="true"))
+    platform.store.save(Setting(name="auto_heal_slices", value="true"))
+    tpu = [h for h in platform.store.find(Host, scoped=False, project="big")
+           if h.has_tpu]
+    assert len(tpu) == 16
+    old_ids = {h.id for h in tpu}
+    for hour in ("2026-07-30T01", "2026-07-30T02"):
+        platform.store.save(HealthRecord(project="big", kind="host",
+                                         target=tpu[3].name, healthy=False,
+                                         hour=hour, name=f"b:{hour}"))
+    healed = healing.heal_tick(platform)
+    assert len(healed) == 16
+    from kubeoperator_tpu.resources.entities import DeployExecution, Node
+
+    master = next(n for n in platform.store.find(Node, scoped=False,
+                                                 project="big")
+                  if "master" in n.roles)
+    mip = platform.store.get(Host, master.host_id, scoped=False).ip
+    drains = [c for c in fake_executor.host(mip).history
+              if " drain " in c]
+    assert len(drains) == 16
+    assert all("--timeout=20s" in c for c in drains)
+    scale = [e for e in platform.store.find(DeployExecution, scoped=False,
+                                            project="big")
+             if e.operation == "scale"]
+    platform.tasks.wait(scale[0].id, timeout=300)
+    new_tpu = [h for h in platform.store.find(Host, scoped=False, project="big")
+               if h.has_tpu]
+    assert len(new_tpu) == 16
+    assert old_ids.isdisjoint({h.id for h in new_tpu})
