@@ -611,6 +611,38 @@ def render_custom(template: str, registry: str,
                   template)
 
 
+def image_refs(names: list[str] | None = None) -> dict[str, list[str]]:
+    """Bare image refs (no registry prefix) per app, extracted from the
+    *rendered* manifests — the single source of truth that both
+    ``scripts/build_system_package.sh`` (what to pull/save into the offline
+    package) and the air-gap cross-check test (what a cluster must be able
+    to resolve without egress) consume, so the two cannot drift. The
+    reference ships this content through per-package nexus registries
+    (``core/apps/kubeops_api/package_manage.py:31-53``)."""
+    import re
+
+    sentinel = "\x00REG\x00"
+    out: dict[str, list[str]] = {}
+    for name in names if names is not None else list_apps():
+        text = render_app(name, registry=sentinel,
+                          vars={"slice_hosts": 1, "slice_id": "s"})
+        if text is None:
+            raise KeyError(name)
+        refs = re.findall(r"image:\s*\"?%s/([^\s\"']+)" % re.escape(sentinel),
+                          text)
+        out[name] = sorted(set(refs))
+    return out
+
+
+def system_image_refs() -> list[str]:
+    """All image refs the system apps (everything except the ko-workloads
+    charts) need — the content list for the ko-system offline package."""
+    refs: set[str] = set()
+    for app_refs in image_refs(sorted(_SYSTEM)).values():
+        refs.update(app_refs)
+    return sorted(refs)
+
+
 def render_app(name: str, registry: str, vars: dict[str, Any] | None = None) -> str | None:
     vars = vars or {}
     params = {

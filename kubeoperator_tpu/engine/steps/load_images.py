@@ -36,6 +36,7 @@ def run(ctx: StepContext):
     if not images:
         return {"images": []}
     repo = k8s.repo_url(ctx)
+    repo_base = ctx.vars.get("repo_base")
     registry = ctx.vars.get("registry", "registry.local:8082")
 
     def per(th):
@@ -48,7 +49,12 @@ def run(ctx: StepContext):
             if present.ok and present.stdout.strip():
                 continue                      # already imported+tagged
             tar = f"{IMAGES_DIR}/{file.rsplit('/', 1)[-1]}"
-            o.ensure_binary(tar.rsplit("/", 1)[-1], f"{repo}/{file}",
+            # each entry names its source package (images aggregate across
+            # content packages at cluster create) — pull from that
+            # package's /repo/ path, not the cluster's main package
+            url = (f"{repo_base}/{img['package']}/{file}"
+                   if img.get("package") and repo_base else f"{repo}/{file}")
+            o.ensure_binary(tar.rsplit("/", 1)[-1], url,
                             dest_dir=IMAGES_DIR, sha256=img.get("sha256"))
             o.sh(f"{CTR} images import {shlex.quote(tar)}", timeout=600)
             # docker-save tarballs carry the short ref; containerd may

@@ -14,6 +14,10 @@ meta.yml schema (ours, TPU-extended):
 
     name: k8s-v1.28-tpu          # package identity (defaults to dir name)
     version: "1.28.2"
+    kind: content                # optional; "content" packages (ko-system,
+                                 # ko-workloads) have their images: merged
+                                 # into EVERY cluster at create — a second
+                                 # k8s package registered side by side is not
     vars:                        # merged into cluster configs at create
       kube_version: v1.28.2
       libtpu_version: "0.9"
@@ -77,10 +81,10 @@ def package_root(platform, package: Package) -> str:
                         package.meta.get("dir", package.name))
 
 
-def repo_url(platform, package: Package) -> str:
-    """URL nodes use to pull from this package's repo. ``repo_host`` must be
-    an address the nodes can reach; a wildcard bind address cannot be
-    baked into node commands, so that misconfiguration fails at cluster
+def repo_base_url(platform) -> str:
+    """Root of the controller-served package repo (``/repo``). ``repo_host``
+    must be an address the nodes can reach; a wildcard bind address cannot
+    be baked into node commands, so that misconfiguration fails at cluster
     creation rather than as an obscure mid-install download error."""
     host = platform.config.get("repo_host") or platform.config.bind_host
     if host in ("0.0.0.0", "::", ""):
@@ -88,7 +92,33 @@ def repo_url(platform, package: Package) -> str:
             "cannot derive a node-reachable package repo URL from wildcard "
             f"bind address {platform.config.bind_host!r}; set KO_REPO_HOST "
             "to the controller address nodes can reach")
-    return f"http://{host}:{platform.config.bind_port}/repo/{package.name}"
+    return f"http://{host}:{platform.config.bind_port}/repo"
+
+
+def repo_url(platform, package: Package) -> str:
+    """URL nodes use to pull from this package's repo."""
+    return f"{repo_base_url(platform)}/{package.name}"
+
+
+def image_tarball_name(ref: str) -> str:
+    """Deterministic tarball filename for an image ref
+    (``coredns:1.11`` -> ``coredns-1.11.tar``)."""
+    import re
+
+    return re.sub(r"[^A-Za-z0-9._-]", "-", ref) + ".tar"
+
+
+def plan_system_package() -> list[dict[str, str]]:
+    """The ``images:`` entries the ko-system offline package must carry —
+    one tarball per image ref any system manifest pulls. Derived from the
+    rendered manifests (``apps.manifests.system_image_refs``), so the
+    build script (``scripts/build_system_package.sh``) and the air-gap
+    cross-check test share one source of truth. ``sha256`` is filled in by
+    the build script after ``docker save``."""
+    from kubeoperator_tpu.apps import manifests
+
+    return [{"ref": ref, "file": f"images/{image_tarball_name(ref)}"}
+            for ref in manifests.system_image_refs()]
 
 
 def resolve_file(platform, package_name: str, rel_path: str) -> str:

@@ -133,17 +133,41 @@ class Platform:
             merged.update(pkg.meta.get("vars", {}))
             if pkg.meta.get("checksums"):
                 merged.setdefault("repo_checksums", pkg.meta["checksums"])
-            if pkg.meta.get("images"):
-                # offline image tarballs the load-images step imports into
-                # containerd on every node (see engine/steps/load_images.py)
-                merged.setdefault("repo_images", pkg.meta["images"])
+            # Offline image tarballs the load-images step imports into
+            # containerd on every node (engine/steps/load_images.py).
+            # Aggregated from the chosen package plus every *content*
+            # package (``kind: content`` in meta.yml — ko-system,
+            # ko-workloads), each entry tagged with its source package so
+            # the step pulls from the right /repo/<package>/ path. Other
+            # k8s packages (a second version registered side by side) are
+            # NOT swept in. First match per ref wins, chosen package first.
+            images: list[dict] = []
+            seen_refs: set[str] = set()
+            content = sorted(
+                (p for p in self.store.find(Package, scoped=False)
+                 if p.name != pkg.name and p.meta.get("kind") == "content"),
+                key=lambda p: p.name)
+            for p in [pkg, *content]:
+                for img in p.meta.get("images") or []:
+                    if img.get("ref") in seen_refs:
+                        continue
+                    seen_refs.add(img.get("ref"))
+                    images.append({**img, "package": p.name})
+            if images:
+                merged.setdefault("repo_images", images)
             # nodes pull binaries from the controller-served package repo
-            # (nexus-lite; reference package_manage.py:31-53)
-            if "repo_url" not in (configs or {}):
-                try:
-                    merged["repo_url"] = packages_svc.repo_url(self, pkg)
-                except ValueError as e:
+            # (nexus-lite; reference package_manage.py:31-53). repo_base is
+            # needed even when configs override repo_url — cross-package
+            # image entries resolve against it.
+            try:
+                repo_base = packages_svc.repo_base_url(self)
+            except ValueError as e:
+                repo_base = None
+                if "repo_url" not in (configs or {}):
                     raise PlatformError(str(e)) from e
+            if repo_base:
+                merged["repo_base"] = repo_base
+                merged["repo_url"] = f"{repo_base}/{pkg.name}"
         merged.update(configs or {})
         item_obj = None
         if item:

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Build the ko-system offline package: every container image the system-app
+# manifests reference (coredns, prometheus, node-exporter, promtail,
+# grafana, loki, ingress-nginx, dashboard, kubeapps, chartmuseum,
+# weave-scope, ...) pulled, saved and checksummed, so an air-gapped
+# cluster can run the full system stack with zero egress.
+#
+# The image list is NOT maintained here: it is derived from the rendered
+# manifests via kubeoperator_tpu.services.packages.plan_system_package(),
+# the same function the air-gap cross-check test
+# (tests/test_images.py::test_every_manifest_image_is_packaged) checks
+# against — add an image to a manifest and both this script and the test
+# pick it up automatically. Mirrors the reference's per-package nexus
+# content (core/apps/kubeops_api/package_manage.py:31-53, data/packages/).
+#
+# Usage: scripts/build_system_package.sh [PACKAGE_DIR] [UPSTREAM_PREFIX]
+#   PACKAGE_DIR      defaults to ./data/packages/ko-system
+#   UPSTREAM_PREFIX  optional registry prefix to pull refs from, e.g.
+#                    "mirror.example.com/" (refs are pulled as
+#                    "$UPSTREAM_PREFIX<ref>" and retagged bare)
+#
+# Produces:
+#   PACKAGE_DIR/meta.yml            (images + checksums)
+#   PACKAGE_DIR/images/<ref>.tar    (docker save, one per image)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PKG_DIR="${1:-./data/packages/ko-system}"
+UPSTREAM="${2:-}"
+
+mkdir -p "$PKG_DIR/images"
+
+plan=$(python -c '
+from kubeoperator_tpu.services.packages import plan_system_package
+for e in plan_system_package():
+    print(e["ref"], e["file"])
+')
+
+entries=""
+while read -r ref file; do
+  echo ">> $ref -> $file"
+  if [ -n "$UPSTREAM" ]; then
+    docker pull "$UPSTREAM$ref"
+    docker tag "$UPSTREAM$ref" "$ref"
+  else
+    docker pull "$ref"
+  fi
+  docker save "$ref" -o "$PKG_DIR/$file"
+  sha=$(sha256sum "$PKG_DIR/$file" | cut -d' ' -f1)
+  entries="$entries  - {file: $file, ref: '$ref', sha256: '$sha'}\n"
+done <<< "$plan"
+
+cat > "$PKG_DIR/meta.yml" <<EOF
+name: ko-system
+version: "$(python -c 'import tomllib;print(tomllib.load(open("pyproject.toml","rb"))["project"]["version"])')"
+kind: content
+vars: {}
+images:
+$(printf "%b" "$entries")
+EOF
+echo ">> done: $PKG_DIR"

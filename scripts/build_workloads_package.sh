@@ -39,6 +39,7 @@ sha_whl=$(sha256sum "$PKG_DIR/wheels/$wheel" | cut -d' ' -f1)
 cat > "$PKG_DIR/meta.yml" <<EOF
 name: ko-workloads
 version: "$(python -c 'import tomllib;print(tomllib.load(open("pyproject.toml","rb"))["project"]["version"])')"
+kind: content
 vars: {}
 images:
   - file: images/ko-workloads.tar

@@ -61,6 +61,37 @@ CPU_FACTS = {"cpu_core": 8, "memory_mb": 32768, "os": "Ubuntu", "os_version": "2
              "disk_gb": 200}
 
 
+def make_image_package(platform, name: str, entries: list[dict]) -> None:
+    """Register an offline image package the way the build scripts lay one
+    out: fake tarballs under images/, a meta.yml whose sha256s match what
+    the FakeExecutor's curl emulation materializes (``fetched:<url>``)."""
+    import hashlib
+    import os
+
+    import yaml
+
+    from kubeoperator_tpu.services import packages as svc
+    from kubeoperator_tpu.services.packages import scan_packages
+
+    pkg_dir = os.path.join(platform.config.packages, name)
+    os.makedirs(os.path.join(pkg_dir, "images"), exist_ok=True)
+    base = svc.repo_base_url(platform)
+    images = []
+    for e in entries:
+        path = os.path.join(pkg_dir, e["file"])
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"FAKE-OCI-TARBALL")
+        url = f"{base}/{name}/{e['file']}"
+        images.append({"file": e["file"], "ref": e["ref"],
+                       "sha256": hashlib.sha256(
+                           f"fetched:{url}".encode()).hexdigest()})
+    with open(os.path.join(pkg_dir, "meta.yml"), "w", encoding="utf-8") as f:
+        yaml.safe_dump({"name": name, "version": "1", "kind": "content",
+                        "vars": {}, "images": images}, f)
+    scan_packages(platform)
+
+
 def make_tpu_facts(tpu_type: str, worker_id: int, node_name: str) -> dict:
     return {**CPU_FACTS, "tpu_type": tpu_type, "tpu_worker_id": worker_id,
             "tpu_env": f"NODE_NAME: '{node_name}'"}
