@@ -31,22 +31,40 @@ STAGE_SIZES = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
 
 
+def mask_channels(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Zero every channel index >= n (no-op when x already has n channels).
+
+    Compute-padding support: a conv widened beyond its nominal channel
+    count stays mathematically identical to the narrow one as long as the
+    padded activations are exactly zero going into the next contraction —
+    and masking *after* BN+relu also zeroes the padded params' gradients,
+    so training dynamics match the narrow model bit-for-bit. The multiply
+    fuses into the preceding elementwise epilogue (no extra HBM pass)."""
+    if n >= x.shape[-1]:
+        return x
+    idx = jax.lax.broadcasted_iota(jnp.int32, (x.shape[-1],), 0)
+    return x * (idx < n).astype(x.dtype)
+
+
 class BottleneckBlock(nn.Module):
     features: int
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    pad_to: int = 0       # lift the bottleneck width to this many channels
+                          # (zero-masked back to `features` — see mask_channels)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         residual = x
-        y = self.conv(self.features, (1, 1))(x)
+        width = max(self.features, self.pad_to)
+        y = self.conv(width, (1, 1))(x)
         y = self.norm()(y)
-        y = nn.relu(y)
+        y = mask_channels(nn.relu(y), self.features)
         # v1.5: stride lives on the 3x3, not the 1x1 — better accuracy, same cost
-        y = self.conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.conv(width, (3, 3), strides=(self.strides, self.strides))(y)
         y = self.norm()(y)
-        y = nn.relu(y)
+        y = mask_channels(nn.relu(y), self.features)
         y = self.conv(self.features * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
@@ -97,6 +115,16 @@ class ResNet(nn.Module):
                                      # weight gradient (conv_vjp.Conv); 0 = off
     conv_bwd: str = "dot"            # "dot" | "pallas" | "dot2" — backward impl
                                      # for custom-VJP convs (conv_vjp.make_conv)
+    pad_min_channels: int = 0        # compute-pad activations narrower than
+                                     # this to this many channels (stem +
+                                     # stage-1 bottleneck width), zero-masked
+                                     # back to nominal — exact ResNet
+                                     # semantics. The PERF.md "Round 4" probe
+                                     # measured this NEGATIVE on v5e (layout
+                                     # flips but extra bytes/FLOPs dominate,
+                                     # 49→59 ms/step); kept default-off as
+                                     # the documented probe. Bottleneck
+                                     # (depth>=50) only.
 
     def _conv_ctor(self) -> ModuleDef:
         """nn.Conv, or the custom-VJP conv for small kernels (PERF.md: the
@@ -125,6 +153,13 @@ class ResNet(nn.Module):
         block = BottleneckBlock if self.depth >= 50 else BasicBlock
 
         x = x.astype(self.dtype)
+        if self.pad_min_channels and self.depth < 50:
+            # BasicBlock has no pad_to: a widened stem would make stage-0
+            # residual shapes mismatch and silently insert projection convs
+            # the nominal model doesn't have
+            raise ValueError("pad_min_channels requires depth >= 50 "
+                             "(bottleneck blocks)")
+        stem_width = max(self.width, self.pad_min_channels)
         if self.stem == "space_to_depth":
             # MLPerf-style conv0 space-to-depth: the 7x7/s2 conv sees only 3
             # input channels and starves the 128-wide MXU contraction. A 2x2
@@ -132,17 +167,19 @@ class ResNet(nn.Module):
             # (the 7x7 kernel zero-padded to 8x8 and regrouped) — identical
             # output shape, MXU-friendly contraction depth of 192 vs 147.
             x = space_to_depth(x, 2)
-            x = conv(self.width, (4, 4), name="stem_conv_s2d")(x)
+            x = conv(stem_width, (4, 4), name="stem_conv_s2d")(x)
         else:
-            x = conv(self.width, (7, 7), strides=(2, 2), name="stem_conv")(x)
+            x = conv(stem_width, (7, 7), strides=(2, 2), name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
-        x = nn.relu(x)
+        x = mask_channels(nn.relu(x), self.width)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(STAGE_SIZES[self.depth]):
             for i in range(n_blocks):
+                kw = ({"pad_to": self.pad_min_channels}
+                      if block is BottleneckBlock else {})
                 x = block(features=self.width * 2 ** stage,
                           strides=2 if stage > 0 and i == 0 else 1,
-                          conv=conv, norm=norm)(x)
+                          conv=conv, norm=norm, **kw)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x

@@ -64,6 +64,7 @@ class TrainConfig:
     dw_dot_max_k: int = 0            # dot-form conv weight gradient for kernels
                                      # up to this size (see workloads/conv_vjp.py)
     conv_bwd: str = "dot"            # "dot" | "pallas" | "dot2" (conv_vjp.make_conv)
+    pad_min_channels: int = 0        # compute-pad C<this activations (resnet.py)
 
 
 @dataclass
@@ -139,7 +140,8 @@ class Trainer:
                                    depth=self.cfg.depth, dtype=self.cfg.dtype,
                                    stem=self.cfg.stem,
                                    dw_dot_max_k=self.cfg.dw_dot_max_k,
-                                   conv_bwd=self.cfg.conv_bwd)
+                                   conv_bwd=self.cfg.conv_bwd,
+                                   pad_min_channels=self.cfg.pad_min_channels)
         self.tx = make_optimizer(self.cfg)
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None

@@ -1,0 +1,69 @@
+import glob, gzip, json, shutil
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+def prof_rows(d):
+    f = sorted(glob.glob(d + "/plugins/profile/*/*.trace.json.gz"))[-1]
+    ev = json.load(gzip.open(f))["traceEvents"]
+    rows = {}
+    for e in ev:
+        if e.get("ph") == "X" and "hlo_category" in e.get("args", {}):
+            r = rows.setdefault(e["name"], [0.0, e["args"].get("long_name","")[:150]])
+            r[0] += e["dur"]
+    return rows
+
+REPS = 20
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    r = f(*args); jax.tree.map(lambda t: float(jnp.sum(t.astype(jnp.float32))), r)
+    d = f"/tmp/ko_prof_b{abs(hash(name))}"
+    shutil.rmtree(d, ignore_errors=True)
+    with jax.profiler.trace(d):
+        for _ in range(REPS): r = f(*args)
+        jax.tree.map(lambda t: float(jnp.sum(t.astype(jnp.float32))), r)
+    rows = prof_rows(d)
+    print(f"== {name}: total {sum(v[0] for v in rows.values())/1000/REPS:.4f} ms")
+    for n,(dur,ln) in sorted(rows.items(), key=lambda kv:-kv[1][0])[:4]:
+        print(f"    {dur/1000/REPS:8.4f}  {n[:26]} | {ln}")
+
+B, Cin, Cout = 128, 64, 256
+x = jax.random.normal(jax.random.key(0), (B,56,56,Cin), jnp.bfloat16)
+w = jax.random.normal(jax.random.key(1), (1,1,Cin,Cout), jnp.bfloat16) * 0.05
+dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC","HWIO","NHWC"))
+conv = lambda x, w: lax.conv_general_dilated(x, w, (1,1), "SAME", dimension_numbers=dn)
+
+def sum_kernel(y_ref, o_ref):
+    i = pl.program_id(0)
+    part = y_ref[...].astype(jnp.float32).sum((0,1,2))
+    @pl.when(i == 0)
+    def _(): o_ref[...] = part
+    @pl.when(i > 0)
+    def _(): o_ref[...] += part
+
+def pallas_sum_naive(y):           # y (B,56,56,C): pallas forces row-major
+    return pl.pallas_call(
+        sum_kernel, grid=(B // 4,),
+        in_specs=[pl.BlockSpec((4,56,56,Cout), lambda i: (i,0,0,0))],
+        out_specs=pl.BlockSpec((Cout,), lambda i: (0,)),
+        out_shape=jax.ShapeDtypeStruct((Cout,), jnp.float32))(y)
+
+def pallas_sum_bitcast(y):         # transpose to match the conv's {3,0,2,1} layout
+    yt = jnp.transpose(y, (1, 2, 0, 3))        # logical (56,56,B,C): row-major == {3,0,2,1}
+    return pl.pallas_call(
+        sum_kernel, grid=(56 // 2,),
+        in_specs=[pl.BlockSpec((2,56,B,Cout), lambda i: (i,0,0,0))],
+        out_specs=pl.BlockSpec((Cout,), lambda i: (0,)),
+        out_shape=jax.ShapeDtypeStruct((Cout,), jnp.float32))(yt)
+
+bench("conv -> XLA sum (baseline)", lambda x,w: conv(x,w).astype(jnp.float32).sum((0,1,2)), x, w)
+bench("conv -> pallas sum naive", lambda x,w: pallas_sum_naive(conv(x,w)), x, w)
+bench("conv -> pallas sum bitcast-transpose", lambda x,w: pallas_sum_bitcast(conv(x,w)), x, w)
+
+# Measured on v5e (PERF.md "Round 4"): the naive pallas consumer pays a
+# 0.614 ms layout copy (conv output {3,0,2,1} -> row-major); wrapping the
+# operand in jnp.transpose(y, (1,2,0,3)) — the logical permutation whose
+# row-major layout equals the conv's physical layout — compiles to a
+# bitcast and the copy disappears. This invalidates the round-3 conclusion
+# that pallas backward kernels necessarily pay per-operand copy taxes.
+# Run: PYTHONPATH=/root/.axon_site:/root/repo python scripts/perf_bitcast_probe.py
