@@ -35,65 +35,107 @@ def _causal_mask(i_blk, j_blk, bq, bk):
     return rows >= cols
 
 
+def _mask_scores(s, i_blk, j_blk, bq, bk, causal, kv_len):
+    """Apply the causal and/or key-padding mask to a [BB, BQ, BK] score
+    block.
+
+    ``kv_len`` (static) marks the real sequence length when the wrapper
+    zero-padded T up to the tile grid (e.g. ViT's 196 -> 256): key columns
+    >= kv_len get NEG_INF so padded keys never receive probability mass —
+    which also zeroes their dk/dv in the backward kernels (p = 0 and
+    ds = 0 for those columns). Padded *query* rows need no mask: they
+    softmax over real keys and their outputs/gradients are sliced off /
+    zero-padded by the wrapper."""
+    if causal:
+        s = jnp.where(_causal_mask(i_blk, j_blk, bq, bk)[None], s, NEG_INF)
+    if kv_len is not None:
+        cols = j_blk * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(cols < kv_len, s, NEG_INF)
+    return s
+
+
+def _batch_block(bh, t, d, bq, bk):
+    """How many (batch, head) pairs one program processes.
+
+    At short sequences each (b, h) slice is only a few microseconds of
+    MXU work, and per-program grid overhead dominates (measured: ViT's
+    [1536, 256, 64] fwd ran 20x off peak with bb=1). Batch the largest
+    power of two that divides bh and keeps the per-program VMEM footprint
+    (inputs double-buffered by the pipeline) comfortably inside the 16 MB
+    scoped limit."""
+    budget = 4 * 1024 * 1024
+    per = (2 * t * d * 2            # k, v (bf16, full seq)
+           + 2 * bq * d * 4         # q (f32) + acc/dq
+           + 2 * bq * d * 2         # o / do
+           + bq * bk * 4)           # score block
+    bb = 1
+    while bb * 2 <= bh and bh % (bb * 2) == 0 and (bb * 2) * per <= budget:
+        bb *= 2
+    return bb
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bk):
-    q = q_ref[0].astype(jnp.float32) * scale                  # [BQ, D]
-    bq, d = q.shape
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bk,
+                kv_len):
+    q = q_ref[...].astype(jnp.float32) * scale                # [BB, BQ, D]
+    bb, bq, d = q.shape
     n_kv = k_ref.shape[1] // bk
     i_blk = pl.program_id(1)
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [BK, D]
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [BQ, BK]
-        if causal:
-            s = jnp.where(_causal_mask(i_blk, j, bq, bk), s, NEG_INF)
+        k = k_ref[:, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [BB, BK, D]
+        v = v_ref[:, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)  # [BB, BQ, BK]
+        s = _mask_scores(s, i_blk, j, bq, bk, causal, kv_len)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        acc = acc * alpha[..., None] + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bb, bq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bb, bq), jnp.float32)
+    acc0 = jnp.zeros((bb, bq, d), jnp.float32)
     # causal: K/V blocks past the diagonal are fully masked — skip them
     # (halves the compute; the loop bound is dynamic, fori_loop lowers to
     # a while loop)
     hi = jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk if causal else n_kv
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
     # lse rides a sublane-padded [BH, 8, T] layout: Mosaic cannot do the
     # dynamic single-row store a flat [BH, T] would need, and a (1, bq)
     # block violates the (8, 128) tiling rule. 8x redundancy on a tiny
     # array buys fully aligned stores.
-    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[None, :], (8, bq))
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None, :], (bb, 8, bq))
 
 
-def _fwd(q, k, v, scale, causal, block, interpret):
+def _fwd(q, k, v, scale, causal, block, interpret, kv_len=None):
     bh, t, d = q.shape
     bq = bk = min(block, t)
-    grid = (bh, t // bq)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bk=bk)
+    bb = _batch_block(bh, t, d, bq, bk)
+    grid = (bh // bb, t // bq)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bk=bk,
+                               kv_len=kv_len)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bb, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bb, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bb, t, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((bb, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bb, 8, bq), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -109,100 +151,101 @@ def _fwd(q, k, v, scale, causal, block, interpret):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, bk):
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)                        # [BQ, D]
-    bq, d = q.shape
+                   *, scale, causal, bk, kv_len):
+    q = q_ref[...].astype(jnp.float32) * scale                # [BB, BQ, D]
+    do = do_ref[...].astype(jnp.float32)
+    bb, bq, d = q.shape
     n_kv = k_ref.shape[1] // bk
     i_blk = pl.program_id(1)
-    lse = lse_ref[0, 0, :]                                    # [BQ]
-    delta = delta_ref[0, 0, :]
+    lse = lse_ref[:, 0, :]                                    # [BB, BQ]
+    delta = delta_ref[:, 0, :]
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        k = k_ref[:, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[:, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_causal_mask(i_blk, j, bq, bk), s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                          # [BQ, BK]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        s = _mask_scores(s, i_blk, j, bq, bk, causal, kv_len)
+        p = jnp.exp(s - lse[..., None])                        # [BB, BQ, BK]
+        dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        ds = p * (dp - delta[..., None])
+        return dq + jax.lax.dot_general(ds, k, (((2,), (1,)), ((0,), (0,))),
                                         preferred_element_type=jnp.float32)
 
     hi = jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk if causal else n_kv
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bb, bq, d), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq):
-    k = k_ref[0].astype(jnp.float32)                          # [BK, D]
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
+                    dk_ref, dv_ref, *, scale, causal, bq, kv_len):
+    k = k_ref[...].astype(jnp.float32)                        # [BB, BK, D]
+    v = v_ref[...].astype(jnp.float32)
+    bb, bk, d = k.shape
     n_q = q_ref.shape[1] // bq
     j_blk = pl.program_id(1)
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq)]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        q = q_ref[:, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
+        do = do_ref[:, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[:, 0, pl.ds(i * bq, bq)]
+        delta = delta_ref[:, 0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_causal_mask(i, j_blk, bq, bk), s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                          # [BQ, BK]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        s = _mask_scores(s, i, j_blk, bq, bk, causal, kv_len)
+        p = jnp.exp(s - lse[..., None])                        # [BB, BQ, BK]
+        dv = dv + jax.lax.dot_general(p, do, (((1,), (1,)), ((0,), (0,))),
                                       preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        ds = p * (dp - delta[..., None])
+        dk = dk + jax.lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk0 = jnp.zeros((bb, bk, d), jnp.float32)
+    dv0 = jnp.zeros((bb, bk, d), jnp.float32)
     # causal: Q blocks strictly above this K/V block's diagonal see none of
     # it — start at the first overlapping Q block
     lo = (j_blk * bk) // bq if causal else 0
     dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
     # q was loaded pre-scaled, so dk = dsᵀ(q·scale) already carries the
     # 1/√d factor — no second multiply here
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block, interpret, residuals, g):
+def _bwd(scale, causal, block, interpret, kv_len, residuals, g):
     q, k, v, o, lse = residuals
     do = g
     bh, t, d = q.shape
     bq = bk = min(block, t)
+    bb = _batch_block(bh, t, d, bq, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, T]
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t))    # match lse layout
 
-    seq_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
-    blk_spec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0))
-    row_blk = pl.BlockSpec((1, 8, bq), lambda b, i: (b, 0, i))
-    row_full = pl.BlockSpec((1, 8, t), lambda b, i: (b, 0, 0))
+    seq_spec = pl.BlockSpec((bb, t, d), lambda b, i: (b, 0, 0))
+    blk_spec = pl.BlockSpec((bb, bq, d), lambda b, i: (b, i, 0))
+    row_blk = pl.BlockSpec((bb, 8, bq), lambda b, i: (b, 0, i))
+    row_full = pl.BlockSpec((bb, 8, t), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bk=bk),
-        grid=(bh, t // bq),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bk=bk,
+                          kv_len=kv_len),
+        grid=(bh // bb, t // bq),
         in_specs=[blk_spec, seq_spec, seq_spec, blk_spec, row_blk, row_blk],
         out_specs=blk_spec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    kv_blk = pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))
+    kv_blk = pl.BlockSpec((bb, bk, d), lambda b, j: (b, j, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq),
-        grid=(bh, t // bk),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq,
+                          kv_len=kv_len),
+        grid=(bh // bb, t // bk),
         in_specs=[seq_spec, kv_blk, kv_blk, seq_spec, row_full, row_full],
         out_specs=[kv_blk, kv_blk],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
@@ -216,14 +259,14 @@ def _bwd(scale, causal, block, interpret, residuals, g):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, block, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block, interpret, kv_len=None):
+    o, _ = _fwd(q, k, v, scale, causal, block, interpret, kv_len)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block, interpret):
-    o, lse = _fwd(q, k, v, scale, causal, block, interpret)
+def _flash_fwd(q, k, v, scale, causal, block, interpret, kv_len=None):
+    o, lse = _fwd(q, k, v, scale, causal, block, interpret, kv_len)
     return o, (q, k, v, o, lse)
 
 
@@ -240,17 +283,28 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
+    if not interpret:
+        # Mosaic tiles lanes in 128s: the lse block (bb, 8, bq) needs
+        # bq % 128 == 0 on real hardware, so sub-128 blocks only exist in
+        # interpret mode (CPU tests exercise multi-block paths cheaply)
+        block = max(block, 128)
     b, t, h, d = q.shape
-    if t % 128 != 0 or t % min(block, t) != 0:
-        # the grid floor-divides (a ragged tail block would be silently
-        # dropped) and Mosaic tiles lanes in 128s, so refuse instead
-        raise ValueError(f"flash_attention needs seq len divisible by 128 "
-                         f"and by the block ({min(block, t)}); got {t}. Pad "
-                         f"the sequence or use reference_attention.")
     scale = 1.0 / (d ** 0.5)
+    # ragged sequences (ViT's 14x14=196 patches) are zero-padded up to the
+    # tile grid; the kernels mask key columns >= kv_len (see _mask_scores)
+    # and the padded query rows are sliced off below, so the result is
+    # exactly the unpadded attention
+    tp = -(-t // 128) * 128
+    bq = min(block, tp)
+    tp = -(-tp // bq) * bq
+    kv_len = t if tp != t else None
 
     def flat(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
+        if kv_len is not None:
+            x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+        return x
 
-    o = _flash(flat(q), flat(k), flat(v), scale, causal, block, interpret)
-    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    o = _flash(flat(q), flat(k), flat(v), scale, causal, block, interpret,
+               kv_len)
+    return o[:, :t].reshape(b, h, t, d).transpose(0, 2, 1, 3)

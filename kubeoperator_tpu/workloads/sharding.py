@@ -146,6 +146,7 @@ def logical_axis_rules(spec: MeshSpec) -> tuple[tuple[str, str | None], ...]:
         ("embed", pick("fsdp")),       # ZeRO-3: shard params along fsdp
         ("mlp", pick("tp")),           # megatron column/row split
         ("heads", pick("tp")),
+        ("qkv_stack", None),           # fused-QKV leading 3 (transformer.py)
         ("kv", None),
         ("seq", pick("sp")),           # ring-attention sequence shards
         ("vocab", pick("tp")),

@@ -23,6 +23,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from kubeoperator_tpu.workloads import ring_attention as ra
 
@@ -57,6 +58,16 @@ class TransformerConfig:
                                 # runs at 1/4 rate, ~18% of fwd FLOPs at 32k
                                 # vocab). Off by default so existing configs
                                 # keep bit-identical logits.
+    remat_policy: str = "dots"  # dots (checkpoint_dots_with_no_batch_dims)
+                                # | dots+attn (also save the attention
+                                #   output, so backward never re-runs the
+                                #   attention kernel — the ViT winner)
+                                # | attn | all (save nothing)
+    fused_qkv: bool = False     # one (3, H, D) projection instead of three
+                                # separate q/k/v matmuls (fewer, larger
+                                # MXU dispatches — wins at small d_model)
+    flash_block: int = 0        # 0 = auto (DEFAULT_BLOCK/128 by seq);
+                                # else the flash kernel block size
 
     @property
     def head_dim(self) -> int:
@@ -92,15 +103,15 @@ class Attention(nn.Module):
 
     def _flash_block(self, seq_len: int) -> int | None:
         """Flash block size for this sequence, or None for the dense path.
-        Derived from the kernel's tuned default with a 128 fallback so an
-        explicit ``attention="flash"`` keeps working at 128-but-not-256-
-        divisible lengths (128 is the Mosaic lane-tile floor; below or off
-        that grid the dense path is the only option)."""
+        Derived from the kernel's tuned default with a 128 fallback; the
+        kernel now zero-pads ragged sequences to the tile grid itself
+        (masked keys, ViT's 196 patches), so an explicit
+        ``attention="flash"`` works at any length — the 128/256 preference
+        here only picks the block size."""
         from kubeoperator_tpu.workloads.flash_attention import DEFAULT_BLOCK
-        block = next((b for b in (DEFAULT_BLOCK, 128)
-                      if seq_len >= b and seq_len % b == 0), None)
-        if block is None:
-            return None
+        block = self.cfg.flash_block or next(
+            (b for b in (DEFAULT_BLOCK, 128)
+             if seq_len >= b and seq_len % b == 0), 128)
         if self.cfg.attention == "flash":
             return block
         # auto: measured crossover on v5e (PERF.md round 3) — flash wins
@@ -116,15 +127,23 @@ class Attention(nn.Module):
     def __call__(self, x, positions):
         cfg = self.cfg
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype)
-        q = dense(features=(cfg.n_heads, cfg.head_dim),
-                  kernel_init=with_parts(nn.initializers.lecun_normal(),
-                                         ("embed", "heads", "kv")), name="q")(x)
-        k = dense(features=(cfg.n_heads, cfg.head_dim),
-                  kernel_init=with_parts(nn.initializers.lecun_normal(),
-                                         ("embed", "heads", "kv")), name="k")(x)
-        v = dense(features=(cfg.n_heads, cfg.head_dim),
-                  kernel_init=with_parts(nn.initializers.lecun_normal(),
-                                         ("embed", "heads", "kv")), name="v")(x)
+        if cfg.fused_qkv:
+            qkv = dense(features=(3, cfg.n_heads, cfg.head_dim),
+                        kernel_init=with_parts(
+                            nn.initializers.lecun_normal(),
+                            ("embed", "qkv_stack", "heads", "kv")),
+                        name="qkv")(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            q = dense(features=(cfg.n_heads, cfg.head_dim),
+                      kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                             ("embed", "heads", "kv")), name="q")(x)
+            k = dense(features=(cfg.n_heads, cfg.head_dim),
+                      kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                             ("embed", "heads", "kv")), name="k")(x)
+            v = dense(features=(cfg.n_heads, cfg.head_dim),
+                      kernel_init=with_parts(nn.initializers.lecun_normal(),
+                                             ("embed", "heads", "kv")), name="v")(x)
         q, k = rope(q, positions), rope(k, positions)
         if cfg.decode:
             # KV cache: static [B, max_seq_len, H, D] buffers + a write
@@ -162,11 +181,21 @@ class Attention(nn.Module):
                 out = ra.sharded_ulysses_attention(self.mesh, q, k, v, causal=cfg.causal)
             else:
                 out = ra.sharded_ring_attention(self.mesh, q, k, v, causal=cfg.causal)
+            out = checkpoint_name(out, "attn_out")
         elif (blk := self._flash_block(q.shape[1])) is not None:
             from kubeoperator_tpu.workloads.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=cfg.causal, block=blk)
+            out = checkpoint_name(
+                flash_attention(q, k, v, causal=cfg.causal, block=blk),
+                "attn_out")
         else:
-            out = ra.reference_attention(q, k, v, causal=cfg.causal)
+            out = checkpoint_name(
+                ra.reference_attention(q, k, v, causal=cfg.causal), "attn_out")
+        # named so remat_policy="dots+attn" can pin it: saving this one
+        # [B,T,H,D] tensor per layer keeps the attention neighborhood out
+        # of the recompute path (PERF.md ViT round 4: +0.9 MFU pt over the
+        # dots policy; an externalized-residual variant that skipped the
+        # fwd replay entirely measured WORSE — prevent_cse=False already
+        # lets XLA share the kernel between fwd and recompute)
         return dense(features=x.shape[-1], axis=(-2, -1),
                      kernel_init=with_parts(nn.initializers.lecun_normal(),
                                             ("heads", "kv", "embed")), name="o")(out)
@@ -217,9 +246,16 @@ def stack_blocks(cfg: TransformerConfig, mesh: Any, name: str = "layers"):
     Used by the decoder LM and the ViT encoder alike."""
     block = Block
     if cfg.remat:
-        block = nn.remat(
-            Block, prevent_cse=False,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+        cp = jax.checkpoint_policies
+        policy = {
+            "dots": cp.checkpoint_dots_with_no_batch_dims,
+            "dots+attn": cp.save_from_both_policies(
+                cp.checkpoint_dots_with_no_batch_dims,
+                cp.save_only_these_names("attn_out")),
+            "attn": cp.save_only_these_names("attn_out"),
+            "all": None,
+        }[cfg.remat_policy]
+        block = nn.remat(Block, prevent_cse=False, policy=policy)
     return nn.scan(
         block, variable_axes={"params": 0, "cache": 0},
         split_rngs={"params": True},

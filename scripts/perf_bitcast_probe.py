@@ -1,4 +1,4 @@
-import glob, gzip, json, shutil
+import glob, gzip, json, re, shutil
 import jax, jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
@@ -17,7 +17,7 @@ REPS = 20
 def bench(name, fn, *args):
     f = jax.jit(fn)
     r = f(*args); jax.tree.map(lambda t: float(jnp.sum(t.astype(jnp.float32))), r)
-    d = f"/tmp/ko_prof_b{abs(hash(name))}"
+    d = "/tmp/ko_prof_" + re.sub(r"[^A-Za-z0-9]+", "_", name)
     shutil.rmtree(d, ignore_errors=True)
     with jax.profiler.trace(d):
         for _ in range(REPS): r = f(*args)
