@@ -74,6 +74,37 @@ class MeshSpec:
                         sp=sequence_parallel)
 
 
+class VirtualSliceDevice:
+    """A real device wearing a synthetic ``slice_index``.
+
+    Multi-slice (DCN) mesh construction is a pure function of device
+    metadata, so it can be exercised on hosts with no multi-slice hardware
+    by dressing real (CPU-mesh) devices in slice indices: the REAL
+    ``mesh_utils.create_hybrid_device_mesh`` then runs — granule grouping,
+    DCN/ICI factoring and all — and ``build_mesh`` unwraps the proxies
+    before constructing the Mesh so jit executes on the real devices.
+    Used by the driver's ``dryrun_multichip`` and the sharding tests."""
+
+    def __init__(self, dev: Any, slice_index: int):
+        self._dev = dev
+        self.slice_index = slice_index
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_dev"], name)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"VirtualSlice({self.slice_index}, {self._dev!r})"
+
+
+def with_virtual_slices(devices: Sequence[Any], n_slices: int) -> list[Any]:
+    """Partition ``devices`` into ``n_slices`` contiguous synthetic slices."""
+    if len(devices) % n_slices:
+        raise ValueError(f"{len(devices)} devices do not split into "
+                         f"{n_slices} equal slices")
+    per = len(devices) // n_slices
+    return [VirtualSliceDevice(d, i // per) for i, d in enumerate(devices)]
+
+
 def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
     """Build a Mesh with axes ordered outer→inner as (dp, fsdp, ep, tp, sp).
 
@@ -119,7 +150,14 @@ def build_mesh(spec: MeshSpec, devices: Sequence[Any] | None = None) -> Mesh:
         else:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:                   # virtual/CPU devices with no topology info
+        if n_slices > 1:
+            # a reshape cannot know which devices share a slice — falling
+            # back here could lay model axes across DCN, the exact layout
+            # bug this function exists to prevent
+            raise
         dev_array = np.asarray(devices).reshape(shape)
+    if dev_array.size and isinstance(dev_array.flat[0], VirtualSliceDevice):
+        dev_array = np.vectorize(lambda d: d._dev)(dev_array)
     return Mesh(dev_array, axis_names=tuple(names))
 
 

@@ -188,23 +188,34 @@ def test_multislice_mesh_guard():
     # a model axis may never span slices, even when divisible
     with pytest.raises(ValueError, match="only a data axis"):
         build_mesh(MeshSpec(tp=6), six)
-    # dp=2 across 2 slices with tp inside each slice is the valid layout;
-    # assert the hybrid construction gets the right ICI/DCN split (the
-    # fake devices would otherwise silently hit the reshape fallback)
-    from unittest import mock
-    from jax.experimental import mesh_utils
-    import numpy as np
 
-    devs = [Dev(i, i // 4) for i in range(8)]           # 2 slices × 4 chips
-    captured = {}
 
-    def fake_hybrid(ici_shape, dcn_shape, devices=None, **kw):
-        captured.update(ici=list(ici_shape), dcn=list(dcn_shape))
-        return np.asarray(devices).reshape([i * d for i, d in
-                                            zip(ici_shape, dcn_shape)])
+def test_hybrid_mesh_real_constructor_and_execution():
+    """The REAL mesh_utils.create_hybrid_device_mesh builds the 2-slice
+    layout (no mock, no reshape fallback — build_mesh raises rather than
+    fall back on multi-slice), the dp axis spans the slices, model axes
+    stay inside each slice, and a collective actually executes over the
+    resulting mesh."""
+    from kubeoperator_tpu.workloads.sharding import (
+        MeshSpec, build_mesh, with_virtual_slices,
+    )
 
-    with mock.patch.object(mesh_utils, "create_hybrid_device_mesh",
-                           side_effect=fake_hybrid):
-        mesh = build_mesh(MeshSpec(dp=2, tp=4), devs)
+    devs = with_virtual_slices(jax.devices()[:8], 2)   # 2 slices x 4 devices
+    mesh = build_mesh(MeshSpec(dp=2, tp=4), devs)
     assert mesh.shape == {"dp": 2, "tp": 4}
-    assert captured == {"ici": [1, 4], "dcn": [2, 1]}   # dp on DCN, tp on ICI
+    # the Mesh carries the real (unwrapped) devices
+    assert all(not hasattr(d, "_dev") for d in mesh.devices.flat)
+    # dp rides DCN: each dp row is exactly one slice; tp never crosses
+    slice_of = {d._dev.id: d.slice_index for d in devs}
+    rows = [{slice_of[d.id] for d in row} for row in mesh.devices]
+    assert rows == [{0}, {1}]
+
+    # and the mesh executes: a tp-psum over sharded data
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(jnp.arange(8.0).reshape(2, 4),
+                       NamedSharding(mesh, P("dp", "tp")))
+
+    total = jax.shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+                      in_specs=P("dp", "tp"), out_specs=P("dp", None))(x)
+    np.testing.assert_allclose(np.asarray(total).ravel(), [6.0, 22.0])
