@@ -109,7 +109,9 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
                 executor=platform.executor,
                 catalog=platform.catalog,
                 config=platform.config,
-                vars={**cluster.configs, **execution.params.get("vars", {})},
+                vars={**cluster.configs,
+                      **execution.params.get("upgrade_vars", {}),
+                      **execution.params.get("vars", {})},
                 step=step_def,
                 provider=platform.provider_for(cluster),
                 params=execution.params,
@@ -144,6 +146,16 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
         cluster.status = DONE_STATUS.get(execution.operation, ClusterStatus.RUNNING)
         if execution.operation in ("scale", "add-worker"):
             _exit_new_node(store, cluster)
+        if execution.operation == "upgrade" and execution.params.get("upgrade_package"):
+            # the package switch commits only now: a failed upgrade must
+            # never record a version the nodes don't actually run. None
+            # overlay values mean "the new package doesn't supply this" —
+            # drop the stale key instead of storing the None.
+            merged = {**cluster.configs,
+                      **execution.params.get("upgrade_vars", {}),
+                      **execution.params.get("vars", {})}
+            cluster.configs = {k: v for k, v in merged.items() if v is not None}
+            cluster.package = execution.params["upgrade_package"]
     store.save(execution)
     store.save(cluster)
     platform.notify(

@@ -9,12 +9,10 @@ from kubeoperator_tpu.engine.steps import k8s
 
 
 def run(ctx: StepContext):
-    repo = k8s.repo_url(ctx)
     # serial, not fan-out: an etcd quorum survives one member restarting
     for th in ctx.targets():
         o = ctx.ops(th)
         for b in ("etcd", "etcdctl"):
-            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
-                 timeout=600)
+            k8s.refresh_binary(o, ctx, b)
         o.sh("systemctl restart etcd")
         o.sh(f"{k8s.BIN}/etcdctl {k8s.etcd_flags(ctx)} endpoint health", timeout=60)

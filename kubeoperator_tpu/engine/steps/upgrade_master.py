@@ -9,12 +9,10 @@ BINARIES = ("kube-apiserver", "kube-controller-manager", "kube-scheduler", "kube
 
 
 def run(ctx: StepContext):
-    repo = k8s.repo_url(ctx)
     for th in ctx.targets():   # serial: keep the HA plane up
         o = ctx.ops(th)
         for b in BINARIES:
-            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
-                 timeout=600)
+            k8s.refresh_binary(o, ctx, b)
         for unit in ("kube-apiserver", "kube-controller-manager", "kube-scheduler"):
             o.sh(f"systemctl restart {unit}")
         o.sh("curl -sk --max-time 30 --retry 10 --retry-delay 3 --retry-connrefused "

@@ -9,7 +9,6 @@ from kubeoperator_tpu.engine.steps import k8s
 
 
 def run(ctx: StepContext):
-    repo = k8s.repo_url(ctx)
     masters = ctx.inventory.masters()
     mo = ctx.ops(masters[0]) if masters else None
 
@@ -18,8 +17,7 @@ def run(ctx: StepContext):
             mo.sh(f"{k8s.KUBECTL} cordon {th.name}", check=False)
         o = ctx.ops(th)
         for b in ("kubelet", "kube-proxy"):
-            o.sh(f"curl -fsSL -o {k8s.BIN}/{b} {repo}/{b} && chmod 0755 {k8s.BIN}/{b}",
-                 timeout=600)
+            k8s.refresh_binary(o, ctx, b)
         o.sh("systemctl restart kubelet && systemctl restart kube-proxy")
         if mo:
             mo.sh(f"{k8s.KUBECTL} uncordon {th.name}", check=False)

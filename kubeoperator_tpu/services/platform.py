@@ -111,6 +111,98 @@ class Platform:
         self.store.save(host)
         return host
 
+    def _aggregate_images(self, pkg: Package) -> list[dict]:
+        """Offline image tarballs the load-images step imports into
+        containerd on every node (engine/steps/load_images.py).
+        Aggregated from the chosen package plus every *content* package
+        (``kind: content`` in meta.yml — ko-system, ko-workloads), each
+        entry tagged with its source package so the step pulls from the
+        right /repo/<package>/ path. Other k8s packages (a second version
+        registered side by side) are NOT swept in. First match per ref
+        wins, chosen package first."""
+        images: list[dict] = []
+        seen_refs: set[str] = set()
+        content = sorted(
+            (p for p in self.store.find(Package, scoped=False)
+             if p.name != pkg.name and p.meta.get("kind") == "content"),
+            key=lambda p: p.name)
+        for p in [pkg, *content]:
+            for img in p.meta.get("images") or []:
+                if img.get("ref") in seen_refs:
+                    continue
+                seen_refs.add(img.get("ref"))
+                images.append({**img, "package": p.name})
+        return images
+
+    def _apply_package_configs(self, pkg: Package, merged: dict,
+                               configs: dict | None) -> None:
+        """Point ``merged`` cluster configs at ``pkg``: version vars, the
+        binary checksums map, the aggregated offline image list, and the
+        controller-served repo URLs (cluster creation path)."""
+        from kubeoperator_tpu.services import packages as packages_svc
+
+        merged.update(pkg.meta.get("vars", {}))
+        if pkg.meta.get("checksums"):
+            merged["repo_checksums"] = pkg.meta["checksums"]
+        images = self._aggregate_images(pkg)
+        if images:
+            merged["repo_images"] = images
+        # nodes pull binaries from the controller-served package repo
+        # (nexus-lite; reference package_manage.py:31-53). repo_base is
+        # needed even when configs override repo_url — cross-package
+        # image entries resolve against it.
+        try:
+            repo_base = packages_svc.repo_base_url(self)
+        except ValueError as e:
+            repo_base = None
+            if "repo_url" not in (configs or {}):
+                raise PlatformError(str(e)) from e
+        if repo_base:
+            merged["repo_base"] = repo_base
+            merged["repo_url"] = f"{repo_base}/{pkg.name}"
+
+    def _upgrade_overlay(self, cluster: Cluster, pkg: Package) -> dict:
+        """Config overlay that points an UPGRADE at ``pkg`` — carried in
+        the execution's params (steps see it via ctx.vars) and merged into
+        the cluster record only when the upgrade SUCCEEDS, so a failed or
+        aborted upgrade never records a version the nodes don't run.
+
+        Keys the new package doesn't supply are set to None: stale
+        checksums, image lists or old-package version vars must not leak
+        across the switch (verifying v2 binaries against v1 hashes fails
+        every refresh). A user-managed repo_url (one not shaped like this
+        controller's /repo/<old-package>) is preserved — the operator owns
+        that mirror's content; the new checksums still verify what nodes
+        download from it."""
+        from kubeoperator_tpu.services import packages as packages_svc
+
+        overlay: dict[str, Any] = dict(pkg.meta.get("vars", {}))
+        old_pkg = (self.store.get_by_name(Package, cluster.package,
+                                          scoped=False)
+                   if cluster.package else None)
+        if old_pkg:
+            for key in old_pkg.meta.get("vars", {}):
+                overlay.setdefault(key, None)     # dropped by the new pkg
+        overlay["repo_checksums"] = pkg.meta.get("checksums") or None
+        overlay["repo_images"] = self._aggregate_images(pkg) or None
+        try:
+            repo_base = packages_svc.repo_base_url(self)
+        except ValueError as e:
+            if "repo_url" not in cluster.configs:
+                raise PlatformError(str(e)) from e
+            repo_base = None
+        if repo_base:
+            # path-suffix match, not exact equality: KO_REPO_HOST /
+            # bind_port may have changed since cluster creation, and a
+            # drifted controller URL is still ours to re-point
+            cur = cluster.configs.get("repo_url")
+            controller_derived = cur is None or (
+                cluster.package and cur.endswith(f"/repo/{cluster.package}"))
+            if controller_derived:
+                overlay["repo_url"] = f"{repo_base}/{pkg.name}"
+            overlay["repo_base"] = repo_base
+        return overlay
+
     # -- clusters ----------------------------------------------------------
     def create_cluster(self, name: str, template: str = "SINGLE",
                        deploy_type: str = DeployType.MANUAL,
@@ -128,46 +220,7 @@ class Platform:
         merged: dict[str, Any] = {}
         pkg = self.store.get_by_name(Package, package, scoped=False) if package else None
         if pkg:
-            from kubeoperator_tpu.services import packages as packages_svc
-
-            merged.update(pkg.meta.get("vars", {}))
-            if pkg.meta.get("checksums"):
-                merged.setdefault("repo_checksums", pkg.meta["checksums"])
-            # Offline image tarballs the load-images step imports into
-            # containerd on every node (engine/steps/load_images.py).
-            # Aggregated from the chosen package plus every *content*
-            # package (``kind: content`` in meta.yml — ko-system,
-            # ko-workloads), each entry tagged with its source package so
-            # the step pulls from the right /repo/<package>/ path. Other
-            # k8s packages (a second version registered side by side) are
-            # NOT swept in. First match per ref wins, chosen package first.
-            images: list[dict] = []
-            seen_refs: set[str] = set()
-            content = sorted(
-                (p for p in self.store.find(Package, scoped=False)
-                 if p.name != pkg.name and p.meta.get("kind") == "content"),
-                key=lambda p: p.name)
-            for p in [pkg, *content]:
-                for img in p.meta.get("images") or []:
-                    if img.get("ref") in seen_refs:
-                        continue
-                    seen_refs.add(img.get("ref"))
-                    images.append({**img, "package": p.name})
-            if images:
-                merged.setdefault("repo_images", images)
-            # nodes pull binaries from the controller-served package repo
-            # (nexus-lite; reference package_manage.py:31-53). repo_base is
-            # needed even when configs override repo_url — cross-package
-            # image entries resolve against it.
-            try:
-                repo_base = packages_svc.repo_base_url(self)
-            except ValueError as e:
-                repo_base = None
-                if "repo_url" not in (configs or {}):
-                    raise PlatformError(str(e)) from e
-            if repo_base:
-                merged["repo_base"] = repo_base
-                merged["repo_url"] = f"{repo_base}/{pkg.name}"
+            self._apply_package_configs(pkg, merged, configs)
         merged.update(configs or {})
         item_obj = None
         if item:
@@ -239,6 +292,28 @@ class Platform:
         if cluster is None:
             raise PlatformError(f"no cluster {cluster_name!r}")
         self.catalog.operation_steps(operation)   # validate early
+
+        if operation == "upgrade":
+            # the version lever: upgrade targets a package (reference
+            # deploy.py:66-83 dispatches with the chosen version). Without
+            # params.package the cluster's current package is re-resolved —
+            # same bits, but checksums/vars refresh if its meta changed.
+            params = dict(params or {})
+            target = params.get("package") or cluster.package
+            if not target:
+                raise PlatformError(
+                    "upgrade needs a target package: the cluster was "
+                    "created without one — pass params={'package': <name>}")
+            pkg = self.store.get_by_name(Package, target, scoped=False)
+            if pkg is None:
+                raise PlatformError(f"upgrade package {target!r} not found")
+            # steps see the new package through the upgrade_vars overlay
+            # (kept separate from user vars so a RETRY recomputes it fresh
+            # from possibly-fixed package metadata instead of replaying the
+            # failed run's stale copy); the cluster record flips only on
+            # SUCCESS (operations.py)
+            params["upgrade_package"] = pkg.name
+            params["upgrade_vars"] = self._upgrade_overlay(cluster, pkg)
 
         # preflight: IP availability for growing AUTOMATIC clusters
         # (reference api.py:234-241)
