@@ -323,17 +323,39 @@ def cmd_serve(args: argparse.Namespace) -> int:
             jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32))["params"]
         emit({"job": "serve", "weights": "fresh-init (no checkpoint)"})
 
-    # one compiled decode per (prompt_len, max_tokens, temperature) shape —
-    # generate() rebuilds its scan closure per call, which would re-trace on
-    # every request on the serving hot path
-    @functools.lru_cache(maxsize=16)
-    def decode_fn(prompt_len: int, max_new: int, temp: float):
-        return jax.jit(lambda params, prompt, rng: generate(
-            cfg, params, prompt, max_new, temperature=temp, rng=rng))
+    # one compiled decode per (batch, prompt_len, max_tokens, temperature,
+    # prefill) bucket — generate() rebuilds its scan closure per call,
+    # which would re-trace on every request on the serving hot path. The
+    # batcher rounds every dimension to powers of two, so the cache stays
+    # small.
+    @functools.lru_cache(maxsize=32)
+    def decode_fn(batch: int, prompt_len: int, max_new: int, temp: float,
+                  prefill: int):
+        return jax.jit(lambda params, prompt, lens, rng: generate(
+            cfg, params, prompt, max_new, temperature=temp, rng=rng,
+            prompt_lens=lens, prefill_len=prefill))
 
     tpu_lock = threading.Lock()   # one generation at a time on the chip
-    decode_fn(4, 4, 0.0)(model_params, jnp.zeros((1, 4), jnp.int32),
-                         jax.random.key(0))   # warm trace+compile
+
+    from kubeoperator_tpu.workloads.serving import DynamicBatcher, _pow2_at_least
+
+    def run_batch(prompts, lens, max_new, temp, prefill, seed):
+        b = _pow2_at_least(len(prompts))
+        # pad the batch dim to its bucket with duplicate rows (cheap; the
+        # batcher never reads them)
+        rows = prompts + [prompts[0]] * (b - len(prompts))
+        row_lens = lens + [lens[0]] * (b - len(lens))
+        with tpu_lock:
+            return decode_fn(b, len(prompts[0]), max_new, temp, prefill)(
+                model_params, jnp.asarray(rows, jnp.int32),
+                jnp.asarray(row_lens, jnp.int32), jax.random.key(seed))
+
+    batcher = DynamicBatcher(run_batch, max_batch=args.max_batch,
+                             window_ms=args.batch_window_ms,
+                             max_seq_len=cfg.max_seq_len)
+    decode_fn(1, 8, 4, 0.0, 8)(model_params, jnp.zeros((1, 8), jnp.int32),
+                               jnp.full((1,), 8, jnp.int32),
+                               jax.random.key(0))   # warm trace+compile
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):  # noqa: N802 — quiet access log
@@ -361,19 +383,23 @@ def cmd_serve(args: argparse.Namespace) -> int:
             try:
                 req = _json.loads(self.rfile.read(
                     int(self.headers.get("Content-Length", 0))))
-                prompt = jnp.asarray([req["prompt_ids"]], jnp.int32)
+                prompt_ids = list(req["prompt_ids"])
                 max_new = int(req.get("max_tokens", 16))
                 temp = float(req.get("temperature", 0.0))
-                if prompt.shape[1] < 1:
-                    raise ValueError("prompt_ids must be non-empty")
-                with tpu_lock:
-                    out = decode_fn(prompt.shape[1], max_new, temp)(
-                        model_params, prompt,
-                        jax.random.key(int(req.get("seed", 0))))
-                self._json(200, {"tokens": out[0].tolist(),
-                                 "new_tokens": out[0, prompt.shape[1]:].tolist()})
+                # concurrent requests fuse into one padded batch on the
+                # chip (workloads/serving.py); this thread blocks until
+                # its row is ready
+                tokens = batcher.submit(prompt_ids, max_new,
+                                        temperature=temp,
+                                        seed=int(req.get("seed", 0)))
+                self._json(200, {"tokens": tokens,
+                                 "new_tokens": tokens[len(prompt_ids):]})
             except (KeyError, ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
+            except TimeoutError as e:
+                self._json(503, {"error": f"generation timed out: {e}"})
+            except Exception as e:  # noqa: BLE001 — worker errors -> JSON
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
     # threading server: /healthz (the chart's readinessProbe) must answer
     # while a long /generate holds the TPU lock — a single-threaded server
@@ -501,6 +527,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--bf16", action="store_true", default=True)
     sv.add_argument("--no-bf16", dest="bf16", action="store_false")
+    sv.add_argument("--max-batch", type=int, default=32,
+                    help="dynamic batcher: max fused requests per step")
+    sv.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="dynamic batcher: wait after first request")
 
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
     lm.add_argument("--steps", type=int, default=100)

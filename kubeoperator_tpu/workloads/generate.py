@@ -5,10 +5,16 @@ training framework needs one for eval/demo serving).
 TPU-first: the cache is a static [B, max_seq_len, H, D] buffer per layer
 (stacked on the scan's layer axis), the decode loop is a ``lax.scan`` over
 token positions (one compiled step, no per-token dispatch), and sampling
-is temperature/greedy over f32 logits. Prefill processes the prompt one
-token at a time inside the same scan — simple and shape-static; a
-chunked-prefill variant is a future optimization, not a correctness
-change.
+is temperature/greedy over f32 logits.
+
+Prefill/decode split (round 4): the prompt's shared prefix is processed
+in ONE chunked forward pass (``prefill_len`` tokens — an MXU-friendly
+[B, C] matmul shape that also fills the KV cache, transformer.py decode
+branch), and only the remaining positions run the token-at-a-time scan.
+Per-row ``prompt_lens`` let one batch mix prompts of different lengths
+(right-padded): each row keeps its own prompt tokens until its prompt
+ends, then generates — the mechanism the serving batcher
+(train/jobs.py cmd_serve) uses to fuse concurrent requests.
 """
 
 from __future__ import annotations
@@ -24,20 +30,32 @@ from kubeoperator_tpu.workloads.transformer import Transformer, TransformerConfi
 
 def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
              max_new_tokens: int, temperature: float = 0.0,
-             rng: jax.Array | None = None, mesh: Any = None) -> jnp.ndarray:
+             rng: jax.Array | None = None, mesh: Any = None,
+             prompt_lens: jnp.ndarray | None = None,
+             prefill_len: int | None = None) -> jnp.ndarray:
     """Greedy (temperature=0) or temperature sampling.
 
-    prompt: [B, P] int32 (P >= 1). Returns [B, P + max_new_tokens] int32.
-    Total length must fit cfg.max_seq_len.
+    prompt: [B, P] int32 (P >= 1), right-padded when rows differ;
+    prompt_lens: [B] true lengths (defaults to all P). prefill_len: static
+    chunk size processed in one forward pass — must not exceed the
+    shortest prompt (those positions must all be given tokens); defaults
+    to P when prompts are uniform, else 1. Returns [B, P + max_new_tokens]
+    int32.
     """
     b, p = prompt.shape
     total = p + max_new_tokens
     if total > cfg.max_seq_len:
         raise ValueError(f"prompt ({p}) + new tokens ({max_new_tokens}) "
                          f"exceed max_seq_len ({cfg.max_seq_len})")
+    if prefill_len is None:
+        prefill_len = p if prompt_lens is None else 1
+    if not 1 <= prefill_len <= p:
+        raise ValueError(f"prefill_len {prefill_len} outside [1, {p}]")
     decode_cfg = replace(cfg, decode=True, remat=False)
     model = Transformer(decode_cfg, mesh=mesh)
     rng = rng if rng is not None else jax.random.key(0)
+    p_vec = (prompt_lens.astype(jnp.int32) if prompt_lens is not None
+             else jnp.full((b,), p, jnp.int32))
 
     # zero caches from shapes only — a real init would materialize (and
     # immediately discard) a full second parameter set
@@ -49,6 +67,35 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
     buf = jnp.zeros((b, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
+    def choose(logits, pos, buf, rng):
+        """Select the token for position pos+1 from position pos's logits —
+        the given prompt token while pos+1 is still inside a row's prompt,
+        the model's choice after."""
+        rng, sub = jax.random.split(rng)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        keep_prompt = pos + 1 < p_vec                           # [B]
+        given = jax.lax.dynamic_slice(
+            buf, (0, jnp.minimum(pos + 1, total - 1)), (b, 1))[:, 0]
+        chosen = jnp.where(keep_prompt, given, nxt.astype(jnp.int32))
+        buf = jax.lax.dynamic_update_slice(
+            buf, chosen[:, None], (0, jnp.minimum(pos + 1, total - 1)))
+        return buf, rng
+
+    # -- prefill: the shared prefix in one chunked pass --------------------
+    start = prefill_len - 1
+    if prefill_len > 1:
+        chunk = jax.lax.dynamic_slice(buf, (0, 0), (b, prefill_len))
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, chunk,
+            jnp.arange(prefill_len, dtype=jnp.int32), mutable=["cache"])
+        cache = mutated["cache"]
+        buf, rng = choose(logits[:, -1, :], jnp.int32(start), buf, rng)
+        start += 1
+
+    # -- decode: one token per scan step -----------------------------------
     def step(carry, pos):
         buf, cache, rng = carry
         token = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
@@ -56,21 +103,11 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
             {"params": params, "cache": cache}, token,
             jnp.full((1,), pos, jnp.int32), mutable=["cache"])
         cache = mutated["cache"]
-        logits = logits[:, 0, :]                       # [B, V] f32
-        rng, sub = jax.random.split(rng)
-        if temperature > 0:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        # within the prompt, the "next" token is the given one, not ours
-        keep_prompt = pos + 1 < p
-        given = jax.lax.dynamic_slice(
-            buf, (0, jnp.minimum(pos + 1, total - 1)), (b, 1))[:, 0]
-        chosen = jnp.where(keep_prompt, given, nxt.astype(jnp.int32))
-        buf = jax.lax.dynamic_update_slice(
-            buf, chosen[:, None], (0, jnp.minimum(pos + 1, total - 1)))
+        buf, rng = choose(logits[:, 0, :], pos, buf, rng)
         return (buf, cache, rng), None
 
-    (buf, _, _), _ = jax.lax.scan(step, (buf, cache, rng),
-                                  jnp.arange(total - 1))
+    if start < total - 1:
+        (buf, _, _), _ = jax.lax.scan(
+            step, (buf, cache, rng),
+            jnp.arange(start, total - 1, dtype=jnp.int32))
     return buf

@@ -1,0 +1,171 @@
+"""Dynamic request batching for the token-generation endpoint.
+
+The decode step is launch-latency-bound at small batches (PERF.md: one
+lax.scan dispatch per token through the relay), so aggregate throughput
+scales almost linearly with batch size until HBM bandwidth saturates.
+Concurrent ``/generate`` requests therefore queue here; a single worker
+drains up to ``max_batch`` of them (waiting ``window_ms`` after the first
+arrival for company), right-pads prompts into one batch, and runs ONE
+batched generation with per-row prompt lengths (``generate.py``). Each
+reply slices its own row — batching changes throughput, never tokens
+(tests/test_serving.py proves token-equality with solo runs).
+
+Static shapes: batch, padded prompt length and new-token count are
+rounded up to powers of two, and the prefill chunk down to one, so the
+number of distinct compiles stays logarithmic in every dimension.
+Requests with different temperatures never fuse (temperature selects the
+sampling branch at trace time); per-request seeds are honoured only for
+batches of one — sampled batches draw from one folded stream, which is
+the standard dynamic-batching trade.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+
+def _pow2_at_least(n: int, floor: int = 1) -> int:
+    v = max(floor, 1)
+    while v < n:
+        v *= 2
+    return v
+
+
+def _pow2_at_most(n: int) -> int:
+    v = 1
+    while v * 2 <= n:
+        v *= 2
+    return v
+
+
+@dataclass
+class _Pending:
+    prompt_ids: list[int]
+    max_tokens: int
+    temperature: float
+    seed: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: list[int] | None = None
+    error: Exception | None = None
+
+
+class DynamicBatcher:
+    """``submit`` blocks until the worker has generated this request's
+    tokens (possibly fused with others).
+
+    ``run_fn(prompts, prompt_lens, max_new, temperature, prefill_len,
+    seed)`` executes one batched generation: prompts is a right-padded
+    int32 [B, P] list-of-lists, prompt_lens the true lengths, max_new /
+    prefill_len static ints, and it returns a [B, P + max_new] token
+    array (row i's reply = result[i][:len_i + want_i]).
+    """
+
+    def __init__(self, run_fn: Callable[..., Any], *, max_batch: int = 32,
+                 window_ms: float = 5.0, max_seq_len: int = 2048):
+        self.run_fn = run_fn
+        self.max_batch = max_batch
+        self.window_s = window_ms / 1000.0
+        self.max_seq_len = max_seq_len
+        self._q: queue.Queue[_Pending] = queue.Queue()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="ko-serve-batcher")
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               timeout: float | None = 300.0) -> list[int]:
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        if len(prompt_ids) + max_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_tokens ({max_tokens}) "
+                f"exceed max_seq_len ({self.max_seq_len})")
+        req = _Pending(list(prompt_ids), int(max_tokens), float(temperature),
+                       int(seed))
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- worker side -------------------------------------------------------
+    def _drain(self) -> list[_Pending]:
+        """One request, then whatever arrives within the window."""
+        batch = [self._q.get()]
+        import time
+
+        deadline = time.monotonic() + self.window_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._drain()
+            # temperature selects the sampling branch at trace time —
+            # split the drain into same-temperature groups
+            groups: dict[float, list[_Pending]] = {}
+            for r in batch:
+                groups.setdefault(r.temperature, []).append(r)
+            for temp, group in groups.items():
+                self._run_group(temp, group)
+
+    def _run_group(self, temp: float, group: list[_Pending]) -> None:
+        """Split a same-temperature drain into subgroups whose combined
+        shape fits: max(prompt) + max(new) <= max_seq_len must hold per
+        EXECUTED batch (submit validates each request alone, but a long
+        prompt and a long generation from different requests can't
+        co-batch)."""
+        sub: list[_Pending] = []
+        p_need = n_need = 0
+        for r in group:
+            p2, n2 = max(p_need, len(r.prompt_ids)), max(n_need, r.max_tokens)
+            if sub and p2 + n2 > self.max_seq_len:
+                self._execute(temp, sub)
+                sub, p2, n2 = [], len(r.prompt_ids), r.max_tokens
+            sub.append(r)
+            p_need, n_need = p2, n2
+        if sub:
+            self._execute(temp, sub)
+
+    def _execute(self, temp: float, group: list[_Pending]) -> None:
+        try:
+            lens = [len(r.prompt_ids) for r in group]
+            p_bucket = _pow2_at_least(max(lens), 8)
+            new_bucket = _pow2_at_least(max(r.max_tokens for r in group))
+            if p_bucket + new_bucket > self.max_seq_len:
+                # shed padding before shedding fusion: exact sizes always
+                # fit (the _run_group split guarantees it)
+                p_bucket = _pow2_at_least(max(lens), 1)
+            if p_bucket + new_bucket > self.max_seq_len:
+                new_bucket = max(r.max_tokens for r in group)
+            if p_bucket + new_bucket > self.max_seq_len:
+                p_bucket = max(lens)
+            prefill = _pow2_at_most(min(lens))
+            prompts = [list(r.prompt_ids) + [0] * (p_bucket - n)
+                       for r, n in zip(group, lens)]
+            seed = group[0].seed if len(group) == 1 else hash(
+                tuple(r.seed for r in group)) & 0x7FFFFFFF
+            out = self.run_fn(prompts, lens, new_bucket, temp, prefill, seed)
+            for i, (r, n) in enumerate(zip(group, lens)):
+                row = list(map(int, out[i]))
+                # rows are contiguous: generate() overwrites a short row's
+                # pad positions with its own continuation as the scan
+                # passes them (keep_prompt is per-row)
+                r.result = row[:n + r.max_tokens]
+                r.done.set()
+        except Exception as e:  # noqa: BLE001 — request boundary
+            for r in group:
+                r.error = e
+                r.done.set()

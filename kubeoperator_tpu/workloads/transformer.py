@@ -148,19 +148,17 @@ class Attention(nn.Module):
         if cfg.decode:
             # KV cache: static [B, max_seq_len, H, D] buffers + a write
             # index — the TPU-idiomatic decode (no dynamic shapes; the
-            # causal structure becomes a position mask against the index).
-            # Single-token steps only: the mask below is per-index, not
-            # per-query, so a multi-token chunk would silently mis-mask
-            if x.shape[1] != 1:
-                raise ValueError(
-                    f"decode mode processes one token per step, got T={x.shape[1]}")
+            # causal structure becomes a per-query position mask against
+            # the cache). T=1 is the token-by-token decode step; T>1 is a
+            # chunked PREFILL (the whole prompt in one MXU-friendly pass,
+            # writing its K/V into the cache — generate.py's prefill phase).
             cache_k = self.variable("cache", "cached_k", jnp.zeros,
                                     (x.shape[0], cfg.max_seq_len,
                                      cfg.n_heads, cfg.head_dim), cfg.dtype)
             cache_v = self.variable("cache", "cached_v", jnp.zeros,
                                     (x.shape[0], cfg.max_seq_len,
                                      cfg.n_heads, cfg.head_dim), cfg.dtype)
-            idx = positions[0]                     # scalar write position
+            idx = positions[0]                     # chunk start position
             cache_k.value = jax.lax.dynamic_update_slice(
                 cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
             cache_v.value = jax.lax.dynamic_update_slice(
@@ -168,7 +166,10 @@ class Attention(nn.Module):
             scale = 1.0 / (cfg.head_dim ** 0.5)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k.value,
                                 preferred_element_type=jnp.float32) * scale
-            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= idx
+            # query at global position `positions[i]` sees cache slots <=
+            # that position — causal within the chunk, full history before
+            mask = (jnp.arange(cfg.max_seq_len)[None, None, None, :]
+                    <= positions[None, None, :, None])
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype),
