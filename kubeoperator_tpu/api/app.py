@@ -413,6 +413,55 @@ async def provider_import(request: web.Request) -> web.Response:
     return web.json_response(result, status=201)
 
 
+async def vsphere_upload_image(request: web.Request) -> web.Response:
+    """Bootstrap a bare vCenter: push an OVA/OVF from the controller's
+    offline package store into a content library (reference NFC upload,
+    ``clients/vsphere.py:84-131``; here content-library update sessions).
+    Body: {host, username, password, library, datastore, item_name,
+    package, file, [verify]} — the template bytes come from
+    ``/repo/<package>/<file>``, so the air-gapped controller is the only
+    source of truth."""
+    require_admin(request)
+    from kubeoperator_tpu.providers import discovery as disc
+    from kubeoperator_tpu.services import packages as packages_svc
+
+    platform: Platform = request.app["platform"]
+    body = await request.json()
+    # header/URL-bound values must be stripped: a pasted trailing newline
+    # would blow up urllib's header validation as a 500 (same discipline
+    # as discovery.discover)
+    body = {k: v.strip() if isinstance(v, str) else v
+            for k, v in body.items()}
+    try:
+        path = packages_svc.resolve_file(platform, body["package"],
+                                         body["file"])
+    except KeyError as e:
+        return json_error(400, f"missing parameter {e}")
+    except (FileNotFoundError, PermissionError) as e:
+        return json_error(404, f"package file not found: {e}")
+
+    def run():
+        import os
+
+        imp = disc.VSphereImageImport(
+            body["host"], body["username"], body["password"],
+            transport=request.app.get("discovery_transport")
+            or disc.make_transport(bool(body.get("verify", True))))
+        with open(path, "rb") as f:    # streamed, not read into RAM
+            return imp.import_template(
+                body.get("library", "kubeoperator"), body["datastore"],
+                body["item_name"], body["file"].rsplit("/", 1)[-1], f,
+                size=os.path.getsize(path))
+
+    try:
+        result = await _sync(request, run)
+    except disc.DiscoveryError as e:
+        return json_error(400, str(e))
+    except KeyError as e:
+        return json_error(400, f"missing parameter {e}")
+    return web.json_response(result, status=201)
+
+
 async def list_cluster_apps(request: web.Request) -> web.Response:
     """App-store state for one cluster: installable charts, what's
     installed (with its vars), and the TPU slice picker choices (reference:
@@ -970,6 +1019,7 @@ def create_app(platform: Platform) -> web.Application:
     register_crud(app, "/api/v1/credentials", Credential, create=_create_credential)
     r.add_post("/api/v1/providers/{provider}/discover", provider_discover)
     r.add_post("/api/v1/providers/{provider}/import", provider_import)
+    r.add_post("/api/v1/providers/vsphere/images", vsphere_upload_image)
     register_crud(app, "/api/v1/regions", Region)
     register_crud(app, "/api/v1/zones", Zone)
     register_crud(app, "/api/v1/plans", Plan)

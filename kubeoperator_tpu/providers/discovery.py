@@ -8,10 +8,10 @@ providers' plain REST APIs (vSphere Automation API, Keystone/Nova/Neutron)
 with the same injectable-transport seam the monitor uses
 (``services/monitor.py``) so tests replay canned responses with zero
 infrastructure. The reference's template image upload (NFC lease,
-``clients/vsphere.py:84-131``) is intentionally NOT mirrored: in this
-stack images are delivered by the offline-package flow
-(``engine/steps/load_images.py``) and cloud templates are referenced by
-name in Region vars.
+``clients/vsphere.py:84-131``) is mirrored as ``VSphereImageImport`` —
+content-library update sessions over the same REST seam, fed from the
+controller's offline package store — so a bare vCenter can be
+bootstrapped without any pre-seeded template.
 """
 
 from __future__ import annotations
@@ -129,6 +129,94 @@ class VSphereDiscovery:
                             "vars": {"datacenter": dc["name"]},
                             "zones": zones})
         return {"provider": "vsphere", "regions": regions}
+
+
+class VSphereImageImport(VSphereDiscovery):
+    """Template image import into a vCenter content library.
+
+    The reference bootstraps a bare vCenter by pushing its OVF/VMDK over
+    an NFC lease (``clients/vsphere.py:84-131``, pyVmomi SOAP with a
+    keepalive thread). The Automation API replaced that dance with
+    content-library update sessions: create (or find) a library backed by
+    a datastore, create an item, open an update session, PUT the bytes to
+    the session's upload endpoint, complete. Same injectable transport as
+    discovery, so tests replay the whole flow canned. The AUTOMATIC
+    provisioning path then references the imported item by name in Region
+    vars (``template``)."""
+
+    def _post(self, path: str, payload: dict | None = None) -> Any:
+        status, body, _ = self.transport(
+            "POST", f"{self.base}{path}",
+            {"vmware-api-session-id": self._login(),
+             "Content-Type": "application/json"},
+            json.dumps(payload).encode() if payload is not None else None,
+            self.timeout)
+        if status not in (200, 201):
+            raise DiscoveryError(f"POST {path} failed ({status})", status)
+        return json.loads(body).get("value") if body else None
+
+    def resolve_datastore(self, datastore: str) -> str:
+        """Accept either a datastore NAME (what discover() shows the
+        operator) or a moref id; names resolve through the datastore
+        listing, unknown values pass through as ids."""
+        for d in self._get("/rest/vcenter/datastore"):
+            if d.get("name") == datastore:
+                return d["datastore"]
+        return datastore
+
+    def ensure_library(self, name: str, datastore: str) -> str:
+        """Find the local content library called ``name``, creating it on
+        ``datastore`` (name or id) if absent. Returns the library id."""
+        for lib_id in self._get("/rest/com/vmware/content/library"):
+            lib = self._get(f"/rest/com/vmware/content/library/id:{lib_id}")
+            if lib.get("name") == name:
+                return lib_id
+        return self._post("/rest/com/vmware/content/local-library", {
+            "create_spec": {
+                "name": name,
+                "type": "LOCAL",
+                "storage_backings": [{
+                    "type": "DATASTORE",
+                    "datastore_id": self.resolve_datastore(datastore)}],
+            }})
+
+    def upload_template(self, library_id: str, item_name: str,
+                        filename: str, data: Any, size: int | None = None) -> str:
+        """Push one OVA/OVF file as a library item; returns the item id.
+        ``data`` may be bytes or a binary file object (streamed — multi-GB
+        templates must not be held in controller RAM); ``size`` is
+        required for file objects."""
+        if size is None:
+            size = len(data)
+        item_id = self._post("/rest/com/vmware/content/library/item", {
+            "create_spec": {"library_id": library_id, "name": item_name,
+                            "type": "ovf"}})
+        session = self._post(
+            "/rest/com/vmware/content/library/item/update-session",
+            {"create_spec": {"library_item_id": item_id}})
+        file_info = self._post(
+            f"/rest/com/vmware/content/library/item/updatesession/file/id:{session}",
+            {"file_spec": {"name": filename, "source_type": "PUSH",
+                           "size": size}})
+        upload_uri = file_info["upload_endpoint"]["uri"]
+        status, _, _ = self.transport(
+            "PUT", upload_uri,
+            {"vmware-api-session-id": self._login(),
+             "Content-Type": "application/octet-stream",
+             "Content-Length": str(size)}, data, self.timeout)
+        if status not in (200, 201):
+            raise DiscoveryError(f"upload to {upload_uri} failed ({status})",
+                                 status)
+        self._post("/rest/com/vmware/content/library/item/update-session/"
+                   f"id:{session}?~action=complete")
+        return item_id
+
+    def import_template(self, library: str, datastore: str, item_name: str,
+                        filename: str, data: Any, size: int | None = None) -> dict:
+        lib_id = self.ensure_library(library, datastore)
+        item_id = self.upload_template(lib_id, item_name, filename, data, size)
+        return {"library_id": lib_id, "item_id": item_id,
+                "template": item_name}
 
 
 class OpenStackDiscovery:

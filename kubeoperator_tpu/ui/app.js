@@ -581,7 +581,32 @@ async function renderPlanning() {
         <input id="dpass" type="password" placeholder="password / gce access token">
         <input id="dproj" placeholder="project (gce / openstack)">
         <button onclick="discoverIaas()">Discover</button></div>
-      <div id="dresult" class="small"></div></div></div>`;
+      <div id="dresult" class="small"></div></div></div>
+    <div class="card"><h3>vSphere template import</h3>
+      <p class="dim small">Bootstrap a bare vCenter: push a packaged OVA
+        from the controller's offline package store into a content
+        library; the AUTOMATIC flow then references it by name.</p>
+      <div class="row"><div>
+        <input id="tihost" placeholder="vCenter host">
+        <input id="tiuser" placeholder="username">
+        <input id="tipass" type="password" placeholder="password">
+        <input id="tids" placeholder="datastore (name from Discover, or id)">
+        <input id="tipkg" placeholder="package" value="templates">
+        <input id="tifile" placeholder="file" value="images/ubuntu.ova">
+        <input id="tiname" placeholder="template name" value="ubuntu-22.04">
+        <button onclick="importTemplate()">Import template</button></div>
+      <div id="tiresult" class="small"></div></div></div>`;
+}
+async function importTemplate() {
+  try {
+    const r = await api("/providers/vsphere/images", {method: "POST",
+      body: JSON.stringify({host: $("#tihost").value, username: $("#tiuser").value,
+        password: $("#tipass").value, datastore: $("#tids").value,
+        package: $("#tipkg").value, file: $("#tifile").value,
+        item_name: $("#tiname").value})});
+    $("#tiresult").textContent =
+      `imported ${r.template} (item ${r.item_id}) into library ${r.library_id}`;
+  } catch (e) { alert(e.message); }
 }
 async function discoverIaas() {
   const prov = $("#dprov").value;

@@ -245,3 +245,131 @@ def test_gce_tolerates_404_tpu_zone_and_strips_token():
     assert regions["us-central2"]["zones"][0]["choices"]["tpu_types"] == [
         "v4-8", "v4-16"]
     assert regions["europe-west4"]["zones"][0]["choices"]["tpu_types"] == []
+
+
+class ContentLibraryTransport:
+    """Replays the content-library update-session flow (the REST successor
+    to the reference's NFC-lease template upload, clients/vsphere.py:84-131)."""
+
+    def __init__(self, existing_library=None):
+        self.calls = []
+        self.uploaded = None
+        self.completed = False
+        self.existing_library = existing_library
+
+    def __call__(self, method, url, headers, body, timeout):
+        self.calls.append((method, url))
+        if url.endswith("/rest/com/vmware/cis/session"):
+            return 200, json.dumps({"value": "sess-9"}), {}
+        if method == "PUT" and "/upload/" in url:
+            self.uploaded = body.read() if hasattr(body, "read") else body
+            return 200, "", {}
+        assert headers.get("vmware-api-session-id") == "sess-9"
+        if "/rest/vcenter/datastore" in url:
+            return 200, json.dumps({"value": [
+                {"datastore": "ds-1", "name": "vsanDatastore"}]}), {}
+        if url.endswith("/rest/com/vmware/content/library") and method == "GET":
+            libs = ["lib-1"] if self.existing_library else []
+            return 200, json.dumps({"value": libs}), {}
+        if "/rest/com/vmware/content/library/id:lib-1" in url:
+            return 200, json.dumps({"value": {"name": self.existing_library,
+                                              "id": "lib-1"}}), {}
+        if url.endswith("/rest/com/vmware/content/local-library"):
+            spec = json.loads(body)["create_spec"]
+            assert spec["storage_backings"][0]["datastore_id"] == "ds-1"
+            return 201, json.dumps({"value": "lib-new"}), {}
+        if url.endswith("/rest/com/vmware/content/library/item"):
+            spec = json.loads(body)["create_spec"]
+            assert spec["type"] == "ovf"
+            self.item_name = spec["name"]
+            return 201, json.dumps({"value": "item-7"}), {}
+        if url.endswith("/rest/com/vmware/content/library/item/update-session"):
+            assert json.loads(body)["create_spec"]["library_item_id"] == "item-7"
+            return 201, json.dumps({"value": "us-3"}), {}
+        if "updatesession/file/id:us-3" in url:
+            spec = json.loads(body)["file_spec"]
+            assert spec["source_type"] == "PUSH" and spec["size"] > 0
+            return 200, json.dumps({"value": {
+                "name": spec["name"],
+                "upload_endpoint": {"uri": "https://vc/upload/us-3"}}}), {}
+        if "update-session/id:us-3?~action=complete" in url:
+            self.completed = True
+            return 200, "", {}
+        return 404, "{}", {}
+
+
+def test_vsphere_template_import_creates_library_and_uploads():
+    t = ContentLibraryTransport()
+    imp = discovery.VSphereImageImport("vc.local", "admin", "pw", transport=t)
+    out = imp.import_template("kubeoperator", "ds-1", "ubuntu-22.04",
+                              "ubuntu.ova", b"OVA-BYTES")
+    assert out == {"library_id": "lib-new", "item_id": "item-7",
+                   "template": "ubuntu-22.04"}
+    assert t.uploaded == b"OVA-BYTES"
+    assert t.completed, "update session must be completed or vCenter drops it"
+
+
+def test_vsphere_template_import_resolves_datastore_name():
+    """The operator types the datastore NAME discover() showed them; the
+    import resolves it to the moref id vCenter demands."""
+    t = ContentLibraryTransport()
+    imp = discovery.VSphereImageImport("vc.local", "admin", "pw", transport=t)
+    out = imp.import_template("kubeoperator", "vsanDatastore", "tpl",
+                              "t.ova", b"X")
+    assert out["library_id"] == "lib-new"    # create_spec asserted ds-1
+
+
+def test_vsphere_template_import_reuses_existing_library():
+    t = ContentLibraryTransport(existing_library="kubeoperator")
+    imp = discovery.VSphereImageImport("vc.local", "admin", "pw", transport=t)
+    out = imp.import_template("kubeoperator", "ds-1", "tpl", "t.ova", b"X")
+    assert out["library_id"] == "lib-1"
+    assert not any(u.endswith("/local-library") for _, u in t.calls)
+
+
+def test_vsphere_image_route_feeds_from_package_store(platform):
+    """POST /providers/vsphere/images streams a packaged OVA into the
+    canned vCenter — the air-gapped bootstrap path end to end."""
+    import asyncio
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeoperator_tpu.api.app import create_app, ensure_admin
+    from kubeoperator_tpu.services.packages import scan_packages
+    from test_api import login
+
+    ensure_admin(platform)
+    pkg_dir = os.path.join(platform.config.packages, "templates")
+    os.makedirs(os.path.join(pkg_dir, "images"), exist_ok=True)
+    with open(os.path.join(pkg_dir, "images", "ubuntu.ova"), "wb") as f:
+        f.write(b"PACKAGED-OVA")
+    with open(os.path.join(pkg_dir, "meta.yml"), "w", encoding="utf-8") as f:
+        f.write("name: templates\nversion: '1'\n")
+    scan_packages(platform)
+
+    t = ContentLibraryTransport()
+
+    async def scenario():
+        app = create_app(platform)
+        app["discovery_transport"] = t
+        async with TestClient(TestServer(app)) as client:
+            hdrs = await login(client)
+            r = await client.post("/api/v1/providers/vsphere/images", json={
+                "host": "vc.local", "username": "admin", "password": "pw",
+                "datastore": "ds-1", "item_name": "ubuntu-22.04",
+                "package": "templates", "file": "images/ubuntu.ova",
+            }, headers=hdrs)
+            assert r.status == 201, await r.text()
+            out = await r.json()
+            assert out["template"] == "ubuntu-22.04"
+            # a missing file is a clean 404, not a 500
+            r = await client.post("/api/v1/providers/vsphere/images", json={
+                "host": "vc.local", "username": "admin", "password": "pw",
+                "datastore": "ds-1", "item_name": "x",
+                "package": "templates", "file": "images/nope.ova",
+            }, headers=hdrs)
+            assert r.status == 404
+
+    asyncio.run(scenario())
+    assert t.uploaded == b"PACKAGED-OVA"
