@@ -100,6 +100,13 @@ def main() -> None:
                                                         seq_len=4096,
                                                         steps=4, warmup=2)
             out["llm_mfu_seq4k"] = round(lm4k["mfu"], 4)
+            # 8k long-context point (r4: flash block 512 makes longer
+            # sequences FASTER per FLOP than short — 62.4% measured)
+            lm8k_cfg = dataclasses.replace(lm_cfg, max_seq_len=8192)
+            lm8k = LMTrainer(lm8k_cfg, lm_spec).measure(batch=2 * n,
+                                                        seq_len=8192,
+                                                        steps=4, warmup=2)
+            out["llm_mfu_seq8k"] = round(lm8k["mfu"], 4)
         except Exception as e:  # noqa: BLE001 — secondary metric only
             print(f"# llm secondary metric failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

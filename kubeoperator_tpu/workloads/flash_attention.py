@@ -24,8 +24,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK = 256     # v5e sweep at [8,2048,16,128] fwd+bwd: 128 → 31.3 ms,
-                        # 256 → 21.1 ms, 512 → 26.1 ms (dense: 46.1 ms)
+DEFAULT_BLOCK = 512     # r4 in-model sweep with the bb-batched kernels
+                        # (D=128 LM): seq 2048 b8: 256 → 56.3%, 512 → 58.8%
+                        # MFU; seq 8192 b2: 128 → 33.9%, 256 → 51.1%,
+                        # 512 → 62.4% (1024 fails VMEM). The r3 per-op
+                        # microbench favored 256, but that predated batch-
+                        # blocking; ViT (D=64, padded seq 256) still pins
+                        # flash_block=256 explicitly (vit.py).
 NEG_INF = -1e30
 
 

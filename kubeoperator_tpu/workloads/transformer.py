@@ -106,8 +106,8 @@ class Attention(nn.Module):
         Derived from the kernel's tuned default with a 128 fallback; the
         kernel now zero-pads ragged sequences to the tile grid itself
         (masked keys, ViT's 196 patches), so an explicit
-        ``attention="flash"`` works at any length — the 128/256 preference
-        here only picks the block size."""
+        ``attention="flash"`` works at any length — the DEFAULT_BLOCK/128
+        preference here only picks the block size."""
         from kubeoperator_tpu.workloads.flash_attention import DEFAULT_BLOCK
         block = self.cfg.flash_block or next(
             (b for b in (DEFAULT_BLOCK, 128)
