@@ -429,8 +429,11 @@ async def vsphere_upload_image(request: web.Request) -> web.Response:
     body = await request.json()
     # header/URL-bound values must be stripped: a pasted trailing newline
     # would blow up urllib's header validation as a 500 (same discipline
-    # as discovery.discover)
-    body = {k: v.strip() if isinstance(v, str) else v
+    # as discovery.discover). Credentials are NOT touched — a password with
+    # edge whitespace is legal and must authenticate as given (ADVICE r4);
+    # basic-auth base64 encoding makes it header-safe regardless.
+    body = {k: v.strip() if isinstance(v, str) and k not in
+            ("username", "password") else v
             for k, v in body.items()}
     try:
         path = packages_svc.resolve_file(platform, body["package"],

@@ -26,6 +26,12 @@ from kubeoperator_tpu.utils.timeutil import iso
 
 log = get_logger(__name__)
 
+# "dropped by the new package" marker in an upgrade's vars overlay. A JSON-
+# safe string (execution params round-trip through the store), NOT None:
+# filtering None at commit time would also eat user configs that
+# legitimately hold None (ADVICE r4).
+UPGRADE_DROP = "__ko_dropped_by_upgrade__"
+
 # cluster status while an operation runs (reference deploy.py:61,74,96,115…)
 RUNNING_STATUS = {
     "install": ClusterStatus.INSTALLING,
@@ -109,9 +115,11 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
                 executor=platform.executor,
                 catalog=platform.catalog,
                 config=platform.config,
-                vars={**cluster.configs,
+                vars={k: v for k, v in {
+                      **cluster.configs,
                       **execution.params.get("upgrade_vars", {}),
-                      **execution.params.get("vars", {})},
+                      **execution.params.get("vars", {})}.items()
+                      if v != UPGRADE_DROP},
                 step=step_def,
                 provider=platform.provider_for(cluster),
                 params=execution.params,
@@ -148,13 +156,15 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
             _exit_new_node(store, cluster)
         if execution.operation == "upgrade" and execution.params.get("upgrade_package"):
             # the package switch commits only now: a failed upgrade must
-            # never record a version the nodes don't actually run. None
-            # overlay values mean "the new package doesn't supply this" —
-            # drop the stale key instead of storing the None.
+            # never record a version the nodes don't actually run.
+            # UPGRADE_DROP overlay values mean "the new package doesn't
+            # supply this" — drop the stale key instead of storing the
+            # marker (user None values survive untouched).
             merged = {**cluster.configs,
                       **execution.params.get("upgrade_vars", {}),
                       **execution.params.get("vars", {})}
-            cluster.configs = {k: v for k, v in merged.items() if v is not None}
+            cluster.configs = {k: v for k, v in merged.items()
+                               if v != UPGRADE_DROP}
             cluster.package = execution.params["upgrade_package"]
     store.save(execution)
     store.save(cluster)

@@ -182,9 +182,14 @@ class Platform:
                    if cluster.package else None)
         if old_pkg:
             for key in old_pkg.meta.get("vars", {}):
-                overlay.setdefault(key, None)     # dropped by the new pkg
-        overlay["repo_checksums"] = pkg.meta.get("checksums") or None
-        overlay["repo_images"] = self._aggregate_images(pkg) or None
+                # dropped by the new pkg — JSON-safe marker, not None, so
+                # user configs that legitimately hold None survive the
+                # success-commit filter (operations.UPGRADE_DROP)
+                overlay.setdefault(key, operations.UPGRADE_DROP)
+        overlay["repo_checksums"] = (pkg.meta.get("checksums")
+                                     or operations.UPGRADE_DROP)
+        overlay["repo_images"] = (self._aggregate_images(pkg)
+                                  or operations.UPGRADE_DROP)
         try:
             repo_base = packages_svc.repo_base_url(self)
         except ValueError as e:

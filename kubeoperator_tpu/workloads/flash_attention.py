@@ -291,8 +291,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if not interpret:
         # Mosaic tiles lanes in 128s: the lse block (bb, 8, bq) needs
         # bq % 128 == 0 on real hardware, so sub-128 blocks only exist in
-        # interpret mode (CPU tests exercise multi-block paths cheaply)
-        block = max(block, 128)
+        # interpret mode (CPU tests exercise multi-block paths cheaply).
+        # Round odd sizes (e.g. 192) up too — a non-multiple violates lane
+        # tiling with an opaque Mosaic compile error (ADVICE r4).
+        block = -(-max(block, 128) // 128) * 128
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     # ragged sequences (ViT's 14x14=196 patches) are zero-padded up to the

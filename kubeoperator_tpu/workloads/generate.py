@@ -20,12 +20,15 @@ ends, then generates — the mechanism the serving batcher
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import Any
+from typing import Any, Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from kubeoperator_tpu.workloads.transformer import Transformer, TransformerConfig
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig, rope,
+)
 
 
 def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
@@ -66,6 +69,11 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
 
     buf = jnp.zeros((b, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    if max_new_tokens == 0:
+        # nothing to generate: the output IS the prompt. Without this the
+        # prefill branch would sample a token for position p-1 and
+        # overwrite the last prompt token (ADVICE r4).
+        return buf
 
     def choose(logits, pos, buf, rng):
         """Select the token for position pos+1 from position pos's logits —
@@ -73,7 +81,13 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
         the model's choice after."""
         rng, sub = jax.random.split(rng)
         if temperature > 0:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            # per-row keys (fold_in on the row index) make each row's sample
+            # depend only on (rng, position, row) — invariant to how many
+            # pad rows the serving batcher appended (ADVICE r4: a shared
+            # draw over [B, V] changed with the padded batch shape)
+            subs = jax.vmap(jax.random.fold_in, (None, 0))(sub, jnp.arange(b))
+            nxt = jax.vmap(lambda k, l: jax.random.categorical(
+                k, l / temperature))(subs, logits)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         keep_prompt = pos + 1 < p_vec                           # [B]
@@ -96,6 +110,19 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
         start += 1
 
     # -- decode: one token per scan step -----------------------------------
+    positions = jnp.arange(start, total - 1, dtype=jnp.int32)
+    if start >= total - 1:
+        return buf
+    if cfg.moe_experts == 0:
+        # fast path: explicit per-layer cache buffers carried through the
+        # scan (see _decode_scan). The flax path below routes the stacked
+        # cache through nn.scan's variable mechanics, which unstacks
+        # (dynamic-slice), restacks (DUS into a fresh buffer) and copies
+        # the full [L,B,S,H,D] cache every token — profiled at ~19 of the
+        # 27 ms/token at d2048/L4/b8 (PERF.md round 5).
+        return _decode_scan(decode_cfg, params, cache, buf, rng, positions,
+                            choose, b)
+
     def step(carry, pos):
         buf, cache, rng = carry
         token = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
@@ -106,8 +133,82 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
         buf, rng = choose(logits[:, 0, :], pos, buf, rng)
         return (buf, cache, rng), None
 
-    if start < total - 1:
-        (buf, _, _), _ = jax.lax.scan(
-            step, (buf, cache, rng),
-            jnp.arange(start, total - 1, dtype=jnp.int32))
+    (buf, _, _), _ = jax.lax.scan(step, (buf, cache, rng), positions)
+    return buf
+
+
+def _decode_scan(cfg: TransformerConfig, params: Any, cache: Any,
+                 buf: jnp.ndarray, rng: jax.Array, positions: jnp.ndarray,
+                 choose: Callable, b: int) -> jnp.ndarray:
+    """Token-at-a-time decode with per-layer cache buffers as plain scan
+    carries — the TPU-shaped inner loop of generation.
+
+    The math mirrors ``transformer.Block``'s decode branch op for op (same
+    einsum strings, same cast points, so greedy tokens match the flax path
+    bit for bit — pinned by tests/test_generate.py). What changes is cache
+    plumbing only: layers unroll in Python over a list of per-layer
+    (k, v) buffers, each updated with ONE dynamic_update_slice that XLA
+    aliases in place across the scan (the buffer is dead after the
+    update), instead of flax nn.scan's slice/restack/copy of the stacked
+    cache. Measured at d2048/L4/b8: 27.2 → ~4 ms/token (PERF.md r5).
+    """
+    params = nn.unbox(params)
+    emb = params["embedding"]                     # [V, d] f32
+    layers = [jax.tree.map(lambda x: x[l], params["layers"])
+              for l in range(cfg.n_layers)]
+    attn_cache = cache["layers"]["attn"]
+    caches = [(attn_cache["cached_k"][l], attn_cache["cached_v"][l])
+              for l in range(cfg.n_layers)]
+    dt, s, scale = cfg.dtype, cfg.max_seq_len, 1.0 / (cfg.head_dim ** 0.5)
+
+    def norm(x, w, eps=1e-6):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+    def step(carry, pos):
+        buf, rng, caches = carry
+        token = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        x = emb[token].astype(dt)                             # [B, 1, d]
+        pos1 = jnp.full((1,), pos, jnp.int32)
+        new_caches = []
+        for pl, (ck, cv) in zip(layers, caches):
+            a = pl["attn"]
+            h = norm(x, pl["ln1"]["scale"]).astype(dt)
+            if "qkv" in a:
+                qkv = jnp.einsum("bqd,dshk->bqshk", h,
+                                 a["qkv"]["kernel"].astype(dt))
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            else:
+                q = jnp.einsum("bqd,dhk->bqhk", h, a["q"]["kernel"].astype(dt))
+                k = jnp.einsum("bqd,dhk->bqhk", h, a["k"]["kernel"].astype(dt))
+                v = jnp.einsum("bqd,dhk->bqhk", h, a["v"]["kernel"].astype(dt))
+            q, k = rope(q, pos1), rope(k, pos1)
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(dt), (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(dt), (0, pos, 0, 0))
+            new_caches.append((ck, cv))
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                                preferred_element_type=jnp.float32) * scale
+            mask = (jnp.arange(s)[None, None, None, :]
+                    <= pos1[None, None, :, None])
+            probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), cv)
+            x = x + jnp.einsum("bqhd,hde->bqe", out,
+                               a["o"]["kernel"].astype(dt))
+            m = pl["mlp"]
+            h2 = norm(x, pl["ln2"]["scale"]).astype(dt)
+            gate = jnp.einsum("bqd,df->bqf", h2, m["gate"]["kernel"].astype(dt))
+            up = jnp.einsum("bqd,df->bqf", h2, m["up"]["kernel"].astype(dt))
+            x = x + jnp.einsum("bqf,fd->bqd", nn.silu(gate) * up,
+                               m["down"]["kernel"].astype(dt))
+        xf = norm(x, params["ln_f"]["scale"])
+        if cfg.logits_bf16:
+            logits = jnp.einsum("btd,vd->btv", xf.astype(dt), emb.astype(dt),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("btd,vd->btv", xf.astype(jnp.float32),
+                                emb.astype(jnp.float32))
+        buf, rng = choose(logits[:, 0, :], pos, buf, rng)
+        return (buf, rng, new_caches), None
+
+    (buf, _, _), _ = jax.lax.scan(step, (buf, rng, caches), positions)
     return buf

@@ -118,16 +118,21 @@ class LMTrainer:
                                     0, self.cfg.vocab_size, jnp.int32)
         return jax.device_put(tokens, self.token_shd)
 
-    def measure(self, batch: int, seq_len: int, steps: int = 10, warmup: int = 2) -> dict:
-        from kubeoperator_tpu.workloads.train import timed_steps
+    def measure(self, batch: int, seq_len: int, steps: int = 10, warmup: int = 2,
+                repeats: int = 3) -> dict:
+        from kubeoperator_tpu.workloads.train import step_stats, timed_steps
 
         state = self.init_state()
         tokens = self.synthetic_batch(batch, seq_len)
-        _, dt = timed_steps(self.train_step, state, (tokens,), steps, warmup)
+        _, times = timed_steps(self.train_step, state, (tokens,), steps, warmup,
+                               repeats)
+        stats = step_stats(times)
+        dt = stats["median_ms"] / 1e3  # robust to one-off relay stalls (r4)
         n_chips = self.mesh.devices.size
         tokens_per_step = batch * seq_len
         achieved = 3 * flops_per_token(self.cfg, seq_len) * tokens_per_step / dt
         return {"tokens_per_sec": tokens_per_step / dt,
-                "step_time_ms": dt * 1e3,
+                "step_time_ms": stats["median_ms"],
                 "mfu": achieved / (peak_flops_per_chip() * n_chips),
-                "achieved_tflops": achieved / 1e12, "chips": n_chips}
+                "achieved_tflops": achieved / 1e12, "chips": n_chips,
+                "step_stats": stats}

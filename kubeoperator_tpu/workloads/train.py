@@ -102,23 +102,62 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def timed_steps(step_fn: Callable, state: Any, inputs: tuple,
-                steps: int, warmup: int) -> tuple[Any, float]:
+                steps: int, warmup: int, repeats: int = 3,
+                prof: Any = None) -> tuple[Any, list[float]]:
     """Shared warmup/fence/timed-loop for the trainers' measure() methods.
 
     The fence is a host transfer of a metric leaf: on the axon relay
     platform ``block_until_ready`` returns before execution finishes, so a
     value fetch is the only reliable barrier (measured: 0.007 s "block" vs
-    9.4 s actual for the same queue). Returns (state, seconds_per_step).
+    9.4 s actual for the same queue).
+
+    The loop runs ``repeats`` independent blocks of ``steps`` pipelined
+    calls, one fence per block — round 4 shipped a 21× step-time collapse
+    as its number of record because a single un-replicated aggregate hid
+    the anomaly (BENCH_r04 llm_mfu 0.0265 vs 0.58 reproduced twice the
+    same day). Per-CALL fencing was measured and rejected: a fenced
+    dispatch round-trip through the relay costs 70-130 ms of dead latency
+    (a ready-value fetch is ~0.03 ms), which inflated every family by
+    exactly one round-trip per call. Per-repeat fencing keeps the
+    pipelined-dispatch convention of rounds 1-4 while giving callers a
+    distribution the median defends. Returns (state, per-repeat
+    seconds-per-step, length ``repeats``).
     """
+    import contextlib
+
     warmup = max(1, warmup)
     for _ in range(warmup):
         state, metrics = step_fn(state, *inputs)
     float(jax.tree.leaves(metrics)[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, *inputs)
-    float(jax.tree.leaves(metrics)[0])
-    return state, (time.perf_counter() - t0) / steps
+    times: list[float] = []
+    # ``prof`` (a jax.profiler.trace context) wraps ONLY the timed repeats:
+    # warmup/compile stay outside so trace-driven tuning sums steady-state
+    # device events, not compilation.
+    with prof if prof is not None else contextlib.nullcontext():
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step_fn(state, *inputs)
+            float(jax.tree.leaves(metrics)[0])
+            times.append((time.perf_counter() - t0) / steps)
+    return state, times
+
+
+def step_stats(times: list[float], steps_per_call: int = 1) -> dict:
+    """min/median/max/mean per-step milliseconds from per-repeat seconds.
+
+    The *median* repeat is what the trainers convert to MFU: it is robust
+    to the one-off multi-second stalls the relay transport can inject (the
+    r4 capture), while a single mean would ship them as the result.
+    max/median > 2 sets ``suspect``; bench.py's guarded() re-measures any
+    suspect point once and keeps the better run.
+    """
+    ts = sorted(t / steps_per_call * 1e3 for t in times)
+    n = len(ts)
+    med = ts[n // 2] if n % 2 else 0.5 * (ts[n // 2 - 1] + ts[n // 2])
+    return {"min_ms": ts[0], "median_ms": med, "max_ms": ts[-1],
+            "mean_ms": sum(ts) / n, "n_repeats": n,
+            "suspect": bool(ts[-1] > 2.0 * med)}
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, smoothing: float) -> jnp.ndarray:
@@ -259,7 +298,7 @@ class Trainer:
 
     def measure(self, steps: int = 20, warmup: int = 3, batch: int | None = None,
                 steps_per_call: int = 1, profile_dir: str | None = None,
-                fresh_data: bool = False) -> dict:
+                fresh_data: bool = False, repeats: int = 3) -> dict:
         """Timed loop → img/sec/chip + MFU.
 
         ``steps_per_call > 1`` uses the scanned multi-step; ``steps`` then
@@ -284,42 +323,37 @@ class Trainer:
         batch = batch or self.cfg.batch_size
         warmup = max(1, warmup)
         state = self.init_state()
-        import contextlib
-        prof = (jax.profiler.trace(profile_dir) if profile_dir
-                else contextlib.nullcontext())
+        prof = jax.profiler.trace(profile_dir) if profile_dir else None
         # barrier via host transfer: on the axon TPU relay platform,
         # block_until_ready returns before execution finishes — a value
         # fetch is the only reliable fence (measured: 0.007s "block" vs
-        # 9.4s actual for the same queue).
+        # 9.4s actual for the same queue). The profiler context wraps only
+        # the timed repeats inside timed_steps (warmup/compile excluded).
         if steps_per_call > 1:
             fn = self.multi_step_fn(steps_per_call, fresh_data=fresh_data)
-            key = jax.random.key(1)
-            for _ in range(warmup):
-                state, losses = fn(state, key)
-            float(losses[-1])
-            t0 = time.perf_counter()
-            with prof:
-                for _ in range(steps):
-                    state, losses = fn(state, key)
-                float(losses[-1])
+
+            def wrapped(s, key):  # adapt (state, losses[k]) to (state, metrics)
+                s, losses = fn(s, key)
+                return s, {"loss": losses[-1]}
+
+            state, times = timed_steps(wrapped, state, (jax.random.key(1),),
+                                       steps, warmup, repeats, prof=prof)
         else:
             images, labels = self.synthetic_batch(batch)
-            for _ in range(warmup):
-                state, metrics = self.train_step(state, images, labels)
-            float(metrics["loss"])
-            t0 = time.perf_counter()
-            with prof:
-                for _ in range(steps):
-                    state, metrics = self.train_step(state, images, labels)
-                float(metrics["loss"])
-        dt = time.perf_counter() - t0
-        total_steps = steps * steps_per_call
+            state, times = timed_steps(self.train_step, state,
+                                       (images, labels), steps, warmup,
+                                       repeats, prof=prof)
+        stats = step_stats(times, steps_per_call)
+        # median step time is the number of record: robust to one-off relay
+        # stalls (the r4 BENCH capture); the full distribution ships with it
+        dt = stats["median_ms"] / 1e3
         n_chips = self.mesh.devices.size
-        img_per_sec = batch * total_steps / dt
-        achieved = self.flops_per_step(batch) * total_steps / dt
+        img_per_sec = batch / dt
+        achieved = self.flops_per_step(batch) / dt
         mfu = achieved / (peak_flops_per_chip() * n_chips)
         return {"img_per_sec": img_per_sec, "img_per_sec_per_chip": img_per_sec / n_chips,
-                "step_time_ms": dt / total_steps * 1e3, "mfu": mfu, "chips": n_chips,
-                "batch": batch, "achieved_tflops": achieved / 1e12}
+                "step_time_ms": stats["median_ms"], "mfu": mfu, "chips": n_chips,
+                "batch": batch, "achieved_tflops": achieved / 1e12,
+                "step_stats": stats}
 
 

@@ -156,13 +156,13 @@ class ViTTrainer:
                        in_shardings=(None, self.batch_shd, self.batch_shd))
 
     def measure(self, batch: int, steps: int = 6, warmup: int = 2,
-                steps_per_call: int = 1) -> dict:
+                steps_per_call: int = 1, repeats: int = 3) -> dict:
         """Timed loop → img/s + MFU (fwd+bwd ≈ 3× forward FLOPs; the
         warmup/fence/timing discipline is the shared ``timed_steps``).
         ``steps_per_call > 1`` uses the scanned multi-step; ``steps`` then
         counts scan calls, so total steps = steps × steps_per_call."""
         from kubeoperator_tpu.workloads.train import (
-            peak_flops_per_chip, timed_steps,
+            peak_flops_per_chip, step_stats, timed_steps,
         )
 
         state = self.init_state()
@@ -175,15 +175,17 @@ class ViTTrainer:
             self.batch_shd)
         step_fn = (self.multi_step(steps_per_call) if steps_per_call > 1
                    else self.train_step)
-        _, dt = timed_steps(step_fn, state, (images, labels), steps, warmup)
-        dt /= steps_per_call
+        _, times = timed_steps(step_fn, state, (images, labels), steps, warmup,
+                               repeats)
+        stats = step_stats(times, steps_per_call)
+        dt = stats["median_ms"] / 1e3  # robust to one-off relay stalls (r4)
         n_chips = self.mesh.devices.size
         achieved = 3 * flops_per_image(self.cfg) * batch / dt
         return {"img_per_sec": batch / dt,
                 "img_per_sec_per_chip": batch / dt / n_chips,
-                "step_time_ms": dt * 1e3,
+                "step_time_ms": stats["median_ms"],
                 "mfu": achieved / (peak_flops_per_chip() * n_chips),
-                "chips": n_chips}
+                "chips": n_chips, "step_stats": stats}
 
 
 def train_step_fn(model: VisionTransformer, tx) -> Any:

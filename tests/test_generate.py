@@ -70,6 +70,27 @@ def test_chunked_prefill_matches_token_by_token(params):
     np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
 
 
+def test_fused_qkv_and_bf16_logits_decode_match_full_forward():
+    """The fast decode engine's fused-qkv einsum and bf16-logits branches
+    (generate._decode_scan) against the un-cached forward — the default
+    test CFG exercises neither."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, fused_qkv=True, logits_bf16=True)
+    model = Transformer(cfg)
+    params = nn.unbox(model.init(jax.random.key(3),
+                                 jnp.zeros((2, 8), jnp.int32))["params"])
+    prompt = jnp.array([[3, 11, 5], [9, 2, 40]], jnp.int32)
+    out = generate(cfg, params, prompt, max_new_tokens=5)
+    seq = np.asarray(out)
+    for t in range(3, 8):
+        logits = model.apply({"params": params},
+                             jnp.asarray(seq[:, :t], jnp.int32))
+        want = np.argmax(np.asarray(logits[:, -1, :]), axis=-1)
+        np.testing.assert_array_equal(seq[:, t], want,
+                                      err_msg=f"divergence at position {t}")
+
+
 def test_mixed_prompt_lengths_match_separate_runs(params):
     """A batch of right-padded prompts with per-row lengths generates, for
     each row, exactly what that prompt generates alone — the fused-batch
