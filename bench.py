@@ -29,7 +29,7 @@ import jax
 # distribution-based suspect check (max repeat > 2× median) is
 # device-independent and always applies.
 EXPECTED_MFU = {
-    "resnet": 0.33, "llm": 0.58, "llm4k": 0.58, "llm8k": 0.62, "vit": 0.35,
+    "resnet": 0.33, "llm": 0.58, "llm4k": 0.58, "llm8k": 0.62, "vit": 0.45,
 }
 
 
@@ -98,8 +98,12 @@ def main() -> None:
                           stem="space_to_depth", dw_dot_max_k=1)
         tr = Trainer(cfg, MeshSpec(dp=n) if n > 1 else MeshSpec())
         try:
+            # the recorded expectation is for the batch-128 config; OOM
+            # fallbacks legitimately measure lower and must not trip the
+            # stall guard every run (suspect-distribution retry still
+            # applies via the unknown name)
             result = guarded(
-                "resnet",
+                "resnet" if per_chip_batch == 128 else "resnet-fallback",
                 lambda: tr.measure(steps=steps, warmup=warmup, steps_per_call=k),
                 out)
             break
@@ -174,15 +178,15 @@ def main() -> None:
             from kubeoperator_tpu.workloads.transformer import TransformerConfig
             from kubeoperator_tpu.workloads.vit import ViTConfig, ViTTrainer
 
-            # r4 tuned config: bb-batched flash kernel at block 256 (padded
-            # 196->256 with masked keys), attention output pinned across
-            # the remat boundary, 8 scanned steps/dispatch (PERF.md:
-            # 31.6% -> 35.5% MFU)
+            # r5 tuned config: packed [B,T,H·D] flash kernels (zero
+            # transpose/pad formatting) + unrolled layers (no scan save
+            # stacks) on the r4 recipe — 35.5% -> 47.2% MFU (PERF.md r5)
             enc = TransformerConfig(d_model=768, n_heads=12, n_layers=12,
                                     d_ff=3072, causal=False, max_seq_len=196,
                                     dtype=jnp.bfloat16, remat=True,
                                     attention="flash", flash_block=256,
-                                    remat_policy="dots+attn")
+                                    remat_policy="dots+attn",
+                                    flash_layout="packed", scan_layers=False)
             vcfg = ViTConfig(num_classes=1000, image_size=224, patch=16,
                              encoder=enc)
             vt = ViTTrainer(vcfg, MeshSpec(dp=n) if n > 1 else MeshSpec())

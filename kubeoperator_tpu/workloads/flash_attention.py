@@ -261,6 +261,220 @@ def _bwd(scale, causal, block, interpret, kv_len, residuals, g):
 
 
 # ---------------------------------------------------------------------------
+# packed-layout ([B, T, H·D]) kernels: heads live in the lane dimension
+# ---------------------------------------------------------------------------
+#
+# The [BH, T, D] wrappers pay a real layout change per tensor per call:
+# [B,T,H,D] -> transpose -> [B,H,T,D] -> reshape, on q/k/v/do in and
+# o/dq/dk/dv out — profiled at ~40 ms/step for ViT-B (3 calls × 12
+# layers, PERF.md r4 "formatting class"). The packed kernels instead
+# consume the projections' output layout directly: [B, T, H·D] is a FREE
+# reshape of [B, T, H, D] (row-major bitcast), heads are static lane
+# slices inside VMEM, and one program processes every head of its
+# (batch, q-block) — which also amortizes per-program grid overhead the
+# way _batch_block does for the flat kernels. A TPU grid-axis-per-head
+# variant was tried first and is impossible: Mosaic requires the block's
+# second-to-last dim to be 8-divisible or full, so a squeezed head dim
+# in [B, T, H, D] blocks cannot lower.
+
+def _bb_packed(b, tp, hd, bq, bk):
+    """Largest power-of-two batch block whose double-buffered VMEM
+    footprint (full-seq packed k/v + f32 q/o/dq + one score block) stays
+    in budget."""
+    per = (2 * tp * hd * 2          # k, v (bf16, full padded seq)
+           + 3 * bq * hd * 4        # q/o (or q/dq/do) in f32
+           + bq * bk * 4)           # per-head score block
+    bb = 1
+    while bb * 2 <= b and b % (bb * 2) == 0 and (bb * 2) * per <= 4 * 1024 * 1024:
+        bb *= 2
+    return bb
+
+
+def _fwd_packed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                       causal, bk, kv_len, d):
+    bb, bq, hd = q_ref.shape
+    n_kv = k_ref.shape[1] // bk
+    i_blk = pl.program_id(1)
+    hi_blk = (jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk
+              if causal else n_kv)
+    for h in range(hd // d):
+        sl = slice(h * d, (h + 1) * d)
+        q = q_ref[:, :, sl].astype(jnp.float32) * scale       # [BB, BQ, D]
+
+        def body(j, carry, sl=sl, q=q):
+            m, l, acc = carry
+            k = k_ref[:, pl.ds(j * bk, bk), sl].astype(jnp.float32)
+            v = v_ref[:, pl.ds(j * bk, bk), sl].astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = _mask_scores(s, i_blk, j, bq, bk, causal, kv_len)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jax.lax.dot_general(
+                p, v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        m, l, acc = jax.lax.fori_loop(
+            0, hi_blk, body,
+            (jnp.full((bb, bq), NEG_INF, jnp.float32),
+             jnp.zeros((bb, bq), jnp.float32),
+             jnp.zeros((bb, bq, d), jnp.float32)))
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:, :, sl] = (acc / l[..., None]).astype(o_ref.dtype)
+        lse_ref[:, h] = jnp.broadcast_to((m + jnp.log(l))[:, None, :],
+                                         (bb, 8, bq))
+
+
+def _bwd_dq_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, *, scale, causal, bk, kv_len, d):
+    bb, bq, hd = q_ref.shape
+    n_kv = k_ref.shape[1] // bk
+    i_blk = pl.program_id(1)
+    hi_blk = (jnp.minimum((i_blk + 1) * bq + bk - 1, n_kv * bk) // bk
+              if causal else n_kv)
+    for h in range(hd // d):
+        sl = slice(h * d, (h + 1) * d)
+        q = q_ref[:, :, sl].astype(jnp.float32) * scale
+        do = do_ref[:, :, sl].astype(jnp.float32)
+        lse = lse_ref[:, h, 0, :]                             # [BB, BQ]
+        delta = delta_ref[:, h, 0, :]
+
+        def body(j, dq, sl=sl, q=q, do=do, lse=lse, delta=delta):
+            k = k_ref[:, pl.ds(j * bk, bk), sl].astype(jnp.float32)
+            v = v_ref[:, pl.ds(j * bk, bk), sl].astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = _mask_scores(s, i_blk, j, bq, bk, causal, kv_len)
+            p = jnp.exp(s - lse[..., None])
+            dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[..., None])
+            return dq + jax.lax.dot_general(
+                ds, k, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, hi_blk, body,
+                               jnp.zeros((bb, bq, d), jnp.float32))
+        dq_ref[:, :, sl] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, *, scale, causal, bq, kv_len, d):
+    bb, bk, hd = k_ref.shape
+    n_q = q_ref.shape[1] // bq
+    j_blk = pl.program_id(1)
+    lo_blk = (j_blk * bk) // bq if causal else 0
+    for h in range(hd // d):
+        sl = slice(h * d, (h + 1) * d)
+        k = k_ref[:, :, sl].astype(jnp.float32)
+        v = v_ref[:, :, sl].astype(jnp.float32)
+
+        def body(i, carry, sl=sl, k=k, v=v):
+            dk, dv = carry
+            q = q_ref[:, pl.ds(i * bq, bq), sl].astype(jnp.float32) * scale
+            do = do_ref[:, pl.ds(i * bq, bq), sl].astype(jnp.float32)
+            lse = lse_ref[:, h, 0, pl.ds(i * bq, bq)]
+            delta = delta_ref[:, h, 0, pl.ds(i * bq, bq)]
+            s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = _mask_scores(s, i, j_blk, bq, bk, causal, kv_len)
+            p = jnp.exp(s - lse[..., None])
+            dv = dv + jax.lax.dot_general(p, do, (((1,), (1,)), ((0,), (0,))),
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[..., None])
+            dk = dk + jax.lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))),
+                                          preferred_element_type=jnp.float32)
+            return dk, dv
+
+        dk, dv = jax.lax.fori_loop(
+            lo_blk, n_q, body,
+            (jnp.zeros((bb, bk, d), jnp.float32),
+             jnp.zeros((bb, bk, d), jnp.float32)))
+        dk_ref[:, :, sl] = dk.astype(dk_ref.dtype)   # q pre-scaled: dk done
+        dv_ref[:, :, sl] = dv.astype(dv_ref.dtype)
+
+
+def _fwd_packed(q, k, v, scale, causal, block, interpret, d, kv_len=None):
+    b, tp, hd = q.shape
+    h = hd // d
+    bq = bk = min(block, tp)
+    bb = _bb_packed(b, tp, hd, bq, bk)
+    blk = pl.BlockSpec((bb, bq, hd), lambda bi, i: (bi, i, 0))
+    seq = pl.BlockSpec((bb, tp, hd), lambda bi, i: (bi, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_packed_kernel, scale=scale, causal=causal,
+                          bk=bk, kv_len=kv_len, d=d),
+        grid=(b // bb, tp // bq),
+        in_specs=[blk, seq, seq],
+        out_specs=[blk,
+                   pl.BlockSpec((bb, h, 8, bq), lambda bi, i: (bi, 0, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((b, tp, hd), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, 8, tp), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_packed(scale, causal, block, interpret, d, kv_len, residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    b, tp, hd = q.shape
+    h = hd // d
+    bq = bk = min(block, tp)
+    bb = _bb_packed(b, tp, hd, bq, bk)
+    delta = jnp.sum((do.astype(jnp.float32) * o.astype(jnp.float32))
+                    .reshape(b, tp, h, d), axis=-1)           # [B, Tp, H]
+    delta = jnp.broadcast_to(delta.transpose(0, 2, 1)[:, :, None, :],
+                             (b, h, 8, tp))                    # match lse
+    blk = pl.BlockSpec((bb, bq, hd), lambda bi, i: (bi, i, 0))
+    seq = pl.BlockSpec((bb, tp, hd), lambda bi, i: (bi, 0, 0))
+    row_blk = pl.BlockSpec((bb, h, 8, bq), lambda bi, i: (bi, 0, 0, i))
+    row_full = pl.BlockSpec((bb, h, 8, tp), lambda bi, i: (bi, 0, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_packed_kernel, scale=scale, causal=causal,
+                          bk=bk, kv_len=kv_len, d=d),
+        grid=(b // bb, tp // bq),
+        in_specs=[blk, seq, seq, blk, row_blk, row_blk],
+        out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct((b, tp, hd), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    kv_blk = pl.BlockSpec((bb, bk, hd), lambda bi, j: (bi, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_packed_kernel, scale=scale, causal=causal,
+                          bq=bq, kv_len=kv_len, d=d),
+        grid=(b // bb, tp // bk),
+        in_specs=[seq, kv_blk, kv_blk, seq, row_full, row_full],
+        out_specs=[kv_blk, kv_blk],
+        out_shape=[jax.ShapeDtypeStruct((b, tp, hd), k.dtype),
+                   jax.ShapeDtypeStruct((b, tp, hd), v.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_packed(q, k, v, scale, causal, block, interpret, d, kv_len=None):
+    o, _ = _fwd_packed(q, k, v, scale, causal, block, interpret, d, kv_len)
+    return o
+
+
+def _flash_packed_fwd(q, k, v, scale, causal, block, interpret, d, kv_len=None):
+    o, lse = _fwd_packed(q, k, v, scale, causal, block, interpret, d, kv_len)
+    return o, (q, k, v, o, lse)
+
+
+_flash_packed.defvjp(_flash_packed_fwd, _bwd_packed)
+
+
+# ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
@@ -280,9 +494,17 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, block: int = DEFAULT_BLOCK,
-                    interpret: bool | None = None) -> jnp.ndarray:
+                    interpret: bool | None = None,
+                    layout: str = "bh") -> jnp.ndarray:
     """Fused attention. q/k/v: [B, T, H, D] (same convention as
     ring_attention); differentiable via the flash backward kernels.
+
+    ``layout`` picks the HBM plumbing, never the math:
+    - ``"bh"``: flatten to [B·H, T, D] around the kernels (transposes +
+      reshapes each way — the rounds-3/4 path).
+    - ``"packed"``: free-reshape to [B, T, H·D] and slice heads in VMEM
+      lanes inside the kernels, so the transpose/reshape formatting
+      class disappears entirely (PERF.md r5, ViT).
 
     ``interpret`` defaults to True off-TPU so CPU CI runs the same code.
     """
@@ -305,6 +527,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     bq = min(block, tp)
     tp = -(-tp // bq) * bq
     kv_len = t if tp != t else None
+
+    if layout == "packed":
+        def pack(x):
+            x = x.reshape(b, t, h * d)        # row-major: free bitcast
+            return (jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+                    if kv_len is not None else x)
+
+        o = _flash_packed(pack(q), pack(k), pack(v), scale, causal, block,
+                          interpret, d, kv_len)
+        return o[:, :t].reshape(b, t, h, d)
 
     def flat(x):
         x = x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])

@@ -113,10 +113,12 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
     positions = jnp.arange(start, total - 1, dtype=jnp.int32)
     if start >= total - 1:
         return buf
-    if cfg.moe_experts == 0:
+    if cfg.moe_experts == 0 and cfg.scan_layers:
         # fast path: explicit per-layer cache buffers carried through the
-        # scan (see _decode_scan). The flax path below routes the stacked
-        # cache through nn.scan's variable mechanics, which unstacks
+        # scan (see _decode_scan; it indexes the nn.scan-STACKED param/
+        # cache layout, so unrolled scan_layers=False configs use the
+        # flax path). The flax path below routes the stacked cache
+        # through nn.scan's variable mechanics, which unstacks
         # (dynamic-slice), restacks (DUS into a fresh buffer) and copies
         # the full [L,B,S,H,D] cache every token — profiled at ~19 of the
         # 27 ms/token at d2048/L4/b8 (PERF.md round 5).

@@ -68,6 +68,21 @@ class TransformerConfig:
                                 # MXU dispatches — wins at small d_model)
     flash_block: int = 0        # 0 = auto (DEFAULT_BLOCK/128 by seq);
                                 # else the flash kernel block size
+    flash_layout: str = "bh"    # "bh": flatten heads into the batch dim
+                                # around the kernels; "packed": feed
+                                # [B,T,H·D] straight in (heads sliced in
+                                # VMEM lanes; kills the transpose/reshape
+                                # formatting class — the ViT winner,
+                                # PERF.md r5)
+    scan_layers: bool = True    # False: python-unrolled layers (params
+                                # named layers_0..layers_{n-1}, NOT
+                                # stacked). Kills nn.scan's saved-dot
+                                # stack DUS traffic at the cost of n×
+                                # compile time — probed for ViT (PERF.md
+                                # r5); keep True for deep models and
+                                # anything that checkpoints stacked
+                                # params (decode fast path assumes
+                                # stacked too).
 
     @property
     def head_dim(self) -> int:
@@ -186,7 +201,8 @@ class Attention(nn.Module):
         elif (blk := self._flash_block(q.shape[1])) is not None:
             from kubeoperator_tpu.workloads.flash_attention import flash_attention
             out = checkpoint_name(
-                flash_attention(q, k, v, causal=cfg.causal, block=blk),
+                flash_attention(q, k, v, causal=cfg.causal, block=blk,
+                                layout=cfg.flash_layout),
                 "attn_out")
         else:
             out = checkpoint_name(
@@ -240,11 +256,28 @@ class Block(nn.Module):
         return x, None
 
 
+class _UnrolledBlocks(nn.Module):
+    """Python-unrolled layer stack (``scan_layers=False``): separate
+    per-layer params, no scan-carried save stacks."""
+    cfg: TransformerConfig
+    mesh: Any = None
+    block: Any = Block
+
+    @nn.compact
+    def __call__(self, x, positions):
+        for i in range(self.cfg.n_layers):
+            x, _ = self.block(self.cfg, self.mesh, name=f"layers_{i}")(
+                x, positions)
+        return x, None
+
+
 def stack_blocks(cfg: TransformerConfig, mesh: Any, name: str = "layers"):
     """The shared block-stacking recipe: ``nn.scan`` puts layer params on a
     leading 'layers' axis (one traced body for all depths — compile time
     and HBM stay flat as n_layers grows), optionally under selective remat.
-    Used by the decoder LM and the ViT encoder alike."""
+    Used by the decoder LM and the ViT encoder alike.
+    ``cfg.scan_layers=False`` unrolls instead (no stacked-save DUS
+    traffic; per-layer param names)."""
     block = Block
     if cfg.remat:
         cp = jax.checkpoint_policies
@@ -257,6 +290,8 @@ def stack_blocks(cfg: TransformerConfig, mesh: Any, name: str = "layers"):
             "all": None,
         }[cfg.remat_policy]
         block = nn.remat(Block, prevent_cse=False, policy=policy)
+    if not cfg.scan_layers:
+        return _UnrolledBlocks(cfg, mesh, block=block, name=name)
     return nn.scan(
         block, variable_axes={"params": 0, "cache": 0},
         split_rngs={"params": True},

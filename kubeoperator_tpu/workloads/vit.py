@@ -35,14 +35,15 @@ class ViTConfig:
     num_classes: int = 1000
     image_size: int = 224
     patch: int = 16
-    # attention="flash" + flash_block=256 + remat_policy="dots+attn" is the
-    # measured v5e optimum for ViT-B/16 b128 (PERF.md round 4: dense 31.6%
-    # -> 35.5% MFU with the bb-batched kernel, key-masked 196->256 padding,
-    # and the attention output pinned across the remat boundary)
+    # Measured v5e optimum for ViT-B/16 b128 (PERF.md round 5): the packed
+    # [B,T,H·D] flash kernels (no transpose/pad formatting) + python-
+    # unrolled layers (no nn.scan save-stack DUS traffic) on top of the r4
+    # recipe (bb-batched kernels, key-masked 196->256 padding, attention
+    # output pinned across the remat boundary): 35.5% -> 47.2% MFU.
     encoder: TransformerConfig = field(default_factory=lambda: TransformerConfig(
         d_model=768, n_heads=12, n_layers=12, d_ff=3072, causal=False,
         max_seq_len=(224 // 16) ** 2, attention="flash", flash_block=256,
-        remat_policy="dots+attn"))
+        remat_policy="dots+attn", flash_layout="packed", scan_layers=False))
 
     @property
     def seq_len(self) -> int:
