@@ -76,8 +76,9 @@ def parse_mesh(arg: str | None, n_devices: int):
     auto_axis = None
     for part in arg.split(","):
         name, _, val = part.strip().partition(":")
-        if name not in ("dp", "fsdp", "ep", "tp", "sp"):
-            raise SystemExit(f"unknown mesh axis {name!r} (want dp/fsdp/ep/tp/sp)")
+        if name not in ("dp", "fsdp", "pp", "ep", "tp", "sp"):
+            raise SystemExit(
+                f"unknown mesh axis {name!r} (want dp/fsdp/pp/ep/tp/sp)")
         if val == "auto":
             if auto_axis:
                 raise SystemExit("only one mesh axis may be 'auto'")
@@ -475,6 +476,71 @@ def cmd_llm(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_pipeline(args: argparse.Namespace) -> int:
+    """Device-pipelined MLP LM over a real ``pp`` mesh axis (GPipe
+    fill/drain, pipeline.gpipe_loss_fn) — the pipeline-parallel
+    launchable. Composes with dp: ``--mesh dp:2,pp:4``."""
+    dist = maybe_initialize_distributed()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.workloads import pipeline as pipe
+    from kubeoperator_tpu.workloads.sharding import build_mesh
+
+    devices = jax.devices()
+    spec = parse_mesh(args.mesh or f"pp:{len(devices)}", len(devices))
+    if spec.pp < 2:
+        raise SystemExit("the pipeline job needs a pp axis >= 2 "
+                         "(e.g. --mesh dp:2,pp:4); for the scan-over-"
+                         "stages stance use the llm job instead")
+    mesh = build_mesh(spec, devices)
+    d, vocab = args.d_model, args.vocab
+    ks = jax.random.split(jax.random.key(args.seed), spec.pp + 2)
+    params = {
+        "embed": jax.device_put(
+            jax.random.normal(ks[0], (vocab, d)) * 0.1,
+            NamedSharding(mesh, P())),
+        "stages": jax.device_put(
+            pipe.stack_stages([
+                {"w1": jax.random.normal(jax.random.split(k)[0], (d, d)) * 0.1,
+                 "w2": jax.random.normal(jax.random.split(k)[1], (d, d)) * 0.1}
+                for k in ks[1:-1]]),
+            NamedSharding(mesh, P("pp"))),
+        "head": jax.device_put(
+            jax.random.normal(ks[-1], (d, vocab)) * 0.1,
+            NamedSharding(mesh, P())),
+    }
+    loss_fn = pipe.gpipe_loss_fn(
+        mesh,
+        embed_fn=lambda e, t: e[t],
+        stage_fn=lambda p, h: h + jnp.tanh(h @ p["w1"]) @ p["w2"],
+        head_fn=lambda p, h: h @ p,
+        loss_fn=lambda out, y: -jax.nn.log_softmax(out)[
+            jnp.arange(y.shape[0]), y],
+        n_micro=args.microbatches)
+
+    @jax.jit
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        return jax.tree.map(lambda p, g: p - args.lr * g, params, grads), loss
+
+    batch = args.batch or args.microbatches * max(1, spec.dp * spec.fsdp)
+    x = jax.random.randint(jax.random.key(1), (batch,), 0, vocab)
+    y = jax.random.randint(jax.random.key(2), (batch,), 0, vocab)
+    for i in range(args.steps):
+        params, loss = step(params, x, y)
+        if (i + 1) % max(1, args.steps // 5) == 0:
+            emit({"job": "pipeline", "step": i + 1,
+                  "loss": round(float(loss), 4)})
+    emit({"job": "pipeline", "done": True, "mesh": dict(spec.sizes()),
+          "stages": spec.pp, "microbatches": args.microbatches,
+          "bubble_fraction": round((spec.pp - 1)
+                                   / (args.microbatches + spec.pp - 1), 3),
+          **dist})
+    return 0
+
+
 # -- CLI -----------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -532,6 +598,17 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="dynamic batcher: wait after first request")
 
+    pp = sub.add_parser("pipeline",
+                        help="device-pipelined training over a pp mesh axis")
+    pp.add_argument("--mesh", help="e.g. dp:2,pp:4 (default pp:<all devices>)")
+    pp.add_argument("--steps", type=int, default=10)
+    pp.add_argument("--batch", type=int, default=0)
+    pp.add_argument("--microbatches", type=int, default=4)
+    pp.add_argument("--d-model", type=int, default=64)
+    pp.add_argument("--vocab", type=int, default=256)
+    pp.add_argument("--lr", type=float, default=0.1)
+    pp.add_argument("--seed", type=int, default=0)
+
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
     lm.add_argument("--steps", type=int, default=100)
     lm.add_argument("--seq-len", type=int, default=2048)
@@ -562,7 +639,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 COMMANDS = {"smoke": cmd_smoke, "mnist": cmd_mnist,
             "resnet50": cmd_resnet50, "vit": cmd_vit, "llm": cmd_llm,
-            "serve": cmd_serve}
+            "serve": cmd_serve, "pipeline": cmd_pipeline}
 
 
 def main(argv: list[str] | None = None) -> int:

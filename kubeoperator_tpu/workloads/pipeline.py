@@ -1,33 +1,41 @@
-"""Pipeline parallelism, the TPU-idiomatic way: scan over stacked stages.
+"""Pipeline parallelism: the scan-over-stages stance AND a real ``pp``
+mesh axis with a microbatched schedule.
 
-On GPU clusters pipeline parallelism assigns layer ranges to different
-devices and streams microbatches between them (GPipe/1F1B) because
-cross-device bandwidth is scarce. On a TPU mesh the same memory goal —
-don't hold every layer's activations at once — is met *inside* the
-fsdp/tp mesh, with no ``pp`` axis at all (sharding.py's documented
-stance):
+Two implementations, because TPU changes which one you should want:
 
-* stage parameters are stacked on a leading axis and the forward is a
-  single ``lax.scan`` over it → one compiled stage body regardless of
-  depth (compile time O(1) in depth);
-* ``jax.checkpoint`` (remat) on the stage body gives the
-  activation-memory profile pipelining buys, trading recompute on the
-  backward pass instead of bubble time on the forward;
-* the stacked parameters still shard over ``fsdp``/``tp`` like any other
-  weight, so ZeRO-3 gathers and megatron splits compose with it.
+1. **Scan over stacked stages** (``scan_stages``): every device-set runs
+   every layer; stage params are stacked on a leading axis and the
+   forward is one ``lax.scan`` under remat. This buys the
+   activation-memory profile pipelining exists for, with NO bubble and
+   no schedule to tune — the TPU-preferred answer when stages fit
+   (transformer.py's ``nn.scan`` is exactly this shape).
 
-There is no pipeline bubble and no microbatch schedule to tune — XLA sees
-one dense loop. The transformer (transformer.py) uses exactly this shape
-via ``nn.scan``; this module exposes the raw primitive for non-flax
-pytrees plus a reference two-phase (embed → stages → head) runner.
+2. **Device pipelining over a ``pp`` mesh axis** (``gpipe_spmd_fn``):
+   stage params shard over ``pp`` (each device-set holds ONE stage), the
+   batch splits into M microbatches, and activations hop stage→stage
+   over ``jax.lax.ppermute`` inside a ``shard_map``. The schedule is the
+   GPipe fill/drain: T = M + S − 1 ticks, bubble fraction (S−1)/T.
+   Autodiff transposes the ``ppermute`` chain, so the backward runs the
+   reverse pipeline automatically; 1F1B's contribution over GPipe —
+   bounding live activations to ~S microbatches instead of M — is
+   delivered here by ``jax.checkpoint`` on the stage body instead of by
+   schedule interleaving (recompute is the TPU-idiomatic currency for
+   that memory, same trade the scan stance makes).
+
+Use (2) when a single stage's params genuinely cannot fit a device-set
+even under ZeRO-3 — e.g. cross-slice scale-out where fsdp gathers would
+ride DCN; the pp hops are one [mb, …] activation per tick, the cheapest
+thing you can put on a slow link. Otherwise use (1).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 def stack_stages(stage_params: list[Any]) -> Any:
@@ -72,3 +80,94 @@ def pipeline_forward(embed_fn: Callable, stage_fn: Callable, head_fn: Callable,
     h = embed_fn(params["embed"], x)
     h = scan_stages(stage_fn, params["stages"], h, remat=remat)
     return head_fn(params["head"], h)
+
+
+# ---------------------------------------------------------------------------
+# real device pipelining: pp mesh axis + microbatch schedule + ppermute
+# ---------------------------------------------------------------------------
+
+def gpipe_loss_fn(mesh, embed_fn: Callable, stage_fn: Callable,
+                  head_fn: Callable, loss_fn: Callable, n_micro: int,
+                  axis: str = "pp", remat: bool = True) -> Callable:
+    """Build ``loss(params, x, y) -> scalar`` where the stage stack runs
+    device-pipelined over the mesh's ``axis``.
+
+    params = {"embed": replicated, "stages": stacked [S, ...] sharded on
+    axis 0 over ``axis``, "head": replicated}; ``embed_fn(p, x) -> h``;
+    ``stage_fn(p, h) -> h``; ``head_fn(p, h) -> out``;
+    ``loss_fn(out, y) -> per-example losses``. x/y: [B, ...] with B
+    divisible by n_micro (and the microbatch by the data axes).
+
+    Schedule: GPipe fill/drain over T = n_micro + S − 1 ticks. Each tick,
+    stage i applies its layer to the activation it received for
+    microbatch m = t − i (zeros ride the bubble slots and are discarded),
+    then every activation hops i → i+1 over a single ``ppermute``. The
+    last stage computes the per-microbatch loss; invalid ticks contribute
+    0. Autodiff transposes ppermute/scan into the reverse-order backward
+    pipeline; ``remat`` checkpoints the stage body so live activations
+    stay O(one stage) instead of O(n_micro) — the 1F1B memory bound via
+    recompute (module docstring).
+    """
+    from jax import shard_map
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    s = sizes[axis]
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in sizes)
+    stage = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def local_loss(stages_local, embed_p, head_p, x_mb, y_mb):
+        """Runs per device-set under shard_map: stages_local is [1, ...]
+        (this stage's slice), x_mb/y_mb are [M, mb_local, ...]."""
+        i = jax.lax.axis_index(axis)
+        p = jax.tree.map(lambda a: a[0], stages_local)
+        m_total = x_mb.shape[0]
+        # the scan carry varies per device (pp stage index, dp data shard);
+        # shard_map's varying-manual-axes typing wants the INITIAL carry
+        # marked the same way
+        state0 = jax.lax.pcast(jnp.zeros_like(embed_fn(embed_p, x_mb[0])),
+                               (axis,), to="varying")
+        loss0 = jax.lax.pcast(jnp.float32(0), data_axes + (axis,),
+                              to="varying")
+
+        def tick(carry, t):
+            state, loss_sum = carry
+            m = t - i                         # microbatch this stage holds
+            valid = (0 <= m) & (m < m_total)
+            # stage 0 ingests a fresh microbatch; others use the hop input
+            xt = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m_total - 1), keepdims=False)
+            inp = jnp.where(i == 0, embed_fn(embed_p, xt), state)
+            h = stage(p, inp)
+            # last stage scores its (valid) microbatch
+            yt = jax.lax.dynamic_index_in_dim(
+                y_mb, jnp.clip(m, 0, m_total - 1), keepdims=False)
+            losses = loss_fn(head_fn(head_p, h), yt)
+            take = ((i == s - 1) & valid).astype(losses.dtype)
+            loss_sum = loss_sum + take * jnp.sum(losses)
+            # one hop: stage i's output becomes stage i+1's next input
+            state = jax.lax.ppermute(
+                h, axis, [(j, (j + 1) % s) for j in range(s)])
+            return (state, loss_sum), None
+
+        (_, loss_sum), _ = jax.lax.scan(
+            tick, (state0, loss0), jnp.arange(m_total + s - 1))
+        # summed loss across stages and data shards; every example is
+        # scored exactly once, so the caller divides by the global batch
+        return jax.lax.psum(loss_sum, (axis,) + data_axes)
+
+    data_spec = P(None, data_axes if data_axes else None)
+
+    def loss(params, x, y):
+        b = x.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+        xm = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+        ym = y.reshape(n_micro, b // n_micro, *y.shape[1:])
+        total = shard_map(
+            local_loss, mesh=mesh,
+            in_specs=(P(axis), P(), P(), data_spec, data_spec),
+            out_specs=P(),
+        )(params["stages"], params["embed"], params["head"], xm, ym)
+        return total / b
+
+    return loss

@@ -18,9 +18,12 @@ Axes (any may be size 1 and is then omitted from the mesh):
              token dispatch/combine is an all-to-all GSPMD derives from the
              expert-weight shardings, so it belongs on ICI like tp/sp.
 
-There is no ``pp`` mesh axis: pipeline parallelism on TPU is expressed as a
-``jax.lax.scan`` over stacked layer params inside the fsdp/tp mesh (see
-``workloads/pipeline.py``), not as a separate device dimension.
+* ``pp``   — pipeline parallel: stages shard over ``pp``, microbatch
+             activations hop stage→stage over ``ppermute``
+             (``workloads/pipeline.py gpipe_*``). The TPU-preferred
+             alternative for depth is still the scan-over-stages stance
+             (one device-set runs every layer under remat, no bubble) —
+             keep ``pp=1`` unless a single stage genuinely cannot fit.
 """
 
 from __future__ import annotations
@@ -38,6 +41,7 @@ class MeshSpec:
     """Parallelism degrees. Product must equal the device count."""
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
     ep: int = 1
     tp: int = 1
     sp: int = 1
@@ -47,12 +51,12 @@ class MeshSpec:
         return tuple(n for n, s in self.sizes() if s > 1) or ("dp",)
 
     def sizes(self) -> tuple[tuple[str, int], ...]:
-        return (("dp", self.dp), ("fsdp", self.fsdp), ("ep", self.ep),
-                ("tp", self.tp), ("sp", self.sp))
+        return (("dp", self.dp), ("fsdp", self.fsdp), ("pp", self.pp),
+                ("ep", self.ep), ("tp", self.tp), ("sp", self.sp))
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.ep * self.tp * self.sp
+        return self.dp * self.fsdp * self.pp * self.ep * self.tp * self.sp
 
     @property
     def data_axes(self) -> tuple[str, ...]:
