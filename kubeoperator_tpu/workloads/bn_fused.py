@@ -1,0 +1,271 @@
+"""Fused (1×1 conv → BatchNorm → relu) backward as a two-phase Pallas unit.
+
+The round-4 bytes audit (PERF.md) showed the ResNet step bandwidth-
+saturated at 43.4 GB/step with no fat op to fix: in backward, every large
+activation has 3–5 consumers XLA cannot fuse into one pass (the dβ/dγ
+stat reduces, the dy elementwise formation, the dInput conv, the dW dot,
+the relu mask), and each re-streams its operands from HBM. This module
+removes whole passes for the (1×1, stride-1) conv+BN(+relu) neighborhoods
+by computing the ENTIRE backward in one pallas_call with a (2, N/tb)
+grid:
+
+* phase 0 streams (g, y) once, accumulating Σg′ and Σg′·x̂ (g′ = g after
+  the relu gate) into the dβ/dγ output blocks, which stay VMEM-resident
+  across the whole grid (their index map is constant);
+* phase 1 streams (g, y, x) once more, forms dy = γσ⁻¹(g′ − Σg′/N −
+  x̂·Σg′x̂/N) in registers and feeds it to both MXU dots — dx = dy·Wᵀ
+  written per block, dW = xᵀ·dy accumulated in its resident output block.
+
+HBM traffic per neighborhood: 2 reads of (g, y) + 1 read of x + 1 write
+of dx ≈ 1.3 GB for the stage-1 64→256 unit, vs ~2.0 GB for the separate
+XLA ops (the +3–4 % MFU lever costed in PERF.md round 4). Operands are
+wrapped in the logical transpose matching the conv emitter's physical
+layout ({3,0,2,1} → [H,W,B,C] row-major) so the pallas custom call's
+row-major requirement compiles to a bitcast instead of the 0.3–0.6 ms
+per-operand copies that killed the round-3 kernels
+(`scripts/perf_bitcast_probe.py`).
+
+No reference counterpart: the reference control plane has no training
+code (SURVEY.md §2.10); this is TPU kernel engineering on the bundled
+flagship workload.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _bn_bwd_kernel(x_ref, g_ref, y_ref, w_ref, gamma_ref, beta_ref, mu_ref,
+                   inv_ref, dx_ref, dw_ref, dgamma_ref, dbeta_ref, *,
+                   relu: bool, inv_n: float):
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    g = g_ref[...].astype(jnp.float32)                         # [TB, Co]
+    yv = y_ref[...].astype(jnp.float32)
+    gamma, beta = gamma_ref[...], beta_ref[...]                # [Co] f32
+    mu, inv = mu_ref[...], inv_ref[...]
+    xhat = (yv - mu[None, :]) * inv[None, :]
+    if relu:
+        # the gate must mirror the forward's cast exactly: pre-activation
+        # is formed in f32 and rounded to the model dtype BEFORE relu.
+        # The comparison itself runs in f32 (bf16→f32 is exact; Mosaic on
+        # v5e rejects bf16 compares: "Target does not support this
+        # comparison")
+        pre = (gamma[None, :] * xhat + beta[None, :]).astype(
+            g_ref.dtype).astype(jnp.float32)
+        gact = jnp.where(pre > 0, g, 0.0)
+    else:
+        gact = g
+
+    @pl.when(p == 0)
+    def _phase0():
+        sg = jnp.sum(gact, axis=0)
+        sgx = jnp.sum(gact * xhat, axis=0)
+
+        @pl.when(i == 0)
+        def _():
+            dbeta_ref[...] = sg
+            dgamma_ref[...] = sgx
+
+        @pl.when(i > 0)
+        def _():
+            dbeta_ref[...] = dbeta_ref[...] + sg
+            dgamma_ref[...] = dgamma_ref[...] + sgx
+
+    @pl.when(p == 1)
+    def _phase1():
+        sg = dbeta_ref[...]                    # complete after phase 0
+        sgx = dgamma_ref[...]
+        dy = ((gamma * inv)[None, :]
+              * (gact - (sg * inv_n)[None, :]
+                 - xhat * (sgx * inv_n)[None, :])).astype(x_ref.dtype)
+        dx_ref[...] = lax.dot_general(
+            dy, w_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dx_ref.dtype)
+        part = lax.dot_general(
+            x_ref[...], dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(i == 0)
+        def _():
+            dw_ref[...] = part
+
+        @pl.when(i > 0)
+        def _():
+            dw_ref[...] = dw_ref[...] + part
+
+
+def conv_bn_relu_bwd(x: jnp.ndarray, g: jnp.ndarray, y: jnp.ndarray,
+                     w: jnp.ndarray, gamma: jnp.ndarray, beta: jnp.ndarray,
+                     mu: jnp.ndarray, inv: jnp.ndarray, relu: bool,
+                     interpret: bool | None = None):
+    """Two-phase fused backward. x: [B,H,W,Ci] conv input; g: [B,H,W,Co]
+    upstream grad (post-relu); y: [B,H,W,Co] conv output (pre-BN);
+    w: [Ci,Co]; gamma/beta/mu/inv: [Co] f32 (inv = rsqrt(var+eps)).
+    Returns (dx [B,H,W,Ci], dw [Ci,Co] f32, dgamma [Co], dbeta [Co])."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, h, wd, ci = x.shape
+    co = g.shape[-1]
+    n = b * h * wd
+
+    # logical [H,W,B,C] view: row-major of this permutation equals the conv
+    # emitter's physical {3,0,2,1} layout, so the custom call's row-major
+    # operand requirement is satisfied by a BITCAST, not a copy
+    def hwbc(a):
+        return jnp.transpose(a, (1, 2, 0, 3)).reshape(n, a.shape[-1])
+
+    x2, g2, y2 = hwbc(x), hwbc(g), hwbc(y)
+    # row chunk: streams double-buffered in ~8 MB alongside the resident
+    # w / dW / stat blocks (the stage-4 2048-channel units need the
+    # resident share subtracted from the budget)
+    pad = lambda c: -(-c // 128) * 128
+    stream_per_row = 2 * 2 * (2 * pad(ci) + 2 * pad(co))
+    resident = 2 * pad(ci) * pad(co) + 4 * pad(ci) * pad(co)
+    budget = max(8 * 1024 * 1024 - 2 * resident, 1 * 1024 * 1024)
+    tb = 128
+    while tb < 8192 and n % (tb * 2) == 0 and (tb * 2) * stream_per_row <= budget:
+        tb *= 2
+    if n % tb:
+        raise ValueError(f"N={n} not divisible by row chunk {tb}; "
+                         "caller must fall back to the unfused path")
+
+    kernel = functools.partial(_bn_bwd_kernel, relu=relu, inv_n=1.0 / n)
+    vec = lambda: pl.BlockSpec((co,), lambda p, i: (0,))
+    dx2, dw, dgamma, dbeta = pl.pallas_call(
+        kernel,
+        grid=(2, n // tb),
+        in_specs=[
+            # x is only consumed in phase 1: park the pipeline on block 0
+            # during phase 0 (index i·p) so it isn't streamed twice
+            pl.BlockSpec((tb, ci), lambda p, i: (i * p, 0)),
+            pl.BlockSpec((tb, co), lambda p, i: (i, 0)),
+            pl.BlockSpec((tb, co), lambda p, i: (i, 0)),
+            pl.BlockSpec((ci, co), lambda p, i: (0, 0)),
+            vec(), vec(), vec(), vec(),
+        ],
+        out_specs=[
+            pl.BlockSpec((tb, ci), lambda p, i: (i * p, 0)),
+            pl.BlockSpec((ci, co), lambda p, i: (0, 0)),
+            vec(), vec(),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, ci), x.dtype),
+            jax.ShapeDtypeStruct((ci, co), jnp.float32),
+            jax.ShapeDtypeStruct((co,), jnp.float32),
+            jax.ShapeDtypeStruct((co,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, g2, y2, w, gamma, beta, mu, inv)
+    dx = dx2.reshape(h, wd, b, ci).transpose(2, 0, 1, 3)
+    return dx, dw, dgamma, dbeta
+
+
+def _forward_math(x, kernel4, gamma, beta, eps, relu, mean=None, var=None):
+    """The ONE copy of the conv → stats → normalize → relu forward, shared
+    by the custom-VJP primal, the small-shape autodiff fallback, and the
+    eval (running-average) path so the three stay numerically identical.
+    mean/var default to batch statistics. Returns (out, mu, var, y, inv).
+    """
+    y = lax.conv_general_dilated(x, kernel4, (1, 1), "SAME",
+                                 dimension_numbers=_DIMNUMS)
+    yf = y.astype(jnp.float32)
+    if mean is None:
+        mean = jnp.mean(yf, axis=(0, 1, 2))
+        var = jnp.mean(jnp.square(yf), axis=(0, 1, 2)) - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    pre = ((yf - mean) * (gamma * inv) + beta).astype(x.dtype)
+    out = jnp.maximum(pre, 0) if relu else pre
+    return out, mean, var, y, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_train(x, w, gamma, beta, relu: bool, eps: float):
+    return _fused_fwd(x, w, gamma, beta, relu, eps)[0]
+
+
+def _fused_fwd(x, w, gamma, beta, relu: bool, eps: float):
+    out, mu, var, y, inv = _forward_math(x, w[None, None], gamma, beta,
+                                         eps, relu)
+    return (out, mu, var), (x, w, y, gamma, beta, mu, inv)
+
+
+def _fused_bwd(relu: bool, eps: float, res, cts):
+    x, w, y, gamma, beta, mu, inv = res
+    g, _, _ = cts          # mu/var outputs feed stop_gradient'd stat updates
+    dx, dw, dgamma, dbeta = conv_bn_relu_bwd(
+        x, g, y, w, gamma, beta, mu, inv, relu)
+    return dx, dw.astype(w.dtype), dgamma, dbeta
+
+
+# real primal: forward pass + batch stats (for the running-stat update)
+def fused_conv_bn(x, w, gamma, beta, relu: bool = True, eps: float = 1e-5):
+    """Differentiable fused (1×1 conv → BN(batch stats) → optional relu).
+    Returns (out, mu, var); gradients flow to x/w/gamma/beta through the
+    two-phase pallas backward. mu/var are auxiliary (running-stat update —
+    stop-gradient them at the call site)."""
+    return _fused_train(x, w, gamma, beta, relu, eps)
+
+
+_fused_train.defvjp(_fused_fwd, _fused_bwd)
+
+
+class FusedConvBN(nn.Module):
+    """(1×1 stride-1 conv, no bias) + BatchNorm + optional relu with the
+    two-phase pallas backward. Parameter/stat layout mirrors
+    nn.Conv("kernel") + nn.BatchNorm("scale"/"bias", batch_stats
+    "mean"/"var") so the pair is interchangeable with the unfused modules
+    up to the module-name level."""
+
+    features: int
+    relu: bool = True
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    scale_init: Callable = nn.initializers.ones_init()
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        ci = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (1, 1, ci, self.features))
+        gamma = self.param("scale", self.scale_init, (self.features,),
+                           jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((self.features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((self.features,), jnp.float32))
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        b, h, wd, _ = x.shape
+        if self.use_running_average:
+            out, *_ = _forward_math(x, kernel, gamma, beta, self.epsilon,
+                                    self.relu, mean=ra_mean.value,
+                                    var=ra_var.value)
+            return out
+        if (b * h * wd) % 128:
+            # the pallas kernel's row chunking needs N % 128 == 0; tiny
+            # shapes (unit tests, smoke configs) take the same forward
+            # math under standard autodiff instead
+            out, mu, var, _, _ = _forward_math(x, kernel, gamma, beta,
+                                               self.epsilon, self.relu)
+        else:
+            out, mu, var = fused_conv_bn(x, kernel[0, 0], gamma, beta,
+                                         relu=self.relu, eps=self.epsilon)
+        if not self.is_initializing():
+            mu, var = lax.stop_gradient(mu), lax.stop_gradient(var)
+            ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mu
+            ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        return out

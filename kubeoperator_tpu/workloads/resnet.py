@@ -53,25 +53,45 @@ class BottleneckBlock(nn.Module):
     norm: ModuleDef
     pad_to: int = 0       # lift the bottleneck width to this many channels
                           # (zero-masked back to `features` — see mask_channels)
+    fused: ModuleDef = None  # FusedConvBN ctor for (1×1, stride-1)
+                             # conv+BN(+relu) neighborhoods (bn_fused.py);
+                             # None = unfused XLA ops
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         residual = x
         width = max(self.features, self.pad_to)
-        y = self.conv(width, (1, 1))(x)
-        y = self.norm()(y)
-        y = mask_channels(nn.relu(y), self.features)
+        # the fused backward pays off where activations are large and
+        # channels narrow (stage 1's 56×56 neighborhoods, the r4 bytes
+        # audit's costed target); deeper stages have wide resident W/dW
+        # blocks that shrink the kernel's row chunks
+        fused = (self.fused if self.fused is not None
+                 and x.shape[1] * x.shape[2] >= 3136 else None)
+        if fused is not None:
+            y = fused(width, relu=True)(x)
+        else:
+            y = self.conv(width, (1, 1))(x)
+            y = self.norm()(y)
+            y = mask_channels(nn.relu(y), self.features)
         # v1.5: stride lives on the 3x3, not the 1x1 — better accuracy, same cost
         y = self.conv(width, (3, 3), strides=(self.strides, self.strides))(y)
         y = self.norm()(y)
         y = mask_channels(nn.relu(y), self.features)
-        y = self.conv(self.features * 4, (1, 1))(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if fused is not None:
+            y = fused(self.features * 4, relu=False,
+                      scale_init=nn.initializers.zeros_init())(y)
+        else:
+            y = self.conv(self.features * 4, (1, 1))(y)
+            y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.features * 4, (1, 1),
-                                 strides=(self.strides, self.strides),
-                                 name="proj_conv")(residual)
-            residual = self.norm(name="proj_bn")(residual)
+            if fused is not None and self.strides == 1:
+                residual = fused(self.features * 4, relu=False,
+                                 name="proj_fused")(residual)
+            else:
+                residual = self.conv(self.features * 4, (1, 1),
+                                     strides=(self.strides, self.strides),
+                                     name="proj_conv")(residual)
+                residual = self.norm(name="proj_bn")(residual)
         return nn.relu(residual + y)
 
 
@@ -125,6 +145,13 @@ class ResNet(nn.Module):
                                      # 49→59 ms/step); kept default-off as
                                      # the documented probe. Bottleneck
                                      # (depth>=50) only.
+    fused_bn: bool = False           # two-phase pallas backward for the
+                                     # (1×1, stride-1) conv+BN(+relu)
+                                     # neighborhoods (bn_fused.FusedConvBN):
+                                     # one fused unit replaces the 3-5
+                                     # separate HBM passes XLA emits.
+                                     # Bottleneck only; incompatible with
+                                     # pad_min_channels.
 
     def _conv_ctor(self) -> ModuleDef:
         """nn.Conv, or the custom-VJP conv for small kernels (PERF.md: the
@@ -152,6 +179,18 @@ class ResNet(nn.Module):
                        epsilon=1e-5, dtype=self.dtype, axis_name=None)
         block = BottleneckBlock if self.depth >= 50 else BasicBlock
 
+        fused = None
+        if self.fused_bn:
+            if self.pad_min_channels:
+                raise ValueError("fused_bn is incompatible with "
+                                 "pad_min_channels (mask semantics)")
+            if self.depth < 50:
+                raise ValueError("fused_bn requires depth >= 50 "
+                                 "(bottleneck blocks)")
+            from kubeoperator_tpu.workloads.bn_fused import FusedConvBN
+            fused = partial(FusedConvBN, dtype=self.dtype,
+                            use_running_average=not train)
+
         x = x.astype(self.dtype)
         if self.pad_min_channels and self.depth < 50:
             # BasicBlock has no pad_to: a widened stem would make stage-0
@@ -175,7 +214,7 @@ class ResNet(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(STAGE_SIZES[self.depth]):
             for i in range(n_blocks):
-                kw = ({"pad_to": self.pad_min_channels}
+                kw = ({"pad_to": self.pad_min_channels, "fused": fused}
                       if block is BottleneckBlock else {})
                 x = block(features=self.width * 2 ** stage,
                           strides=2 if stage > 0 and i == 0 else 1,

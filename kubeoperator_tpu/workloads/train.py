@@ -65,6 +65,8 @@ class TrainConfig:
                                      # up to this size (see workloads/conv_vjp.py)
     conv_bwd: str = "dot"            # "dot" | "pallas" | "dot2" (conv_vjp.make_conv)
     pad_min_channels: int = 0        # compute-pad C<this activations (resnet.py)
+    fused_bn: bool = False           # two-phase pallas conv+BN backward
+                                     # for 1×1/s1 neighborhoods (bn_fused.py)
 
 
 @dataclass
@@ -180,7 +182,8 @@ class Trainer:
                                    stem=self.cfg.stem,
                                    dw_dot_max_k=self.cfg.dw_dot_max_k,
                                    conv_bwd=self.cfg.conv_bwd,
-                                   pad_min_channels=self.cfg.pad_min_channels)
+                                   pad_min_channels=self.cfg.pad_min_channels,
+                                   fused_bn=self.cfg.fused_bn)
         self.tx = make_optimizer(self.cfg)
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None
