@@ -318,6 +318,84 @@ async def list_executions(request: web.Request) -> web.Response:
     exs.sort(key=lambda e: e.created_at, reverse=True)
     return web.json_response([dump(e) for e in exs])
 
+async def openapi_schema(request: web.Request) -> web.Response:
+    """Machine-readable OpenAPI 3.0 document for the whole REST surface
+    (reference ships swagger via drf-yasg, ``kubeoperator/urls.py``).
+    Generated LIVE from the aiohttp route table — every registered
+    route appears with its handler docstring's first line as summary, so
+    the schema cannot drift from the implementation."""
+    import re as _re
+
+    from kubeoperator_tpu.version import __version__
+
+    paths: dict[str, dict] = {}
+    for route in request.app.router.routes():
+        if route.method in ("HEAD", "OPTIONS") or route.resource is None:
+            continue
+        info = route.resource.get_info()
+        path = info.get("path") or info.get("formatter") or ""
+        if not path.startswith("/api/"):
+            continue
+        doc = (route.handler.__doc__ or "").strip().split("\n")[0]
+        op: dict[str, Any] = {
+            "summary": doc or route.handler.__name__,
+            "operationId": f"{route.method.lower()}_{route.handler.__name__}",
+            "responses": {"200": {"description": "success"}},
+            "security": [{"bearer": []}],
+        }
+        params = _re.findall(r"{([a-zA-Z_]+)}", path)
+        if params:
+            op["parameters"] = [
+                {"name": p, "in": "path", "required": True,
+                 "schema": {"type": "string"}} for p in params]
+        paths.setdefault(path, {})[route.method.lower()] = op
+    return web.json_response({
+        "openapi": "3.0.3",
+        "info": {"title": "kubeoperator-tpu", "version": __version__,
+                 "description": "TPU-native cluster operations platform"},
+        "components": {"securitySchemes": {
+            "bearer": {"type": "http", "scheme": "bearer",
+                       "bearerFormat": "JWT"}}},
+        "paths": dict(sorted(paths.items())),
+    })
+
+
+def _dump_task(rec) -> dict:
+    return {"id": rec.id, "name": rec.name, "state": rec.state,
+            "error": rec.error, "started_at": rec.started_at,
+            "finished_at": rec.finished_at}
+
+
+async def tasks_monitor(request: web.Request) -> web.Response:
+    """Worker-pool monitor (flower parity, reference ``kubeops.py:197-213``
+    ships Flower for live Celery inspection): queue depth, per-state
+    counts, live beats, and the most recent task history across every
+    cluster. Admin-only — task names span all projects."""
+    require_admin(request)
+    platform: Platform = request.app["platform"]
+    try:
+        limit = max(0, int(request.query.get("limit", 100)))
+    except ValueError:
+        return json_error(400, "limit must be an integer")
+    state = request.query.get("state", "")
+    records = platform.tasks.records()
+    if state:
+        records = [r for r in records if r.state == state]
+    return web.json_response({
+        "summary": platform.tasks.summary(),
+        "tasks": [_dump_task(r) for r in records[:limit]],
+    })
+
+
+async def get_task(request: web.Request) -> web.Response:
+    require_admin(request)
+    platform: Platform = request.app["platform"]
+    rec = platform.tasks.tasks.get(request.match_info["id"])
+    if rec is None:
+        return json_error(404, "no such task")
+    return web.json_response(_dump_task(rec))
+
+
 async def create_execution(request: web.Request) -> web.Response:
     check_cluster_access(request, request.match_info["name"], write=True)
     body = await request.json()
@@ -620,16 +698,26 @@ async def delete_host(request: web.Request) -> web.Response:
     return web.json_response({"deleted": request.match_info["name"]})
 
 async def import_hosts(request: web.Request) -> web.Response:
-    """Bulk host import. The reference parses an Excel sheet
-    (``host_import.py:12-62``); openpyxl isn't in this image so the portal
-    uploads CSV with the same columns: name,ip,port,credential."""
+    """Bulk host import — .xlsx (reference parity, ``host_import.py:12-62``;
+    an operator migrating from KubeOperator uploads their existing Excel
+    workbook unchanged, parsed by the vendored minimal reader
+    ``utils/xlsx.py``) or CSV with the same columns:
+    name,ip,port,credential. Detected by the zip magic."""
     require_admin(request)
     platform: Platform = request.app["platform"]
-    text = (await request.read()).decode("utf-8-sig")
+    raw = await request.read()
+    if raw[:4] == b"PK\x03\x04":
+        from kubeoperator_tpu.utils import xlsx
+        try:
+            rows = xlsx.dict_rows(raw)
+        except ValueError as e:   # xlsx.py folds all parse failures here
+            return json_error(400, str(e))
+    else:
+        rows = list(csv.DictReader(io.StringIO(raw.decode("utf-8-sig"))))
     created, errors = [], []
 
     def _import():
-        for i, row in enumerate(csv.DictReader(io.StringIO(text))):
+        for i, row in enumerate(rows):
             try:
                 cred = platform.store.get_by_name(
                     Credential, (row.get("credential") or "").strip(), scoped=False)
@@ -644,6 +732,21 @@ async def import_hosts(request: web.Request) -> web.Response:
     await _sync(request, _import)
     return web.json_response({"created": created, "errors": errors},
                              status=201 if not errors else 207)
+
+
+async def host_import_template(request: web.Request) -> web.Response:
+    """Downloadable .xlsx import template (reference serves one via
+    openpyxl; here utils/xlsx.write_rows). Auth via the middleware like
+    every route."""
+    from kubeoperator_tpu.utils import xlsx
+    body = xlsx.write_rows([["name", "ip", "port", "credential"],
+                            ["node-1", "10.0.0.11", "22", "default-ssh"]])
+    return web.Response(
+        body=body,
+        content_type=("application/vnd.openxmlformats-officedocument"
+                      ".spreadsheetml.sheet"),
+        headers={"Content-Disposition":
+                 'attachment; filename="hosts-template.xlsx"'})
 
 
 # ---------------------------------------------------------------------------
@@ -1010,6 +1113,9 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/clusters/{name}/errorlogs", cluster_error_logs)
     r.add_get("/api/v1/executions/{id}", get_execution)
     r.add_post("/api/v1/executions/{id}/retry", retry_execution)
+    r.add_get("/api/v1/tasks", tasks_monitor)
+    r.add_get("/api/v1/tasks/{id}", get_task)
+    r.add_get("/api/v1/schema", openapi_schema)
     r.add_get("/api/v1/dashboard/{item}", dashboard)
     r.add_get("/api/v1/logs", search_system_logs)
     r.add_get("/api/v1/events", search_cluster_events)
@@ -1018,6 +1124,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_post("/api/v1/hosts", create_host)
     r.add_delete("/api/v1/hosts/{name}", delete_host)
     r.add_post("/api/v1/hosts/import", import_hosts)
+    r.add_get("/api/v1/hosts/import/template", host_import_template)
 
     register_crud(app, "/api/v1/credentials", Credential, create=_create_credential)
     r.add_post("/api/v1/providers/{provider}/discover", provider_discover)

@@ -166,6 +166,18 @@ data:
         regex: '([^:]+)(?::\\d+)?'
         replacement: "$1:8431"
         target_label: __address__
+    - job_name: ko-serve
+      # the jax-serve endpoint's batcher metrics (queue depth, fused
+      # batch histogram, request latency) on :8080/metrics
+      kubernetes_sd_configs: [{{role: pod}}]
+      relabel_configs:
+      - source_labels: [__meta_kubernetes_pod_label_app]
+        regex: jax-serve
+        action: keep
+      - source_labels: [__address__]
+        regex: '([^:]+)(?::\\d+)?'
+        replacement: "$1:8080"
+        target_label: __address__
 ---
 apiVersion: apps/v1
 kind: DaemonSet
@@ -260,7 +272,12 @@ data:
         "targets": [{{"expr": "100 * avg(tpu_tensorcore_utilization)"}}]}},
       {{"title": "Error log rate", "type": "timeseries", "gridPos": {{"x":0,"y":8,"w":12,"h":8}},
         "datasource": "Loki",
-        "targets": [{{"expr": "sum(rate({{namespace=~\\".+\\"}} |~ \\"(?i)error\\" [5m]))"}}]}}
+        "targets": [{{"expr": "sum(rate({{namespace=~\\".+\\"}} |~ \\"(?i)error\\" [5m]))"}}]}},
+      {{"title": "Serve queue depth", "type": "timeseries", "gridPos": {{"x":12,"y":8,"w":6,"h":8}},
+        "targets": [{{"expr": "avg(ko_serve_queue_depth)"}}]}},
+      {{"title": "Serve latency p95 / tokens rate", "type": "timeseries", "gridPos": {{"x":18,"y":8,"w":6,"h":8}},
+        "targets": [{{"expr": "avg(ko_serve_request_latency_seconds{{quantile=\\"0.95\\"}})"}},
+                    {{"expr": "sum(rate(ko_serve_tokens_generated_total[5m]))"}}]}}
     ]}}
 ---
 apiVersion: v1
@@ -532,6 +549,20 @@ spec:
   type: NodePort
   selector: {{app: jax-serve}}
   ports: [{{port: 8080, nodePort: 30980}}]
+---
+apiVersion: autoscaling/v2
+kind: HorizontalPodAutoscaler
+metadata: {{name: jax-serve, namespace: default}}
+spec:
+  scaleTargetRef: {{apiVersion: apps/v1, kind: Deployment, name: jax-serve}}
+  minReplicas: 1
+  maxReplicas: {max_replicas}
+  metrics:
+  # the request threads burn CPU while blocked on the batcher under
+  # load, so CPU tracks serving pressure; external ko_serve_queue_depth
+  # via an adapter is the sharper signal when one is installed
+  - type: Resource
+    resource: {{name: cpu, target: {{type: Utilization, averageUtilization: 70}}}}
 """,
     "jax-vit": """apiVersion: apps/v1
 kind: StatefulSet
@@ -649,6 +680,7 @@ def render_app(name: str, registry: str, vars: dict[str, Any] | None = None) -> 
         "registry": registry,
         "slice_hosts": vars.get("slice_hosts", 1),
         "slice_id": vars.get("slice_id", ""),
+        "max_replicas": vars.get("max_replicas", 4),
     }
     tmpl = _SYSTEM.get(name) or _WORKLOADS.get(name)
     return tmpl.format(**params) if tmpl else None

@@ -49,6 +49,30 @@ class TaskEngine:
         self._periodic: list[threading.Timer] = []
         self._closed = False
 
+    def summary(self) -> dict:
+        """Worker-pool introspection for the tasks monitor (flower parity,
+        reference ``kubeops.py:197-213``): per-state counts, queue depth,
+        live beats."""
+        with self._lock:
+            counts: dict[str, int] = {"PENDING": 0, "STARTED": 0,
+                                      "SUCCESS": 0, "FAILURE": 0}
+            for r in self.tasks.values():
+                counts[r.state] = counts.get(r.state, 0) + 1
+            beats = sum(1 for t in self._periodic if t.is_alive())
+        return {"workers": self.pool._max_workers,
+                "queue_depth": counts["PENDING"],
+                "running": counts["STARTED"],
+                "succeeded": counts["SUCCESS"],
+                "failed": counts["FAILURE"],
+                "total": sum(counts.values()),
+                "beats": beats}
+
+    def records(self) -> list[TaskRecord]:
+        """Most-recent-first task history (records are insertion-ordered;
+        one-shot ids are execution ids, beat runs carry their beat name)."""
+        with self._lock:
+            return list(self.tasks.values())[::-1]
+
     # -- one-shot tasks ----------------------------------------------------
     def submit(self, task_id: str, name: str, fn: Callable, *args: Any, **kwargs: Any) -> TaskRecord:
         with self._lock:

@@ -43,6 +43,9 @@ QUERIED_METRICS = {
     "node_memory_MemTotal_bytes": "node-exporter",
     "node_memory_MemAvailable_bytes": "node-exporter",
     "tpu_tensorcore_utilization": "tpu-workload",   # libtpu :8431, tpu job
+    "ko_serve_queue_depth": "jax-serve",            # batcher, :8080/metrics
+    "ko_serve_request_latency_seconds": "jax-serve",
+    "ko_serve_tokens_generated_total": "jax-serve",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -53,6 +56,11 @@ PROMQL = {
     "mem_used": "sum(node_memory_MemTotal_bytes - node_memory_MemAvailable_bytes)",
     "mem_total": "sum(node_memory_MemTotal_bytes)",
     "tpu_util": "avg(tpu_tensorcore_utilization)",
+    # serving plane (DynamicBatcher stats scraped off the jax-serve pods)
+    "serve_queue_depth": "sum(ko_serve_queue_depth)",
+    "serve_latency_p95":
+        'avg(ko_serve_request_latency_seconds{quantile="0.95"})',
+    "serve_tokens_rate": "sum(rate(ko_serve_tokens_generated_total[5m]))",
 }
 
 
@@ -246,6 +254,10 @@ class ClusterMonitor:
         mem_used = prom.scalar(PROMQL["mem_used"])
         mem_total = prom.scalar(PROMQL["mem_total"])
         tpu_util = prom.scalar(PROMQL["tpu_util"], default=-1.0)
+        # serving plane: -1 marks "no jax-serve deployed" (charts hide it)
+        serve_queue = prom.scalar(PROMQL["serve_queue_depth"], default=-1.0)
+        serve_p95 = prom.scalar(PROMQL["serve_latency_p95"], default=-1.0)
+        serve_rate = prom.scalar(PROMQL["serve_tokens_rate"], default=-1.0)
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -259,6 +271,9 @@ class ClusterMonitor:
             "cpu_usage": cpu_usage, "cpu_total": cpu_total,
             "mem_used_bytes": mem_used, "mem_total_bytes": mem_total,
             "tpu_utilization": tpu_util,
+            "serve_queue_depth": serve_queue,
+            "serve_latency_p95": serve_p95,
+            "serve_tokens_rate": serve_rate,
             "time": iso_now(),
         }
         self._save_snapshot(data)
@@ -289,6 +304,9 @@ class ClusterMonitor:
                        "mem_used_bytes": data["mem_used_bytes"],
                        "mem_total_bytes": data["mem_total_bytes"],
                        "tpu_utilization": data["tpu_utilization"],
+                       "serve_queue_depth": data["serve_queue_depth"],
+                       "serve_latency_p95": data["serve_latency_p95"],
+                       "serve_tokens_rate": data["serve_tokens_rate"],
                        "pod_count": data["pod_count"]})
         hist.data = {"points": points[-self.HISTORY_POINTS:]}
         hist.created_at = iso_now()

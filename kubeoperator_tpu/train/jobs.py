@@ -375,6 +375,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 self._json(200, {"status": "ok", "model": {
                     "d_model": cfg.d_model, "layers": cfg.n_layers,
                     "vocab": cfg.vocab_size, "max_seq_len": cfg.max_seq_len}})
+            elif self.path == "/metrics":
+                # prometheus text: queue depth, fused-batch histogram,
+                # p50/p95 latency (workloads/serving.BatcherStats) —
+                # scraped by services/monitor.py and the bundled
+                # prometheus stack
+                body = batcher.stats.prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/stats":
+                self._json(200, batcher.stats.snapshot())
             else:
                 self._json(404, {"error": "not found"})
 

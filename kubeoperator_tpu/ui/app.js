@@ -11,7 +11,8 @@ const $ = (s, el = document) => el.querySelector(s);
 const state = { token: sessionStorage.getItem("token") || "", user: null,
                 ws: null, term: null };
 const PAGES = ["dashboard", "clusters", "planning", "hosts", "packages",
-               "storage", "items", "users", "settings", "logs", "messages"];
+               "storage", "items", "users", "settings", "logs", "messages",
+               "tasks"];
 
 async function api(path, opts = {}) {
   const r = await fetch("/api/v1" + path, {...opts, headers: {
@@ -55,7 +56,7 @@ function render() {
                  packages: renderPackages, storage: renderStorage,
                  items: renderItems, users: renderUsers,
                  settings: renderSettings, logs: renderLogs,
-                 messages: renderMessages};
+                 messages: renderMessages, tasks: renderTasks};
   (table[page] || renderDashboard)(...rest).catch(e =>
     $("#view").innerHTML = `<div class="card" style="color:var(--err)">${esc(e.message)}</div>`);
 }
@@ -86,8 +87,10 @@ async function doLogin() {
 
 /* Small-multiple utilization line charts (one measure per chart, shared
    0-100% scale — never a dual axis). Single series each, so the panel title
-   names it and no legend is needed; hover shows time + value. */
-function lineChart(title, points, fmt) {
+   names it and no legend is needed; hover shows time + value. rawVals/unit
+   let a differently-scaled series (rawChart) keep honest tooltips/labels:
+   geometry uses the scaled values, the data attributes carry the raw. */
+function lineChart(title, points, fmt, unit = "%", rawVals = null) {
   const W = 250, H = 64, P = 6;
   const vals = points.map(p => p.v), times = points.map(p => p.t);
   if (!vals.some(v => v != null)) return "";
@@ -95,18 +98,32 @@ function lineChart(title, points, fmt) {
   const y = v => H - P - Math.max(0, Math.min(100, v)) / 100 * (H - 2 * P);
   const path = vals.map((v, i) => v == null ? null : `${x(i)},${y(v)}`)
                    .filter(Boolean).join(" ");
-  const last = [...vals].reverse().find(v => v != null);
+  const shown = rawVals || vals;
+  const last = [...shown].reverse().find(v => v != null);
   return `<div class="spark">
     <span class="dim small">${esc(title)}</span>
     <svg viewBox="0 0 ${W} ${H}" width="${W}" height="${H}"
          data-times="${esc(JSON.stringify(times))}"
-         data-vals="${esc(JSON.stringify(vals))}" data-fmt="${esc(fmt)}">
+         data-vals="${esc(JSON.stringify(shown))}" data-fmt="${esc(fmt)}"
+         data-unit="${esc(unit)}">
       ${[0, 50, 100].map(g => `<line x1="${P}" x2="${W - P}" y1="${y(g)}"
           y2="${y(g)}" stroke="var(--line)" stroke-width="1"/>`).join("")}
       <polyline points="${path}" fill="none" stroke="var(--accent)"
           stroke-width="2" stroke-linejoin="round"/>
     </svg>
-    <b>${last == null ? "–" : last.toFixed(0) + "%"}</b></div>`;
+    <b>${last == null ? "–" : unit === "%" ? last.toFixed(0) + "%"
+        : +last.toFixed(2) + unit}</b></div>`;
+}
+
+/* Non-percentage series (serve queue depth, token rate): scale to the
+   series' own max for the shared chart body; tooltips and the label show
+   the raw values with their unit. */
+function rawChart(title, points, unit) {
+  const raw = points.map(p => p.v);
+  const max = Math.max(...raw.filter(v => v != null), 1e-9);
+  return lineChart(title,
+    points.map(p => ({t: p.t, v: p.v == null ? null : 100 * p.v / max})),
+    title, unit || "", raw);
 }
 
 function utilizationCharts(history) {
@@ -119,6 +136,12 @@ function utilizationCharts(history) {
       lineChart("Memory used", series(p => pct(p.mem_used_bytes, p.mem_total_bytes)), "Memory"),
       lineChart("TPU tensorcore", series(p => p.tpu_utilization >= 0 ?
         100 * p.tpu_utilization : null), "TPU"),
+      rawChart("Serve queue", series(p => p.serve_queue_depth >= 0 ?
+        p.serve_queue_depth : null), ""),
+      rawChart("Serve tok/s", series(p => p.serve_tokens_rate >= 0 ?
+        p.serve_tokens_rate : null), " tok/s"),
+      rawChart("Serve p95", series(p => p.serve_latency_p95 >= 0 ?
+        p.serve_latency_p95 : null), " s"),
     ].filter(Boolean).join("");
     return charts ? `<div><span class="small">${esc(name)}</span>
       <div class="row sparkrow">${charts}</div></div>` : "";
@@ -949,6 +972,28 @@ async function renderMessages() {
       </tr>`).join("")}
     </table></div>`;
 }
+/* Worker-pool monitor (flower parity): queue depth, per-state counts,
+   beats, recent task history with per-task error text. */
+async function renderTasks() {
+  const d = await api("/tasks?limit=100");
+  const s = d.summary;
+  $("#view").innerHTML = `<div class="card"><h3>Task workers</h3>
+    <div class="grid">
+      ${[["workers", s.workers], ["queued", s.queue_depth],
+         ["running", s.running], ["succeeded", s.succeeded],
+         ["failed", s.failed], ["beats", s.beats]].map(([k, v]) =>
+        `<div class="stat"><b>${v}</b><span>${k}</span></div>`).join("")}
+    </div></div>
+    <div class="card"><h3>Recent tasks</h3>
+    <table><tr><th>state</th><th>task</th><th>started</th><th>finished</th><th>error</th></tr>
+    ${d.tasks.map(t => `<tr><td>${tag(t.state)}</td><td>${esc(t.name)}</td>
+      <td class="dim">${when(t.started_at)}</td>
+      <td class="dim">${when(t.finished_at)}</td>
+      <td class="small" style="color:var(--err)">${esc(t.error || "")}</td>
+      </tr>`).join("")}
+    </table></div>`;
+}
+
 async function markRead(id) {
   try { await api(`/messages/${id}/read`, {method: "POST"}); renderMessages(); }
   catch (e) { alert(e.message); }
@@ -984,7 +1029,8 @@ document.addEventListener("mousemove", e => {
   const i = Math.max(0, Math.min(vals.length - 1,
     Math.round((e.clientX - rect.left) / rect.width * (vals.length - 1))));
   if (vals[i] == null) { tip.style.display = "none"; return; }
-  tip.textContent = `${svg.dataset.fmt} · ${times[i] || ""} · ${vals[i].toFixed(1)}%`;
+  const unit = svg.dataset.unit != null ? svg.dataset.unit : "%";
+  tip.textContent = `${svg.dataset.fmt} · ${times[i] || ""} · ${vals[i].toFixed(1)}${unit}`;
   tip.style.display = "block";
   tip.style.left = (e.pageX + 14) + "px";
   tip.style.top = (e.pageY - 12) + "px";

@@ -1,0 +1,155 @@
+"""Minimal .xlsx read/write — stdlib only (zipfile + ElementTree).
+
+The reference bulk-imports hosts from Excel workbooks and serves a
+downloadable template (``core/apps/kubeops_api/host_import.py:12-62``,
+openpyxl). openpyxl isn't in the air-gapped image, and vendoring it for
+one sheet of strings would be absurd: an xlsx file is a zip of small XML
+parts, and the subset a host-import sheet needs — one worksheet, string
+and number cells, shared strings — is a page of code. This module
+implements exactly that subset:
+
+* ``read_rows``: sheet1 of any real-world workbook (shared strings,
+  inline strings, numbers; sparse cells land in their lettered column).
+* ``write_rows``: a valid single-sheet workbook with inline strings —
+  what the template download serves; Excel/LibreOffice open it.
+
+Anything fancier (formulas, styles, multiple sheets) is out of scope —
+the CSV path remains the documented plain-text alternative.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+from xml.etree import ElementTree
+from xml.sax.saxutils import escape
+
+_NS = {"m": "http://schemas.openxmlformats.org/spreadsheetml/2006/main"}
+
+
+def _col_index(ref: str) -> int:
+    """'A1' -> 0, 'AB7' -> 27."""
+    n = 0
+    for ch in re.match(r"[A-Z]+", ref).group(0):
+        n = n * 26 + (ord(ch) - 64)
+    return n - 1
+
+
+def read_rows(data: bytes) -> list[list[str]]:
+    """Rows of sheet1 as strings ('' for gaps). Raises ValueError on a
+    non-xlsx payload."""
+    try:
+        zf = zipfile.ZipFile(io.BytesIO(data))
+    except zipfile.BadZipFile as e:
+        raise ValueError("not an xlsx file (not a zip archive)") from e
+    names = set(zf.namelist())
+    sheet = next((n for n in ("xl/worksheets/sheet1.xml",)
+                  if n in names), None)
+    if sheet is None:
+        sheet = next((n for n in sorted(names)
+                      if n.startswith("xl/worksheets/")), None)
+    if sheet is None:
+        raise ValueError("not an xlsx file (no worksheet part)")
+    shared: list[str] = []
+    if "xl/sharedStrings.xml" in names:
+        root = ElementTree.fromstring(zf.read("xl/sharedStrings.xml"))
+        for si in root.findall("m:si", _NS):
+            shared.append("".join(t.text or ""
+                                  for t in si.iter(f"{{{_NS['m']}}}t")))
+    rows: list[list[str]] = []
+    try:
+        root = ElementTree.fromstring(zf.read(sheet))
+        for row_el in root.iter(f"{{{_NS['m']}}}row"):
+            row: list[str] = []
+            for c in row_el.findall("m:c", _NS):
+                idx = _col_index(c.get("r", "A1"))
+                ctype = c.get("t", "n")
+                if ctype == "inlineStr":
+                    val = "".join(t.text or ""
+                                  for t in c.iter(f"{{{_NS['m']}}}t"))
+                else:
+                    v = c.find("m:v", _NS)
+                    val = v.text or "" if v is not None else ""
+                    if ctype == "s":
+                        val = shared[int(val)] if val else ""
+                    elif ctype == "n" and val.endswith(".0"):
+                        val = val[:-2]   # 22.0 -> "22" (Excel port numbers)
+                while len(row) < idx:
+                    row.append("")
+                row.append(val)
+            rows.append(row)
+    except (ElementTree.ParseError, IndexError, AttributeError,
+            KeyError) as e:
+        # malformed refs (AttributeError from the [A-Z]+ match), shared-
+        # string indices past the table (IndexError), broken XML — all
+        # surface as the one documented failure mode
+        raise ValueError(f"unreadable xlsx: {type(e).__name__}: {e}") from e
+    return rows
+
+
+def dict_rows(data: bytes) -> list[dict[str, str]]:
+    """First row = header; remaining rows as dicts (csv.DictReader shape,
+    so the host import treats xlsx and CSV uploads identically)."""
+    rows = read_rows(data)
+    if not rows:
+        return []
+    header = [h.strip() for h in rows[0]]
+    return [{h: (r[i] if i < len(r) else "") for i, h in enumerate(header) if h}
+            for r in rows[1:] if any(v.strip() for v in r)]
+
+
+_CONTENT_TYPES = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Types xmlns="http://schemas.openxmlformats.org/package/2006/content-types">
+<Default Extension="rels" ContentType="application/vnd.openxmlformats-package.relationships+xml"/>
+<Default Extension="xml" ContentType="application/xml"/>
+<Override PartName="/xl/workbook.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.sheet.main+xml"/>
+<Override PartName="/xl/worksheets/sheet1.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.worksheet+xml"/>
+</Types>"""
+
+_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
+<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/officeDocument/2006/relationships/officeDocument" Target="xl/workbook.xml"/>
+</Relationships>"""
+
+_WORKBOOK = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<workbook xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main"
+ xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/relationships">
+<sheets><sheet name="hosts" sheetId="1" r:id="rId1"/></sheets></workbook>"""
+
+_WORKBOOK_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
+<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/officeDocument/2006/relationships/worksheet" Target="worksheets/sheet1.xml"/>
+</Relationships>"""
+
+
+def _col_letter(ci: int) -> str:
+    s = ""
+    ci += 1
+    while ci:
+        ci, r = divmod(ci - 1, 26)
+        s = chr(65 + r) + s
+    return s
+
+
+def write_rows(rows: list[list[str]]) -> bytes:
+    """A single-sheet workbook with every cell an inline string."""
+    cells = []
+    for ri, row in enumerate(rows, 1):
+        cs = "".join(
+            f'<c r="{_col_letter(ci)}{ri}" t="inlineStr">'
+            f"<is><t>{escape(str(v))}</t></is></c>"
+            for ci, v in enumerate(row))
+        cells.append(f'<row r="{ri}">{cs}</row>')
+    sheet = ('<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+             '<worksheet xmlns="http://schemas.openxmlformats.org/'
+             'spreadsheetml/2006/main"><sheetData>'
+             + "".join(cells) + "</sheetData></worksheet>")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("[Content_Types].xml", _CONTENT_TYPES)
+        zf.writestr("_rels/.rels", _RELS)
+        zf.writestr("xl/workbook.xml", _WORKBOOK)
+        zf.writestr("xl/_rels/workbook.xml.rels", _WORKBOOK_RELS)
+        zf.writestr("xl/worksheets/sheet1.xml", sheet)
+    return buf.getvalue()

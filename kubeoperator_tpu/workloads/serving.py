@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+from bisect import bisect_left, insort
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
@@ -50,6 +52,102 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: list[int] | None = None
     error: Exception | None = None
+    submitted_at: float = field(default_factory=time.monotonic)
+
+
+class BatcherStats:
+    """Serving observability for the batcher: counters, the fused-batch
+    size histogram, and a bounded latency reservoir for p50/p95 —
+    exported as JSON (``snapshot``) and Prometheus text (``prometheus``),
+    scraped by services/monitor.py and charted in the UI."""
+
+    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._window = window
+        self.requests_total = 0
+        self.errors_total = 0
+        self.batches_total = 0
+        self.tokens_generated_total = 0
+        self.queue_depth = 0
+        self.batch_hist = {b: 0 for b in self.BATCH_BUCKETS}
+        self._latencies: list[float] = []   # sorted, bounded reservoir
+        self._latency_order: list[float] = []
+
+    def enqueued(self) -> None:
+        with self._lock:
+            self.queue_depth += 1
+
+    def executed(self, batch_size: int) -> None:
+        with self._lock:
+            self.batches_total += 1
+            b = min((x for x in self.BATCH_BUCKETS if x >= batch_size),
+                    default=self.BATCH_BUCKETS[-1])
+            self.batch_hist[b] += 1
+
+    def finished(self, req: _Pending, ok: bool) -> None:
+        with self._lock:
+            self.queue_depth = max(0, self.queue_depth - 1)
+            self.requests_total += 1
+            if ok:
+                # the tokens this request actually received (its result is
+                # sliced to prompt + max_tokens), not the pow2 bucket the
+                # fused batch decoded at
+                self.tokens_generated_total += req.max_tokens
+            else:
+                self.errors_total += 1
+            lat = time.monotonic() - req.submitted_at
+            insort(self._latencies, lat)
+            self._latency_order.append(lat)
+            if len(self._latency_order) > self._window:
+                old = self._latency_order.pop(0)
+                del self._latencies[bisect_left(self._latencies, old)]
+
+    def _quantile(self, q: float) -> float:
+        if not self._latencies:
+            return 0.0
+        i = min(len(self._latencies) - 1, int(q * len(self._latencies)))
+        return self._latencies[i]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "errors_total": self.errors_total,
+                "batches_total": self.batches_total,
+                "tokens_generated_total": self.tokens_generated_total,
+                "queue_depth": self.queue_depth,
+                "batch_size_hist": dict(self.batch_hist),
+                "latency_p50_s": round(self._quantile(0.50), 4),
+                "latency_p95_s": round(self._quantile(0.95), 4),
+            }
+
+    def prometheus(self) -> str:
+        s = self.snapshot()
+        lines = [
+            "# TYPE ko_serve_requests_total counter",
+            f"ko_serve_requests_total {s['requests_total']}",
+            "# TYPE ko_serve_errors_total counter",
+            f"ko_serve_errors_total {s['errors_total']}",
+            "# TYPE ko_serve_batches_total counter",
+            f"ko_serve_batches_total {s['batches_total']}",
+            "# TYPE ko_serve_tokens_generated_total counter",
+            f"ko_serve_tokens_generated_total {s['tokens_generated_total']}",
+            "# TYPE ko_serve_queue_depth gauge",
+            f"ko_serve_queue_depth {s['queue_depth']}",
+            "# TYPE ko_serve_request_latency_seconds summary",
+            "ko_serve_request_latency_seconds{quantile=\"0.5\"} "
+            f"{s['latency_p50_s']}",
+            "ko_serve_request_latency_seconds{quantile=\"0.95\"} "
+            f"{s['latency_p95_s']}",
+            "# TYPE ko_serve_batch_size_bucket counter",
+        ]
+        cum = 0
+        for b, n in sorted(s["batch_size_hist"].items()):
+            cum += n
+            lines.append(f'ko_serve_batch_size_bucket{{le="{b}"}} {cum}')
+        return "\n".join(lines) + "\n"
 
 
 class DynamicBatcher:
@@ -69,6 +167,7 @@ class DynamicBatcher:
         self.max_batch = max_batch
         self.window_s = window_ms / 1000.0
         self.max_seq_len = max_seq_len
+        self.stats = BatcherStats()
         self._q: queue.Queue[_Pending] = queue.Queue()
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ko-serve-batcher")
@@ -86,6 +185,7 @@ class DynamicBatcher:
                 f"exceed max_seq_len ({self.max_seq_len})")
         req = _Pending(list(prompt_ids), int(max_tokens), float(temperature),
                        int(seed))
+        self.stats.enqueued()
         self._q.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
@@ -158,14 +258,23 @@ class DynamicBatcher:
             seed = group[0].seed if len(group) == 1 else hash(
                 tuple(r.seed for r in group)) & 0x7FFFFFFF
             out = self.run_fn(prompts, lens, new_bucket, temp, prefill, seed)
+            self.stats.executed(len(group))
             for i, (r, n) in enumerate(zip(group, lens)):
                 row = list(map(int, out[i]))
                 # rows are contiguous: generate() overwrites a short row's
                 # pad positions with its own continuation as the scan
                 # passes them (keep_prompt is per-row)
                 r.result = row[:n + r.max_tokens]
+                self.stats.finished(r, ok=True)
                 r.done.set()
         except Exception as e:  # noqa: BLE001 — request boundary
-            for r in group:
+            # fail only the rows still pending: a late per-row error must
+            # not poison requests already completed above (and their stats
+            # must not double-count)
+            pending = [r for r in group if not r.done.is_set()]
+            if pending and not any(r.done.is_set() for r in group):
+                self.stats.executed(len(group))   # run_fn itself failed
+            for r in pending:
                 r.error = e
+                self.stats.finished(r, ok=False)
                 r.done.set()

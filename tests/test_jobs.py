@@ -41,7 +41,8 @@ def test_resnet50_tiny_end_to_end(capsys, tmp_path):
     assert rc == 0
     done = recs[-1]
     assert done["done"] and done["steps"] == 2
-    assert done["mesh"] == {"dp": 2, "fsdp": 4, "ep": 1, "tp": 1, "sp": 1}
+    assert done["mesh"] == {"dp": 2, "fsdp": 4, "pp": 1, "ep": 1, "tp": 1,
+                            "sp": 1}
 
     # resume: latest checkpoint (step 2) picked up, continues to step 3
     argv[2] = "3"

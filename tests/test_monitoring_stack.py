@@ -20,7 +20,8 @@ def _queried_metric_names() -> set[str]:
     (snapshot() reads its queries from mon.PROMQL, so this IS what runs)."""
     names: set[str] = set()
     for expr in mon.PROMQL.values():
-        names |= set(re.findall(r"\b((?:node|tpu|container)_[a-zA-Z0-9_]+)\b", expr))
+        names |= set(re.findall(
+            r"\b((?:node|tpu|container|ko_serve)_[a-zA-Z0-9_]+)\b", expr))
     return names
 
 
@@ -39,6 +40,12 @@ def test_every_queried_metric_has_a_deployed_exporter():
         elif exporter == "tpu-workload":
             # tpu scrape job relabeling to libtpu's :8431 metrics port
             assert "job_name: tpu" in prom and "8431" in prom, metric
+        elif exporter == "jax-serve":
+            # the serve endpoint's batcher metrics: a scrape job keyed on
+            # the app label, and the chart actually serving /metrics
+            assert "job_name: ko-serve" in prom, metric
+            serve = manifests.render_app("jax-serve", registry="r")
+            assert "jobs" in serve and "8080" in serve, metric
         else:  # a new exporter kind must come with its own manifest check
             raise AssertionError(f"no manifest check for exporter {exporter!r}")
     # the Loki log queries need promtail shipping pod logs

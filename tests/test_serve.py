@@ -63,6 +63,21 @@ def test_serve_generates_over_http(tmp_path, capsys):
             raise AssertionError("expected 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+        # observability: the generations above must have moved the
+        # batcher stats, and /metrics exposes them as prometheus text
+        status, stats = _request(f"http://127.0.0.1:{port}/stats")
+        assert status == 200
+        assert stats["requests_total"] >= 2
+        assert stats["tokens_generated_total"] >= 8
+        assert stats["latency_p50_s"] > 0
+        assert sum(stats["batch_size_hist"].values()) == stats["batches_total"]
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            text = resp.read().decode()
+        assert "ko_serve_requests_total" in text
+        assert 'ko_serve_request_latency_seconds{quantile="0.95"}' in text
+        assert "ko_serve_queue_depth 0" in text
         server.shutdown()
     finally:
         http.server.HTTPServer.__init__ = orig_init
@@ -73,6 +88,11 @@ def test_jax_serve_chart_renders():
 
     text = manifests.render_app("jax-serve", registry="reg.local:8082")
     assert 'image: "reg.local:8082/ko-workloads:latest"' in text
+    # HPA replica policy scales the endpoint (max_replicas var, default 4)
+    assert "HorizontalPodAutoscaler" in text and "maxReplicas: 4" in text
+    scaled = manifests.render_app("jax-serve", registry="r",
+                                  vars={"max_replicas": 8})
+    assert "maxReplicas: 8" in scaled
     assert "kubeoperator_tpu.train.jobs" in text and "serve" in text
     assert "readinessProbe" in text and "nodePort: 30980" in text
 

@@ -111,3 +111,35 @@ def test_batched_serving_tokens_equal_solo_runs():
     solo_b = generate(CFG, params, jnp.asarray([[9, 2, 40]], jnp.int32), 6)
     assert results["a"] == [int(x) for x in np.asarray(solo_a)[0]]
     assert results["b"] == [int(x) for x in np.asarray(solo_b)[0]]
+
+
+def test_batcher_stats_track_load():
+    """BatcherStats moves under concurrent load: counters, queue drain,
+    fused-batch histogram, latency quantiles."""
+    import threading
+
+    from kubeoperator_tpu.workloads.serving import DynamicBatcher
+
+    def run_fn(prompts, lens, max_new, temp, prefill, seed):
+        return [list(p[:n]) + [1] * (len(p) - n + max_new)
+                for p, n in zip(prompts, lens)]
+
+    b = DynamicBatcher(run_fn, max_batch=8, window_ms=30.0, max_seq_len=64)
+    threads = [threading.Thread(
+        target=lambda: b.submit([3, 4, 5], 4)) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = b.stats.snapshot()
+    assert s["requests_total"] == 6
+    assert s["errors_total"] == 0
+    assert s["queue_depth"] == 0
+    assert s["tokens_generated_total"] >= 6 * 4
+    assert s["latency_p50_s"] > 0 and s["latency_p95_s"] >= s["latency_p50_s"]
+    assert sum(s["batch_size_hist"].values()) == s["batches_total"]
+    # at least one multi-request fuse happened under the 30ms window
+    assert s["batches_total"] <= 6
+    text = b.stats.prometheus()
+    assert "ko_serve_requests_total 6" in text
+    assert 'ko_serve_batch_size_bucket{le="64"}' in text
