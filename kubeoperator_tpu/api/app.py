@@ -341,8 +341,9 @@ async def openapi_schema(request: web.Request) -> web.Response:
             "summary": doc or route.handler.__name__,
             "operationId": f"{route.method.lower()}_{route.handler.__name__}",
             "responses": {"200": {"description": "success"}},
-            "security": [{"bearer": []}],
         }
+        if not path.endswith("/auth/login"):   # the bootstrap route is open
+            op["security"] = [{"bearer": []}]
         params = _re.findall(r"{([a-zA-Z_]+)}", path)
         if params:
             op["parameters"] = [

@@ -338,7 +338,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
     tpu_lock = threading.Lock()   # one generation at a time on the chip
 
-    from kubeoperator_tpu.workloads.serving import DynamicBatcher, _pow2_at_least
+    from kubeoperator_tpu.workloads.serving import (
+        DynamicBatcher, _pow2_at_least, _pow2_at_most,
+    )
 
     def run_batch(prompts, lens, max_new, temp, prefill, seed):
         b = _pow2_at_least(len(prompts))
@@ -357,6 +359,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
     decode_fn(1, 8, 4, 0.0, 8)(model_params, jnp.zeros((1, 8), jnp.int32),
                                jnp.full((1,), 8, jnp.int32),
                                jax.random.key(0))   # warm trace+compile
+    # pre-compile the expected bucket lattice BEFORE readiness: a cold
+    # (batch, prompt, new) bucket compiles its decode scan on the first
+    # request that needs it — minutes at multi-GB model sizes, which
+    # blows client timeouts under a load spike. "BxPxN" triples, greedy
+    # temperature (sampling buckets trace separately).
+    for spec in (args.warm.split(",") if args.warm else []):
+        b, p, n = (int(x) for x in spec.lower().split("x"))
+        emit({"job": "serve", "warming": spec})
+        # the same prefill the batcher would pick for a uniform group of
+        # length-p prompts (pow2 at most p) — any other value would land
+        # in a different bucket and recompile anyway
+        decode_fn(b, p, n, 0.0, _pow2_at_most(p))(
+            model_params, jnp.zeros((b, p), jnp.int32),
+            jnp.full((b,), p, jnp.int32), jax.random.key(0))
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):  # noqa: N802 — quiet access log
@@ -607,6 +623,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--bf16", action="store_true", default=True)
     sv.add_argument("--no-bf16", dest="bf16", action="store_false")
+    sv.add_argument("--warm", default="",
+                    help="pre-compile decode buckets before serving, "
+                         "comma-separated BxPxN triples (e.g. "
+                         "'8x128x64,32x128x64')")
     sv.add_argument("--max-batch", type=int, default=32,
                     help="dynamic batcher: max fused requests per step")
     sv.add_argument("--batch-window-ms", type=float, default=5.0,

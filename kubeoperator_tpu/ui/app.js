@@ -1030,7 +1030,8 @@ document.addEventListener("mousemove", e => {
     Math.round((e.clientX - rect.left) / rect.width * (vals.length - 1))));
   if (vals[i] == null) { tip.style.display = "none"; return; }
   const unit = svg.dataset.unit != null ? svg.dataset.unit : "%";
-  tip.textContent = `${svg.dataset.fmt} · ${times[i] || ""} · ${vals[i].toFixed(1)}${unit}`;
+  const v = unit === "%" ? vals[i].toFixed(1) : +vals[i].toFixed(3);
+  tip.textContent = `${svg.dataset.fmt} · ${times[i] || ""} · ${v}${unit}`;
   tip.style.display = "block";
   tip.style.left = (e.pageX + 14) + "px";
   tip.style.top = (e.pageY - 12) + "px";

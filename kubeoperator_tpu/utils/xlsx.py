@@ -22,6 +22,7 @@ from __future__ import annotations
 import io
 import re
 import zipfile
+import zlib
 from xml.etree import ElementTree
 from xml.sax.saxutils import escape
 
@@ -52,13 +53,13 @@ def read_rows(data: bytes) -> list[list[str]]:
     if sheet is None:
         raise ValueError("not an xlsx file (no worksheet part)")
     shared: list[str] = []
-    if "xl/sharedStrings.xml" in names:
-        root = ElementTree.fromstring(zf.read("xl/sharedStrings.xml"))
-        for si in root.findall("m:si", _NS):
-            shared.append("".join(t.text or ""
-                                  for t in si.iter(f"{{{_NS['m']}}}t")))
     rows: list[list[str]] = []
     try:
+        if "xl/sharedStrings.xml" in names:
+            for si in ElementTree.fromstring(
+                    zf.read("xl/sharedStrings.xml")).findall("m:si", _NS):
+                shared.append("".join(t.text or ""
+                                      for t in si.iter(f"{{{_NS['m']}}}t")))
         root = ElementTree.fromstring(zf.read(sheet))
         for row_el in root.iter(f"{{{_NS['m']}}}row"):
             row: list[str] = []
@@ -79,11 +80,12 @@ def read_rows(data: bytes) -> list[list[str]]:
                     row.append("")
                 row.append(val)
             rows.append(row)
-    except (ElementTree.ParseError, IndexError, AttributeError,
-            KeyError) as e:
+    except (ElementTree.ParseError, IndexError, AttributeError, KeyError,
+            zipfile.BadZipFile, zlib.error) as e:
         # malformed refs (AttributeError from the [A-Z]+ match), shared-
-        # string indices past the table (IndexError), broken XML — all
-        # surface as the one documented failure mode
+        # string indices past the table (IndexError), broken XML, corrupt
+        # zip members (BadZipFile/zlib on read) — all surface as the one
+        # documented failure mode
         raise ValueError(f"unreadable xlsx: {type(e).__name__}: {e}") from e
     return rows
 
