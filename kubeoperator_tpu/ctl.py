@@ -216,6 +216,23 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_tasks(args) -> int:
+    """Worker-pool monitor (flower parity): summary + recent history."""
+    state = args.state.upper()
+    if state and state not in ("PENDING", "STARTED", "SUCCESS", "FAILURE"):
+        print(f"unknown state {args.state!r} "
+              "(want PENDING|STARTED|SUCCESS|FAILURE)")
+        return 2
+    q = f"?limit={args.limit}" + (f"&state={state}" if state else "")
+    d = Client().call("GET", f"/api/v1/tasks{q}")
+    s = d["summary"]
+    print(f"workers {s['workers']} · queued {s['queue_depth']} · running "
+          f"{s['running']} · succeeded {s['succeeded']} · failed "
+          f"{s['failed']} · beats {s['beats']}")
+    table(d["tasks"], ["state", "name", "started_at", "finished_at", "error"])
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     d = Client().call("GET", "/api/v1/dashboard/all")
     print(f"clusters: {d['cluster_count']} (running {d['running']}, "
@@ -262,6 +279,11 @@ def build_parser(sub) -> None:
     apps.set_defaults(fn=cmd_apps)
 
     sub.add_parser("hosts", help="list hosts").set_defaults(fn=cmd_hosts)
+    tk = sub.add_parser("tasks", help="worker-pool monitor (queue/history)")
+    tk.add_argument("--state", default="",
+                    help="filter: PENDING|STARTED|SUCCESS|FAILURE")
+    tk.add_argument("--limit", type=int, default=30)
+    tk.set_defaults(fn=cmd_tasks)
     sub.add_parser("packages", help="list offline packages").set_defaults(fn=cmd_packages)
     sub.add_parser("dashboard", help="fleet summary").set_defaults(fn=cmd_dashboard)
 

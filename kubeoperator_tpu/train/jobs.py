@@ -365,14 +365,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
     # blows client timeouts under a load spike. "BxPxN" triples, greedy
     # temperature (sampling buckets trace separately).
     for spec in (args.warm.split(",") if args.warm else []):
-        b, p, n = (int(x) for x in spec.lower().split("x"))
-        emit({"job": "serve", "warming": spec})
-        # the same prefill the batcher would pick for a uniform group of
-        # length-p prompts (pow2 at most p) — any other value would land
-        # in a different bucket and recompile anyway
-        decode_fn(b, p, n, 0.0, _pow2_at_most(p))(
+        b, p_raw, n = (int(x) for x in spec.lower().split("x"))
+        # round every dimension exactly the way the batcher buckets real
+        # traffic — a verbatim 24x100x64 would warm a bucket no request
+        # ever lands in, silently re-introducing the cold-compile stall.
+        # The prefill chunk derives from the RAW prompt length (pow2 at
+        # most min(lens)), not from the padded prompt bucket.
+        b = _pow2_at_least(b)
+        p = _pow2_at_least(p_raw, 8)
+        n = _pow2_at_least(n)
+        prefill = _pow2_at_most(p_raw)
+        emit({"job": "serve", "warming": f"{b}x{p}x{n} prefill={prefill}"})
+        decode_fn(b, p, n, 0.0, prefill)(
             model_params, jnp.zeros((b, p), jnp.int32),
-            jnp.full((b,), p, jnp.int32), jax.random.key(0))
+            jnp.full((b,), p_raw, jnp.int32), jax.random.key(0))
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):  # noqa: N802 — quiet access log

@@ -49,12 +49,15 @@ def test_ctl_login_and_flows(live_server, tmp_path, monkeypatch, capsys):
         assert ctl.main(["logs", "--query", "install"]) == 0
         # op + watch: backup completes quickly on fakes
         assert ctl.main(["op", "demo", "backup"]) == 0
+        # worker-pool monitor shows the op's task history
+        assert ctl.main(["tasks"]) == 0
         return True
 
     assert run_with_server(live_server, drive)
     out = capsys.readouterr().out
     assert "demo" in out and "RUNNING" in out
     assert "backup SUCCESS" in out
+    assert "workers" in out and "queued" in out   # ko tasks summary
     assert "demo-tpu-1" in out                     # hosts table
 
 
