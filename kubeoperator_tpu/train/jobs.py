@@ -339,7 +339,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     tpu_lock = threading.Lock()   # one generation at a time on the chip
 
     from kubeoperator_tpu.workloads.serving import (
-        DynamicBatcher, _pow2_at_least, _pow2_at_most,
+        DynamicBatcher, _pow2_at_least, plan_bucket,
     )
 
     def run_batch(prompts, lens, max_new, temp, prefill, seed):
@@ -365,16 +365,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
     # blows client timeouts under a load spike. "BxPxN" triples, greedy
     # temperature (sampling buckets trace separately).
     for spec in (args.warm.split(",") if args.warm else []):
-        b, p_raw, n = (int(x) for x in spec.lower().split("x"))
-        # round every dimension exactly the way the batcher buckets real
-        # traffic — a verbatim 24x100x64 would warm a bucket no request
-        # ever lands in, silently re-introducing the cold-compile stall.
-        # The prefill chunk derives from the RAW prompt length (pow2 at
-        # most min(lens)), not from the padded prompt bucket.
+        b, p_raw, n_raw = (int(x) for x in spec.lower().split("x"))
+        # bucket the spec exactly the way the batcher buckets real
+        # traffic (serving.plan_bucket — ONE rule, including the
+        # shed-padding fallbacks near max_seq_len): a verbatim or
+        # naively-rounded spec would warm a bucket no request ever
+        # lands in, silently re-introducing the cold-compile stall
         b = _pow2_at_least(b)
-        p = _pow2_at_least(p_raw, 8)
-        n = _pow2_at_least(n)
-        prefill = _pow2_at_most(p_raw)
+        p, n, prefill = plan_bucket([p_raw] * b, [n_raw] * b,
+                                    cfg.max_seq_len)
         emit({"job": "serve", "warming": f"{b}x{p}x{n} prefill={prefill}"})
         decode_fn(b, p, n, 0.0, prefill)(
             model_params, jnp.zeros((b, p), jnp.int32),

@@ -45,6 +45,26 @@ def _pow2_at_most(n: int) -> int:
     return v
 
 
+def plan_bucket(lens: Sequence[int], max_tokens: Sequence[int],
+                max_seq_len: int) -> tuple[int, int, int]:
+    """(prompt_bucket, new_bucket, prefill) for one executed batch — THE
+    bucketing rule, shared by the execution path and the serve job's
+    ``--warm`` precompile so a warmed bucket is exactly the one real
+    traffic lands in (including the shed-padding fallbacks near
+    max_seq_len)."""
+    p_bucket = _pow2_at_least(max(lens), 8)
+    new_bucket = _pow2_at_least(max(max_tokens))
+    if p_bucket + new_bucket > max_seq_len:
+        # shed padding before shedding fusion: exact sizes always fit
+        # (submit / _run_group guarantee it per executed batch)
+        p_bucket = _pow2_at_least(max(lens), 1)
+    if p_bucket + new_bucket > max_seq_len:
+        new_bucket = max(max_tokens)
+    if p_bucket + new_bucket > max_seq_len:
+        p_bucket = max(lens)
+    return p_bucket, new_bucket, _pow2_at_most(min(lens))
+
+
 @dataclass
 class _Pending:
     prompt_ids: list[int]
@@ -244,17 +264,8 @@ class DynamicBatcher:
     def _execute(self, temp: float, group: list[_Pending]) -> None:
         try:
             lens = [len(r.prompt_ids) for r in group]
-            p_bucket = _pow2_at_least(max(lens), 8)
-            new_bucket = _pow2_at_least(max(r.max_tokens for r in group))
-            if p_bucket + new_bucket > self.max_seq_len:
-                # shed padding before shedding fusion: exact sizes always
-                # fit (the _run_group split guarantees it)
-                p_bucket = _pow2_at_least(max(lens), 1)
-            if p_bucket + new_bucket > self.max_seq_len:
-                new_bucket = max(r.max_tokens for r in group)
-            if p_bucket + new_bucket > self.max_seq_len:
-                p_bucket = max(lens)
-            prefill = _pow2_at_most(min(lens))
+            p_bucket, new_bucket, prefill = plan_bucket(
+                lens, [r.max_tokens for r in group], self.max_seq_len)
             prompts = [list(r.prompt_ids) + [0] * (p_bucket - n)
                        for r, n in zip(group, lens)]
             seed = group[0].seed if len(group) == 1 else hash(
