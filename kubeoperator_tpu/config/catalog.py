@@ -22,6 +22,9 @@ class StepDef:
     name: str
     module: str
     targets: tuple[str, ...]
+    # fault-tolerance overrides; None -> the config defaults apply
+    retry: int | None = None         # transient-failure retries for this step
+    timeout_s: float | None = None   # hard per-step deadline in the driver
 
 
 @dataclass(frozen=True)
@@ -107,7 +110,9 @@ class Catalog:
 def _parse(raw: dict[str, Any]) -> Catalog:
     cat = Catalog(raw=raw)
     for name, spec in raw.get("steps", {}).items():
-        cat.steps[name] = StepDef(name=name, module=spec["module"], targets=tuple(spec["targets"]))
+        cat.steps[name] = StepDef(
+            name=name, module=spec["module"], targets=tuple(spec["targets"]),
+            retry=spec.get("retry"), timeout_s=spec.get("timeout_s"))
     cat.operations = {k: list(v) for k, v in raw.get("operations", {}).items()}
     for op, steps in cat.operations.items():
         missing = [s for s in steps if s not in cat.steps]

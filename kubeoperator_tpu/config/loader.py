@@ -29,7 +29,22 @@ DEFAULTS: dict[str, Any] = {
     # engine
     "task_workers": 4,                      # ref: celery -c 4 (core/kubeops.py:28)
     "node_forks": 10,                       # ref: ansible forks=5 (runner.py:39); TPU pools are bigger
+    # fault tolerance (ISSUE 1): step-level retries for transient failures
+    # (catalog per-step `retry` overrides), exponential backoff + jitter
+    # between attempts, capped; plus transport-level command retries inside
+    # HostOps for flaked SSH round-trips
     "step_retry": 1,
+    "step_backoff_s": 1.0,
+    "step_backoff_max_s": 30.0,
+    "exec_retry": 2,
+    "exec_backoff_s": 0.2,
+    # quarantine: a non-critical worker that keeps transiently failing is
+    # dropped from the operation (step succeeds with a WARNING; the host is
+    # recorded for the healing beat) instead of failing the whole install
+    "quarantine": True,
+    # executor "chaos" (fake transport + fault injection): "<rate>:<regex>"
+    # flakes matching commands, e.g. KO_CHAOS_FLAKE="0.3:mkdir|sysctl"
+    "chaos_flake": "",
     "ssh_connect_timeout": 10,
     # api
     "bind_host": "127.0.0.1",

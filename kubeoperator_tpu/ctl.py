@@ -123,17 +123,24 @@ def cmd_cluster(args) -> int:
 
 
 def _watch(c: Client, ex_id: str) -> int:
-    """Poll the execution until terminal, printing step transitions."""
-    seen: dict[str, str] = {}
+    """Poll the execution until terminal, printing step transitions (and
+    in-flight retries: the driver bumps ``retries`` per transient-failure
+    attempt, so a (status, retries) change reprints the line)."""
+    seen: dict[str, tuple] = {}
     while True:
         ex = c.call("GET", f"/api/v1/executions/{ex_id}")
         for s in ex.get("steps", []):
-            if seen.get(s["name"]) != s["status"]:
-                seen[s["name"]] = s["status"]
+            key = (s["status"], s.get("retries", 0))
+            if seen.get(s["name"]) != key:
+                seen[s["name"]] = key
                 mark = {"success": "✔", "error": "✘", "running": "▶",
                         "skipped": "↷"}.get(s["status"], "·")
-                print(f"  {mark} {s['name']} {s.get('message', '')}".rstrip())
+                retries = f" [retry {s['retries']}]" if s.get("retries") else ""
+                print(f"  {mark} {s['name']}{retries} {s.get('message', '')}".rstrip())
         if ex["state"] in ("SUCCESS", "FAILURE"):
+            quarantined = ex.get("result", {}).get("quarantined", {})
+            if quarantined:
+                print("quarantined hosts: " + ", ".join(sorted(quarantined)))
             print(f"{ex['operation']} {ex['state']}")
             return 0 if ex["state"] == "SUCCESS" else 1
         time.sleep(2)

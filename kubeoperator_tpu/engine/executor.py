@@ -17,12 +17,15 @@ build on:
 from __future__ import annotations
 
 import atexit
+import hashlib
 import os
+import random
 import re
 import shlex
 import subprocess
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -49,6 +52,19 @@ class Conn:
         )
 
 
+# Transport-failure classification. rc 124 is the local/SSH subprocess
+# timeout convention (and GNU timeout's); rc 255 is OpenSSH's "connection
+# never happened" (and the FakeExecutor's down-host marker). Both mean the
+# *transport* flaked, not that the remote command ran and failed — the
+# retry policy treats them uniformly (ISSUE 1 satellite: ping's 255 and
+# run's 124 were previously two unrelated conventions).
+TRANSIENT_RCS = frozenset({124, 255})
+_TRANSIENT_RE = re.compile(
+    r"connection (refused|reset|closed|timed out)"
+    r"|timed? ?out|no route to host|network is unreachable"
+    r"|temporarily unavailable|broken pipe", re.I)
+
+
 @dataclass
 class ExecResult:
     rc: int
@@ -59,14 +75,31 @@ class ExecResult:
     def ok(self) -> bool:
         return self.rc == 0
 
+    @property
+    def transient(self) -> bool:
+        """True when the failure looks like a transport flake (timeout,
+        refused/reset connection) rather than the remote command itself
+        failing — the class of errors worth retrying."""
+        if self.ok:
+            return False
+        return self.rc in TRANSIENT_RCS or bool(_TRANSIENT_RE.search(self.stderr))
+
     def check(self, what: str = "command") -> "ExecResult":
         if not self.ok:
-            raise ExecError(f"{what} failed (rc={self.rc}): {self.stderr or self.stdout}")
+            cls = TransientError if self.transient else ExecError
+            raise cls(f"{what} failed (rc={self.rc}): {self.stderr or self.stdout}")
         return self
 
 
 class ExecError(RuntimeError):
     pass
+
+
+class TransientError(ExecError):
+    """A transport-level flake (SSH timeout, connection refused/reset):
+    safe to retry — the remote command either never ran or is idempotent.
+    ``transient`` is what the step driver's retry policy keys on."""
+    transient = True
 
 
 class Executor:
@@ -139,7 +172,10 @@ class SSHExecutor(Executor):
     def _key_path(self, conn: Conn) -> str | None:
         if not conn.private_key:
             return None
-        digest = str(hash(conn.private_key))
+        # sha256, NOT str(hash(...)): Python string hashing is per-process
+        # randomized and collision-prone across credentials — two distinct
+        # keys must never silently share one keyfile
+        digest = hashlib.sha256(conn.private_key.encode()).hexdigest()
         with self._lock:
             if digest not in self._keyfiles:
                 fd, path = tempfile.mkstemp(prefix="ko-key-")
@@ -419,3 +455,123 @@ class FakeExecutor(Executor):
     # -- assertions for tests ---------------------------------------------
     def ran(self, ip: str, pattern: str) -> bool:
         return any(re.search(pattern, c) for c in self.host(ip).history)
+
+
+# ---------------------------------------------------------------------------
+
+
+# default seed for deterministic chaos runs; override with KO_CHAOS_SEED
+CHAOS_SEED_ENV = "KO_CHAOS_SEED"
+DEFAULT_CHAOS_SEED = 1337
+
+
+class ChaosExecutor(Executor):
+    """Fault-injection wrapper around any transport (normally the
+    FakeExecutor) — the chaos harness the soak tests drive a full
+    install/scale/upgrade through.
+
+    Faults are *transient-shaped* (rc 255 resets, rc 124 timeouts) so they
+    exercise exactly the classification + retry + quarantine machinery:
+
+    * ``fail_next(n, pattern=)`` — deterministically fail the next ``n``
+      matching commands (transport reset);
+    * ``flake(pattern, rate)``   — each matching command fails with
+      probability ``rate`` (seeded RNG → reproducible sequences);
+    * ``latency_s``              — fixed injected delay per command;
+    * ``kill_after(ip, n)``      — the host dies mid-operation after ``n``
+      more commands and stays dead (``revive`` brings it back).
+
+    The RNG seeds from ``KO_CHAOS_SEED`` (default 1337) so CI failures
+    replay exactly; ``injected``/``calls`` counters let tests assert both
+    that chaos actually fired and that retries stayed bounded.
+    """
+
+    def __init__(self, inner: Executor, seed: int | None = None,
+                 latency_s: float = 0.0):
+        self.inner = inner
+        if seed is None:
+            seed = int(os.environ.get(CHAOS_SEED_ENV, DEFAULT_CHAOS_SEED))
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.latency_s = latency_s
+        self._lock = threading.Lock()
+        self._fail_next: list[tuple[re.Pattern | None, int]] = []
+        self._flakes: list[tuple[re.Pattern, float]] = []
+        self._kill: dict[str, int] = {}      # ip -> commands until death
+        self._dead: set[str] = set()
+        self.calls = 0
+        self.injected = 0
+
+    # -- fault programming -------------------------------------------------
+    def fail_next(self, n: int = 1, pattern: str | None = None) -> None:
+        """Fail the next ``n`` commands (matching ``pattern`` if given)."""
+        with self._lock:
+            self._fail_next.append((re.compile(pattern) if pattern else None, n))
+
+    def flake(self, pattern: str, rate: float) -> None:
+        """Matching commands fail with probability ``rate``."""
+        with self._lock:
+            self._flakes.append((re.compile(pattern), rate))
+
+    def kill_after(self, ip: str, commands: int = 0) -> None:
+        """``ip`` dies after ``commands`` more commands and stays dead."""
+        with self._lock:
+            self._kill[ip] = commands
+
+    def revive(self, ip: str) -> None:
+        """The dead host comes back (heal/replacement happened)."""
+        with self._lock:
+            self._dead.discard(ip)
+            self._kill.pop(ip, None)
+
+    # -- fault evaluation --------------------------------------------------
+    def _chaos(self, ip: str, command: str) -> ExecResult | None:
+        with self._lock:
+            self.calls += 1
+            if ip in self._dead:
+                self.injected += 1
+                return ExecResult(255, "", "chaos: host is dead")
+            if ip in self._kill:
+                self._kill[ip] -= 1
+                if self._kill[ip] < 0:
+                    del self._kill[ip]
+                    self._dead.add(ip)
+                    self.injected += 1
+                    return ExecResult(255, "", "chaos: host died mid-operation")
+            for idx, (pat, left) in enumerate(self._fail_next):
+                if pat is None or pat.search(command):
+                    if left <= 1:
+                        del self._fail_next[idx]
+                    else:
+                        self._fail_next[idx] = (pat, left - 1)
+                    self.injected += 1
+                    return ExecResult(255, "", "chaos: injected connection reset")
+            for pat, rate in self._flakes:
+                if pat.search(command) and self.rng.random() < rate:
+                    self.injected += 1
+                    return ExecResult(124, "", "chaos: injected timeout")
+        return None
+
+    # -- interface ---------------------------------------------------------
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        injected = self._chaos(conn.ip, command)
+        if injected is not None:
+            return injected
+        return self.inner.run(conn, command, timeout=timeout)
+
+    def put_file(self, conn: Conn, path: str, content: bytes, mode: int = 0o644) -> None:
+        injected = self._chaos(conn.ip, f"put_file {path}")
+        if injected is not None:
+            raise TransientError(f"put_file {path} failed: {injected.stderr}")
+        self.inner.put_file(conn, path, content, mode=mode)
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        injected = self._chaos(conn.ip, f"get_file {path}")
+        if injected is not None:
+            raise TransientError(f"get_file {path} failed: {injected.stderr}")
+        return self.inner.get_file(conn, path)
+
+    def tty_argv(self, conn: Conn, command: str) -> list[str] | None:
+        return self.inner.tty_argv(conn, command)

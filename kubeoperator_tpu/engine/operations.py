@@ -12,10 +12,17 @@ Inventory is rebuilt before every step: the provider step mutates it
 
 from __future__ import annotations
 
+import contextvars
+import random
+import threading
+import time
 from dataclasses import asdict
 
+from kubeoperator_tpu.config.catalog import StepDef
 from kubeoperator_tpu.engine.inventory import build_inventory
-from kubeoperator_tpu.engine.steps import StepContext, StepError, load_step
+from kubeoperator_tpu.engine.steps import (
+    StepContext, StepDeadline, StepError, load_step,
+)
 from kubeoperator_tpu.resources import scope
 from kubeoperator_tpu.resources.entities import (
     Cluster, ClusterStatus, DeployExecution, ExecutionState, ExecutionStep,
@@ -48,6 +55,48 @@ RUNNING_STATUS = {
 DONE_STATUS = {
     "uninstall": ClusterStatus.READY,
 }
+
+# hard cap on quarantine rounds per step — each round must quarantine at
+# least one new host, so this only trips on a pathological cluster where
+# workers keep dying one by one mid-step
+MAX_QUARANTINE_ROUNDS = 8
+
+
+def _backoff(config, attempt: int) -> float:
+    """Exponential backoff with full-range jitter for step retry ``attempt``
+    (1-based): base * 2^(attempt-1), capped, then scaled by [0.5, 1.0) so
+    parallel operations don't thundering-herd a recovering mirror."""
+    base = float(config.get("step_backoff_s", 1.0))
+    cap = float(config.get("step_backoff_max_s", 30.0))
+    return min(cap, base * (2 ** (attempt - 1))) * (0.5 + random.random() / 2)
+
+
+def _call_step(fn, ctx: StepContext, step_def: StepDef):
+    """Invoke the step, enforcing the catalog-declared ``timeout_s`` when
+    present: the step runs in a side thread and a deadline overrun raises
+    StepDeadline immediately — the wedged (daemon) thread is abandoned
+    rather than left hanging a TaskEngine worker."""
+    if not step_def.timeout_s:
+        return fn(ctx)
+    box: dict = {}
+    cctx = contextvars.copy_context()   # keep CURRENT_TASK for log routing
+
+    def target():
+        try:
+            box["result"] = cctx.run(fn, ctx)
+        except BaseException as e:  # noqa: BLE001 — relayed to the driver
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"ko-step-{step_def.name}")
+    t.start()
+    t.join(step_def.timeout_s)
+    if t.is_alive():
+        raise StepDeadline(
+            f"step {step_def.name!r} exceeded its {step_def.timeout_s}s deadline")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
 
 
 def run_execution(platform, execution_id: str) -> DeployExecution:
@@ -97,6 +146,7 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
                         execution.project, resume_from, execution.operation)
 
     error: str | None = None
+    quarantined: dict[str, str] = {}   # host -> reason, shared across steps
     for i, step_def in enumerate(steps):
         if i < start_index:
             continue
@@ -106,45 +156,97 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
         store.save(execution)
         log.info("[%s] %s: step %s (%d/%d)", execution.project,
                  execution.operation, step_def.name, i + 1, len(steps))
-        try:
-            cluster = store.get_by_name(Cluster, execution.project) or cluster
-            ctx = StepContext(
-                cluster=cluster,
-                store=store,
-                inventory=build_inventory(store, cluster, platform.catalog),
-                executor=platform.executor,
-                catalog=platform.catalog,
-                config=platform.config,
-                vars={k: v for k, v in {
-                      **cluster.configs,
-                      **execution.params.get("upgrade_vars", {}),
-                      **execution.params.get("vars", {})}.items()
-                      if v != UPGRADE_DROP},
-                step=step_def,
-                provider=platform.provider_for(cluster),
-                params=execution.params,
-                operation=execution.operation,
-            )
-            result = load_step(step_def)(ctx)
-            execution.steps[i]["status"] = StepState.SUCCESS
-            if isinstance(result, dict):
-                execution.result[step_def.name] = result
-        except Exception as e:  # noqa: BLE001 — step boundary
-            error = f"{step_def.name}: {e}"
-            execution.steps[i]["status"] = StepState.ERROR
-            execution.steps[i]["message"] = str(e)
-            log.error("[%s] step %s failed: %s", execution.project, step_def.name, e)
-        finally:
-            execution.steps[i]["finished_at"] = iso()
-            done = sum(1 for s in execution.steps
-                       if s["status"] in (StepState.SUCCESS, StepState.ERROR,
-                                          StepState.SKIPPED))
-            execution.progress = round(done / len(steps), 3)
-            store.save(execution)
+        # retry budget: catalog per-step `retry` override, else config
+        # `step_retry`; only *transient* failures consume it
+        retries = (step_def.retry if step_def.retry is not None
+                   else int(platform.config.get("step_retry", 1)))
+        attempt = 0
+        quarantine_rounds = 0
+        while True:
+            try:
+                cluster = store.get_by_name(Cluster, execution.project) or cluster
+                ctx = StepContext(
+                    cluster=cluster,
+                    store=store,
+                    inventory=build_inventory(store, cluster, platform.catalog),
+                    executor=platform.executor,
+                    catalog=platform.catalog,
+                    config=platform.config,
+                    vars={k: v for k, v in {
+                          **cluster.configs,
+                          **execution.params.get("upgrade_vars", {}),
+                          **execution.params.get("vars", {})}.items()
+                          if v != UPGRADE_DROP},
+                    step=step_def,
+                    provider=platform.provider_for(cluster),
+                    params=execution.params,
+                    operation=execution.operation,
+                    quarantined=quarantined,
+                )
+                result = _call_step(load_step(step_def), ctx, step_def)
+                execution.steps[i]["status"] = StepState.SUCCESS
+                if quarantine_rounds:
+                    execution.steps[i]["message"] = (
+                        "succeeded with quarantined hosts: "
+                        + ", ".join(sorted(quarantined)))
+                elif execution.steps[i].get("retries"):
+                    # drop the stale retry complaint; the count survives in
+                    # the ``retries`` field
+                    execution.steps[i]["message"] = ""
+                if isinstance(result, dict):
+                    execution.result[step_def.name] = result
+            except Exception as e:  # noqa: BLE001 — step boundary
+                if getattr(e, "transient", False) and attempt < retries:
+                    attempt += 1
+                    delay = _backoff(platform.config, attempt)
+                    execution.steps[i]["retries"] = attempt
+                    execution.steps[i]["backoff_s"] = round(
+                        execution.steps[i]["backoff_s"] + delay, 3)
+                    execution.steps[i]["message"] = (
+                        f"retry {attempt}/{retries} after transient failure: {e}")
+                    store.save(execution)   # progress stream sees the retry
+                    log.warning("[%s] step %s attempt %d/%d failed "
+                                "transiently (%s); backing off %.1fs",
+                                execution.project, step_def.name, attempt,
+                                retries + 1, e, delay)
+                    time.sleep(delay)
+                    continue
+                # graceful degradation: retries exhausted, but every failure
+                # sits on a non-critical, transiently-failing host while the
+                # step succeeded elsewhere — quarantine those hosts and
+                # re-run the step without them instead of failing the
+                # operation; the healing beat replaces them later
+                quarantinable = getattr(e, "quarantinable", None)
+                if (quarantinable and platform.config.get("quarantine", True)
+                        and quarantine_rounds < MAX_QUARANTINE_ROUNDS):
+                    quarantine_rounds += 1
+                    for name, why in quarantinable.items():
+                        quarantined[name] = f"{step_def.name}: {why}"
+                    log.warning("[%s] step %s: quarantining %s (%s)",
+                                execution.project, step_def.name,
+                                ", ".join(sorted(quarantinable)), e)
+                    continue
+                error = f"{step_def.name}: {e}"
+                execution.steps[i]["status"] = StepState.ERROR
+                execution.steps[i]["message"] = str(e)
+                log.error("[%s] step %s failed: %s", execution.project,
+                          step_def.name, e)
+            break
+        execution.steps[i]["finished_at"] = iso()
+        done = sum(1 for s in execution.steps
+                   if s["status"] in (StepState.SUCCESS, StepState.ERROR,
+                                      StepState.SKIPPED))
+        execution.progress = round(done / len(steps), 3)
+        store.save(execution)
         if error:
             break
 
     execution.finished_at = iso()
+    if quarantined:
+        # hand-off to the healing beat (services/healing.py): the hosts are
+        # named in the result, the cluster goes WARNING (still heal-eligible)
+        # and the notification below fans out at WARNING level
+        execution.result["quarantined"] = dict(quarantined)
     if error:
         execution.state = ExecutionState.FAILURE
         execution.result["error"] = error
@@ -152,6 +254,8 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
     else:
         execution.state = ExecutionState.SUCCESS
         cluster.status = DONE_STATUS.get(execution.operation, ClusterStatus.RUNNING)
+        if quarantined and cluster.status == ClusterStatus.RUNNING:
+            cluster.status = ClusterStatus.WARNING
         if execution.operation in ("scale", "add-worker"):
             _exit_new_node(store, cluster)
         if execution.operation == "upgrade" and execution.params.get("upgrade_package"):
@@ -170,10 +274,13 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
     store.save(cluster)
     platform.notify(
         title=f"cluster {cluster.name} {execution.operation} "
-              f"{'failed' if error else 'succeeded'}",
-        level="ERROR" if error else "INFO",
+              + ("failed" if error else
+                 "succeeded with quarantined hosts" if quarantined
+                 else "succeeded"),
+        level="ERROR" if error else "WARNING" if quarantined else "INFO",
         project=cluster.name,
         content={"execution": execution.id, "error": error or "",
+                 "quarantined": dict(quarantined),
                  "prev_status": prev_status},
     )
     return execution
@@ -203,5 +310,8 @@ def progress_payload(execution: DeployExecution) -> dict:
         "state": execution.state,
         "progress": execution.progress,
         "current_step": execution.current_step,
+        # steps carry per-step retries/backoff_s so clients can render
+        # "retry n/m" live; quarantined hosts surface once recorded
         "steps": execution.steps,
+        "quarantined": execution.result.get("quarantined", {}),
     }

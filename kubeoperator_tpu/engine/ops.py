@@ -8,19 +8,64 @@ systemd/shell/yum). Every operation converges state and is safe to re-run.
 from __future__ import annotations
 
 import hashlib
+import random
 import shlex
+import time
 
 from kubeoperator_tpu.engine.executor import Conn, ExecResult, Executor
 
+# roles whose failure must always fail the step: losing a master or etcd
+# member is never gracefully degradable (quorum/control-plane at stake),
+# while a plain/TPU worker can be quarantined and handed to the healing
+# beat (services/healing.py) for replacement
+CRITICAL_ROLES = frozenset({"master", "etcd"})
+
+
+def is_critical(roles: list[str] | tuple[str, ...]) -> bool:
+    return bool(CRITICAL_ROLES.intersection(roles))
+
+
+def split_failures(targets, failures: dict[str, tuple[str, bool]],
+                   ) -> tuple[dict[str, str], dict[str, str]]:
+    """Partition per-host fan-out ``failures`` (name -> (msg, transient))
+    into (fatal, quarantinable). Quarantinable = a non-critical host whose
+    failure is transport-shaped (down/unreachable), and only while the step
+    still succeeded somewhere — if *every* target failed, nothing is
+    quarantined: that's an operation problem, not one bad node."""
+    roles = {th.name: th.roles for th in targets}
+    fatal: dict[str, str] = {}
+    quarantinable: dict[str, str] = {}
+    partial = len(failures) < len(targets)
+    for name, (msg, transient) in failures.items():
+        if partial and transient and not is_critical(roles.get(name, ())):
+            quarantinable[name] = msg
+        else:
+            fatal[name] = msg
+    return fatal, quarantinable
+
 
 class HostOps:
-    def __init__(self, executor: Executor, conn: Conn):
+    def __init__(self, executor: Executor, conn: Conn,
+                 retries: int = 2, backoff_s: float = 0.2):
         self.x = executor
         self.conn = conn
+        self.retries = retries
+        self.backoff_s = backoff_s
 
     # -- primitives --------------------------------------------------------
     def sh(self, command: str, check: bool = True, timeout: int = 300) -> ExecResult:
         r = self.x.run(self.conn, command, timeout=timeout)
+        # transport-level retry: a flaked command (timeout/refused/reset) is
+        # re-run with exponential backoff + jitter. Safe unconditionally —
+        # the whole ops vocabulary is convergent. Permanent failures (the
+        # command ran and exited nonzero) are never retried here.
+        for attempt in range(self.retries):
+            if not r.transient:
+                break
+            if self.backoff_s:
+                time.sleep(self.backoff_s * (2 ** attempt)
+                           * (0.5 + random.random() / 2))
+            r = self.x.run(self.conn, command, timeout=timeout)
         if check:
             r.check(command.split()[0] if command else "command")
         return r

@@ -23,9 +23,9 @@ from typing import Any, Callable
 
 from kubeoperator_tpu.config.catalog import Catalog, StepDef
 from kubeoperator_tpu.config.loader import Config
-from kubeoperator_tpu.engine.executor import ExecError, Executor
+from kubeoperator_tpu.engine.executor import ExecError, Executor, TransientError
 from kubeoperator_tpu.engine.inventory import Inventory, TargetHost
-from kubeoperator_tpu.engine.ops import HostOps
+from kubeoperator_tpu.engine.ops import HostOps, split_failures
 from kubeoperator_tpu.resources.entities import Cluster
 from kubeoperator_tpu.resources.store import Store
 from kubeoperator_tpu.utils.logs import get_logger
@@ -35,7 +35,41 @@ log = get_logger(__name__)
 
 class StepError(RuntimeError):
     """Raised by a step to fail the execution at that step (reference:
-    step status ERROR stops the operation, ``deploy.py:127-134``)."""
+    step status ERROR stops the operation, ``deploy.py:127-134``).
+    ``transient`` marks failures the driver may retry with backoff."""
+
+    transient = False
+
+
+class StepDeadline(StepError):
+    """The step blew its catalog-declared ``timeout_s`` — the driver fails
+    fast instead of hanging a TaskEngine worker. Deadline overruns are
+    treated as transient (a wedged mirror/apiserver usually recovers)."""
+
+    transient = True
+
+
+class HostFailures(StepError):
+    """Per-host fan-out failures, pre-partitioned for the driver's retry
+    and quarantine policy:
+
+    * ``failures``      — every failed host, name -> message;
+    * ``transient``     — True iff *all* failures are transport-shaped
+                          (the whole step is worth retrying);
+    * ``quarantinable`` — the non-critical transiently-failing subset the
+                          driver may quarantine once retries are exhausted
+                          (empty when any critical host failed with them,
+                          or when no host succeeded at all).
+    """
+
+    def __init__(self, targets: list[TargetHost],
+                 failures: dict[str, tuple[str, bool]]):
+        self.failures = {name: msg for name, (msg, _) in failures.items()}
+        self.transient = all(t for _, t in failures.values())
+        fatal, quarantinable = split_failures(targets, failures)
+        self.quarantinable = {} if fatal else quarantinable
+        super().__init__(
+            f"{len(failures)}/{len(targets)} hosts failed: {self.failures}")
 
 
 @dataclass
@@ -51,6 +85,9 @@ class StepContext:
     provider: Any = None          # CloudProvider for AUTOMATIC clusters
     params: dict[str, Any] = field(default_factory=dict)  # operation params
     operation: str = ""           # the running operation (install/scale/...)
+    quarantined: dict[str, str] = field(default_factory=dict)
+    # ^ host name -> reason, shared across the operation's steps: hosts the
+    #   driver quarantined stop being targeted and are excluded from checks
 
     # -- helpers usable by every step -------------------------------------
     def targets(self) -> list[TargetHost]:
@@ -59,23 +96,26 @@ class StepContext:
         seen: set[str] = set()
         for expr in self.step.targets:
             for th in self.inventory.targets(expr):
-                if th.name not in seen:
+                if th.name not in seen and th.name not in self.quarantined:
                     seen.add(th.name)
                     out.append(th)
         return out
 
     def ops(self, th: TargetHost) -> HostOps:
-        return HostOps(self.executor, th.conn)
+        return HostOps(self.executor, th.conn,
+                       retries=int(self.config.get("exec_retry", 2)),
+                       backoff_s=float(self.config.get("exec_backoff_s", 0.2)))
 
     def fan_out(self, fn: Callable[[TargetHost], Any],
                 targets: list[TargetHost] | None = None) -> dict[str, Any]:
-        """Run ``fn`` on every target host in parallel; raise StepError with
-        the full per-host failure map if any host fails."""
+        """Run ``fn`` on every target host in parallel; raise HostFailures
+        with the full per-host failure map (plus transient/quarantinable
+        classification for the driver) if any host fails."""
         targets = self.targets() if targets is None else targets
         if not targets:
             return {}
         results: dict[str, Any] = {}
-        failures: dict[str, str] = {}
+        failures: dict[str, tuple[str, bool]] = {}   # name -> (msg, transient)
         workers = max(1, min(int(self.config.get("node_forks", 10)), len(targets)))
         with ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-fanout") as pool:
             # copy_context per host: worker threads inherit CURRENT_TASK so
@@ -85,12 +125,36 @@ class StepContext:
             for fut, th in futs.items():
                 try:
                     results[th.name] = fut.result()
+                except TransientError as e:
+                    failures[th.name] = (str(e), True)
                 except (StepError, ExecError) as e:
-                    failures[th.name] = str(e)
+                    failures[th.name] = (str(e), bool(getattr(e, "transient", False)))
                 except Exception as e:  # noqa: BLE001 — per-host boundary
-                    failures[th.name] = f"{type(e).__name__}: {e}"
+                    failures[th.name] = (f"{type(e).__name__}: {e}", False)
         if failures:
-            raise StepError(f"{len(failures)}/{len(targets)} hosts failed: {failures}")
+            raise HostFailures(targets, failures)
+        return results
+
+    def roll(self, fn: Callable[[TargetHost], Any],
+             targets: list[TargetHost] | None = None) -> dict[str, Any]:
+        """Serial (rolling) counterpart of fan_out for steps that must keep
+        capacity up by touching one host at a time (e.g. cordon/upgrade/
+        uncordon). Collects the same per-host failure map so the driver can
+        quarantine a dead non-critical host instead of aborting."""
+        targets = self.targets() if targets is None else targets
+        results: dict[str, Any] = {}
+        failures: dict[str, tuple[str, bool]] = {}
+        for th in targets:
+            try:
+                results[th.name] = fn(th)
+            except TransientError as e:
+                failures[th.name] = (str(e), True)
+            except (StepError, ExecError) as e:
+                failures[th.name] = (str(e), bool(getattr(e, "transient", False)))
+            except Exception as e:  # noqa: BLE001 — per-host boundary
+                failures[th.name] = (f"{type(e).__name__}: {e}", False)
+        if failures:
+            raise HostFailures(targets, failures)
         return results
 
 

@@ -9,7 +9,11 @@ from kubeoperator_tpu.engine.steps import k8s
 
 
 def run(ctx: StepContext):
-    expected = {th.name for th in ctx.inventory.workers()}
+    # quarantined workers are known-absent: the operation degraded around
+    # them and the healing beat owns their replacement — expecting them
+    # here would turn every quarantine into a post-check failure
+    expected = ({th.name for th in ctx.inventory.workers()}
+                - set(ctx.quarantined))
 
     def per(th):
         o = ctx.ops(th)

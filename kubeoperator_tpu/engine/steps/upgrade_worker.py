@@ -12,7 +12,7 @@ def run(ctx: StepContext):
     masters = ctx.inventory.masters()
     mo = ctx.ops(masters[0]) if masters else None
 
-    for th in ctx.targets():
+    def upgrade_one(th):
         if mo:
             mo.sh(f"{k8s.KUBECTL} cordon {th.name}", check=False)
         o = ctx.ops(th)
@@ -21,3 +21,8 @@ def run(ctx: StepContext):
         o.sh("systemctl restart kubelet && systemctl restart kube-proxy")
         if mo:
             mo.sh(f"{k8s.KUBECTL} uncordon {th.name}", check=False)
+
+    # roll (not fan_out): one worker at a time keeps serving capacity up,
+    # while the per-host failure map still lets the driver quarantine a
+    # dead worker instead of failing the whole upgrade
+    ctx.roll(upgrade_one)

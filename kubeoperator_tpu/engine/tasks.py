@@ -19,6 +19,7 @@ import os
 import threading
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -107,9 +108,17 @@ class TaskEngine:
             handler.close()
 
     def wait(self, task_id: str, timeout: float | None = None) -> TaskRecord:
-        rec = self.tasks[task_id]
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            raise KeyError(f"unknown task id {task_id!r} "
+                           f"({len(self.tasks)} records known)")
         if rec.future is not None:
-            rec.future.result(timeout=timeout)
+            try:
+                rec.future.result(timeout=timeout)
+            except (TimeoutError, FutureTimeoutError):
+                raise           # still running — the caller's timeout expired
+            except Exception:   # noqa: BLE001 — _run already recorded FAILURE
+                pass            # callers read rec.state / rec.error instead
         return rec
 
     def task_log_path(self, task_id: str) -> str:

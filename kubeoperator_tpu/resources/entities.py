@@ -191,6 +191,8 @@ class ExecutionStep:
     message: str = ""
     started_at: str = ""
     finished_at: str = ""
+    retries: int = 0          # transient-failure retries the driver spent
+    backoff_s: float = 0.0    # total backoff slept between the attempts
 
 
 @dataclass

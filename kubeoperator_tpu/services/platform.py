@@ -18,7 +18,7 @@ from kubeoperator_tpu.config.catalog import Catalog, load_catalog
 from kubeoperator_tpu.config.loader import Config, load_config
 from kubeoperator_tpu.engine import adhoc, operations
 from kubeoperator_tpu.engine.executor import (
-    Conn, Executor, FakeExecutor, SSHExecutor,
+    ChaosExecutor, Conn, Executor, FakeExecutor, SSHExecutor,
 )
 from kubeoperator_tpu.engine.tasks import TaskEngine, TaskRecord
 from kubeoperator_tpu.providers import PROVIDERS, TerraformDriver
@@ -55,6 +55,15 @@ class Platform:
             self.executor = executor
         elif self.config.executor == "fake":
             self.executor = FakeExecutor()
+        elif self.config.executor == "chaos":
+            # live fault-injection rig: fake transport wrapped in the seeded
+            # chaos layer; KO_CHAOS_FLAKE="<rate>:<regex>" flakes matching
+            # commands, KO_CHAOS_SEED pins the RNG
+            self.executor = ChaosExecutor(FakeExecutor())
+            spec = str(self.config.get("chaos_flake", "") or "")
+            if ":" in spec:
+                rate, pattern = spec.split(":", 1)
+                self.executor.flake(pattern, float(rate))
         else:
             self.executor = SSHExecutor(connect_timeout=self.config.ssh_connect_timeout)
         self._ensure_auth_secret()

@@ -1,0 +1,141 @@
+"""Chaos soak (ISSUE 1, marked slow): a full AUTOMATIC install + scale +
+upgrade driven through the ChaosExecutor with randomized-but-seeded
+transient faults (flake rate 0.25, injected latency) plus a mid-operation
+host death — asserting the engine converges, retries stay bounded, and the
+dead worker is quarantined rather than failing the upgrade.
+
+The fast deterministic counterpart lives in test_fault_tolerance.py and
+runs in tier-1; this module exists to grind the same machinery much harder
+(hundreds of chaos decisions across three operations).
+"""
+
+import hashlib
+import os
+
+import pytest
+import yaml
+
+from kubeoperator_tpu.config.loader import load_config
+from kubeoperator_tpu.engine.executor import ChaosExecutor, FakeExecutor
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, ExecutionState, Host, Plan, Region, Zone,
+)
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.services.platform import Platform
+
+pytestmark = pytest.mark.slow
+
+# commands the chaos layer flakes: the prepare/worker vocabulary plus the
+# package-plane fetches — the exact traffic an air-gapped install is made of
+FLAKY = r"mkdir|sysctl|systemctl (enable|restart)|curl|ctr |cat |hostnamectl"
+FLAKE_RATE = 0.25
+
+
+def _k8s_package(platform, name, version):
+    from kubeoperator_tpu.services import packages as svc
+    from kubeoperator_tpu.services.packages import scan_packages
+
+    binaries = ("etcd", "etcdctl", "kube-apiserver", "kube-controller-manager",
+                "kube-scheduler", "kubectl", "kubelet", "kube-proxy")
+    pkg_dir = os.path.join(platform.config.packages, name)
+    os.makedirs(pkg_dir, exist_ok=True)
+    base = svc.repo_base_url(platform)
+    checksums = {b: hashlib.sha256(f"fetched:{base}/{name}/{b}".encode()).hexdigest()
+                 for b in binaries}
+    with open(os.path.join(pkg_dir, "meta.yml"), "w", encoding="utf-8") as f:
+        yaml.safe_dump({"name": name, "version": version,
+                        "vars": {"kube_version": version},
+                        "checksums": checksums}, f)
+    scan_packages(platform)
+
+
+@pytest.fixture
+def soak(tmp_path):
+    chaos = ChaosExecutor(FakeExecutor(), seed=20260804, latency_s=0.001)
+    cfg = load_config(overrides={
+        "data_dir": str(tmp_path / "data"),
+        "executor": "fake",
+        "terraform_bin": "",
+        "task_workers": 2,
+        "node_forks": 8,
+        "repo_host": "127.0.0.1",
+        # generous transport retries absorb the 0.25 flake; the step budget
+        # catches the tail — backoff near-zero to keep the soak minutes-free
+        "exec_retry": 5,
+        "exec_backoff_s": 0.0,
+        "step_retry": 4,
+        "step_backoff_s": 0.005,
+        "step_backoff_max_s": 0.02,
+    })
+    p = Platform(config=cfg, store=Store(), executor=chaos)
+    _k8s_package(p, "k8s-v1", "v1.28.0")
+    _k8s_package(p, "k8s-v2", "v1.29.0")
+    region = Region(name="us-central2", provider="gce",
+                    vars={"project": "t", "gce_region": "us-central2"})
+    p.store.save(region)
+    zone = Zone(name="us-central2-b", region_id=region.id,
+                vars={"gce_zone": "us-central2-b"},
+                ip_pool=[f"10.8.0.{i}" for i in range(10, 60)])
+    p.store.save(zone)
+    plan = Plan(name="tpu-plan", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=2,
+                tpu_pools=[{"slice_type": "v5e-8", "count": 1,
+                            "zone": zone.name}])
+    p.store.save(plan)
+    p.create_cluster("soak", template="SINGLE", deploy_type="AUTOMATIC",
+                     plan_id=plan.id, package="k8s-v1",
+                     configs={"registry": "reg.local:8082"})
+    yield p, chaos
+    p.shutdown()
+
+
+def _retry_budget_respected(ex, platform):
+    cat = platform.catalog
+    for s in ex.steps:
+        step_def = cat.steps.get(s["name"])
+        budget = (step_def.retry if step_def and step_def.retry is not None
+                  else int(platform.config["step_retry"]))
+        assert s["retries"] <= budget, (s["name"], s["retries"], budget)
+
+
+def test_soak_install_scale_upgrade_under_chaos(soak):
+    platform, chaos = soak
+    chaos.flake(FLAKY, FLAKE_RATE)
+
+    # -- Day 1: install converges despite constant transport flakes -------
+    ex = platform.run_operation("soak", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert "quarantined" not in ex.result
+    assert chaos.injected > 20, "soak chaos barely fired; flake wiring broke"
+    _retry_budget_respected(ex, platform)
+
+    # -- Day 2: scale up under the same chaos ------------------------------
+    ex = platform.run_operation("soak", "scale", {"worker_size": 4})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    workers = [h for h in platform.store.find(Host, scoped=False, project="soak")
+               if "-worker-" in h.name]
+    assert len(workers) == 4
+    _retry_budget_respected(ex, platform)
+
+    # -- mid-operation host death: a worker dies during the upgrade --------
+    victim = sorted(workers, key=lambda h: h.name)[-1]
+    chaos.kill_after(victim.ip, 10)
+    ex = platform.run_operation("soak", "upgrade", {"package": "k8s-v2"})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert list(ex.result["quarantined"]) == [victim.name]
+    _retry_budget_respected(ex, platform)
+
+    cluster = platform.store.get_by_name(Cluster, "soak", scoped=False)
+    assert cluster.package == "k8s-v2"          # upgrade committed
+    assert cluster.status == ClusterStatus.WARNING   # degraded, heal-eligible
+
+    # -- the quarantined host comes back (healed/replaced): the next
+    #    operation converges it again and the cluster leaves WARNING -------
+    chaos.revive(victim.ip)
+    ex = platform.run_operation("soak", "scale", {"worker_size": 4})
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert "quarantined" not in ex.result
+    cluster = platform.store.get_by_name(Cluster, "soak", scoped=False)
+    assert cluster.status == ClusterStatus.RUNNING
+    total_injected = chaos.injected
+    assert total_injected < chaos.calls, "chaos must not dominate traffic"

@@ -1,0 +1,468 @@
+"""Fault-tolerant execution engine (ISSUE 1): transient-error
+classification, retry/backoff at the transport and step layers, per-step
+deadlines, host quarantine, and the deterministic chaos smoke test.
+
+Everything here runs on FakeExecutor/ChaosExecutor only — no real SSH —
+and with zeroed backoff so the tier-1 run stays fast. The long randomized
+soak lives in test_chaos_soak.py (marked slow)."""
+
+import hashlib
+import time
+
+import pytest
+
+from kubeoperator_tpu.config.loader import load_config
+from kubeoperator_tpu.engine.executor import (
+    ChaosExecutor, Conn, ExecError, ExecResult, FakeExecutor, SSHExecutor,
+    TransientError,
+)
+from kubeoperator_tpu.engine.ops import HostOps, is_critical, split_failures
+from kubeoperator_tpu.engine.tasks import TaskEngine
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, ExecutionState, StepState,
+)
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.services.platform import Platform
+
+from tests.conftest import CPU_FACTS
+
+FAST_FT = {
+    # zero/near-zero backoff so retries don't slow the suite down
+    "step_backoff_s": 0.001,
+    "step_backoff_max_s": 0.002,
+    "exec_backoff_s": 0.0,
+}
+
+
+# ---------------------------------------------------------------------------
+# classification (tentpole part 1 + rc-normalization satellite)
+# ---------------------------------------------------------------------------
+
+def test_transient_classification_normalizes_timeouts_and_resets():
+    # rc 124: LocalExecutor/SSHExecutor subprocess timeouts
+    assert ExecResult(124, "", "timeout after 300s").transient
+    # rc 255: OpenSSH connect failures and FakeExecutor's down-host marker
+    assert ExecResult(255, "", "ssh: connect to host timed out").transient
+    # stderr markers classify even without the conventional rc
+    assert ExecResult(1, "", "read: Connection reset by peer").transient
+    assert ExecResult(1, "", "curl: (7) Connection refused").transient
+    # permanent step errors stay permanent
+    assert not ExecResult(1, "", "No such file or directory").transient
+    assert not ExecResult(0, "ok").transient
+
+
+def test_check_raises_transient_vs_permanent():
+    with pytest.raises(TransientError):
+        ExecResult(255, "", "connection refused").check("ssh")
+    with pytest.raises(ExecError) as ei:
+        ExecResult(1, "", "boom").check("cmd")
+    assert not isinstance(ei.value, TransientError)
+    # TransientError is an ExecError: existing handlers still catch it
+    assert issubclass(TransientError, ExecError)
+
+
+def test_ping_down_host_is_transient(fake_executor):
+    fake_executor.set_down("10.9.9.9")
+    r = fake_executor.run(Conn(ip="10.9.9.9"), "true")
+    assert r.rc == 255 and r.transient
+    assert fake_executor.ping(Conn(ip="10.9.9.9")) is False
+
+
+# ---------------------------------------------------------------------------
+# SSHExecutor keyfile (satellite: sha256 keying, not str(hash(...)))
+# ---------------------------------------------------------------------------
+
+def test_keyfiles_keyed_by_sha256():
+    x = SSHExecutor()
+    try:
+        a = x._key_path(Conn(ip="1.1.1.1", private_key="KEY-A"))
+        b = x._key_path(Conn(ip="1.1.1.1", private_key="KEY-B"))
+        a2 = x._key_path(Conn(ip="2.2.2.2", private_key="KEY-A"))
+        assert a != b                 # distinct credentials, distinct files
+        assert a == a2                # same key shares one file
+        assert hashlib.sha256(b"KEY-A").hexdigest() in x._keyfiles
+        assert hashlib.sha256(b"KEY-B").hexdigest() in x._keyfiles
+        with open(a) as f:
+            assert f.read() == "KEY-A"
+        assert x._key_path(Conn(ip="3.3.3.3")) is None
+    finally:
+        x.cleanup_keys()
+
+
+# ---------------------------------------------------------------------------
+# TaskEngine.wait (satellite)
+# ---------------------------------------------------------------------------
+
+def test_wait_unknown_task_raises_descriptive_keyerror(tmp_path):
+    eng = TaskEngine(workers=1, log_dir=str(tmp_path))
+    try:
+        with pytest.raises(KeyError, match="unknown task id 'nope'"):
+            eng.wait("nope")
+    finally:
+        eng.shutdown()
+
+
+def test_wait_returns_failed_record_without_reraising(tmp_path):
+    eng = TaskEngine(workers=1, log_dir=str(tmp_path))
+    try:
+        def boom():
+            raise ValueError("exploded")
+        eng.submit("t1", "boom", boom)
+        rec = eng.wait("t1")        # must not raise
+        assert rec.state == "FAILURE"
+        assert "ValueError: exploded" in rec.error
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HostOps transport-level retry
+# ---------------------------------------------------------------------------
+
+def test_hostops_retries_transient_command(fake_executor):
+    chaos = ChaosExecutor(fake_executor, seed=1)
+    chaos.fail_next(1, pattern="mkdir")
+    ops = HostOps(chaos, Conn(ip="10.0.0.1"), retries=2, backoff_s=0)
+    r = ops.sh("mkdir -p /opt/kube")        # first try flakes, retry lands
+    assert r.ok and chaos.injected == 1
+
+
+def test_hostops_does_not_retry_permanent_failure(fake_executor):
+    fake_executor.fail_on("10.0.0.1", "false-cmd")
+    ops = HostOps(fake_executor, Conn(ip="10.0.0.1"), retries=3, backoff_s=0)
+    with pytest.raises(ExecError):
+        ops.sh("false-cmd")
+    # exactly one attempt: the rc-1 failure is not transport-shaped
+    assert fake_executor.host("10.0.0.1").history.count("false-cmd") == 1
+
+
+def test_hostops_retries_exhaust_and_raise_transient(fake_executor):
+    fake_executor.set_down("10.0.0.1")
+    ops = HostOps(fake_executor, Conn(ip="10.0.0.1"), retries=2, backoff_s=0)
+    with pytest.raises(TransientError):
+        ops.sh("true")
+    assert fake_executor.host("10.0.0.1").history.count("true") == 3
+
+
+# ---------------------------------------------------------------------------
+# quarantine partitioning helper
+# ---------------------------------------------------------------------------
+
+class _T:
+    def __init__(self, name, roles):
+        self.name, self.roles = name, roles
+
+
+def test_split_failures_criticality():
+    assert is_critical(["master", "etcd"]) and is_critical(["etcd"])
+    assert not is_critical(["worker", "tpu-worker"])
+    targets = [_T("m1", ["etcd", "master"]), _T("w1", ["worker"]),
+               _T("w2", ["worker"])]
+    # non-critical transient failure with partial success -> quarantinable
+    fatal, q = split_failures(targets, {"w1": ("down", True)})
+    assert fatal == {} and q == {"w1": "down"}
+    # critical host -> fatal even when transient
+    fatal, q = split_failures(targets, {"m1": ("down", True)})
+    assert fatal == {"m1": "down"} and q == {}
+    # permanent failure -> fatal even on a worker
+    fatal, q = split_failures(targets, {"w1": ("rc=1", False)})
+    assert fatal == {"w1": "rc=1"} and q == {}
+    # every target failed -> nothing quarantines (operation problem)
+    all_down = {t.name: ("down", True) for t in targets}
+    fatal, q = split_failures(targets, all_down)
+    assert q == {} and set(fatal) == {"m1", "w1", "w2"}
+
+
+# ---------------------------------------------------------------------------
+# chaos executor determinism (CI/tooling satellite)
+# ---------------------------------------------------------------------------
+
+def test_chaos_flakes_are_deterministic_per_seed():
+    def injected_sequence(seed):
+        chaos = ChaosExecutor(FakeExecutor(), seed=seed)
+        chaos.flake(r"probe", 0.5)
+        return [chaos.run(Conn(ip="10.0.0.1"), f"probe {i}").rc
+                for i in range(32)]
+
+    seq = injected_sequence(42)
+    assert seq == injected_sequence(42)       # reproducible
+    assert seq != injected_sequence(43)       # and actually seed-driven
+    assert 124 in seq and 0 in seq            # flaked AND passed some
+
+
+def test_chaos_default_seed_from_env(monkeypatch):
+    monkeypatch.setenv("KO_CHAOS_SEED", "777")
+    assert ChaosExecutor(FakeExecutor()).seed == 777
+    monkeypatch.delenv("KO_CHAOS_SEED")
+    assert ChaosExecutor(FakeExecutor()).seed == 1337
+
+
+def test_chaos_kill_after_and_revive():
+    chaos = ChaosExecutor(FakeExecutor(), seed=0)
+    conn = Conn(ip="10.0.0.5")
+    chaos.kill_after("10.0.0.5", 2)
+    assert chaos.run(conn, "true").ok
+    assert chaos.run(conn, "true").ok
+    dead = chaos.run(conn, "true")
+    assert dead.rc == 255 and dead.transient
+    assert chaos.run(conn, "true").rc == 255  # stays dead
+    chaos.revive("10.0.0.5")
+    assert chaos.run(conn, "true").ok
+
+
+# ---------------------------------------------------------------------------
+# platform fixtures: a chaos-wrapped fake behind a real Platform
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def chaos_executor():
+    fake = FakeExecutor()
+    return ChaosExecutor(fake, seed=1234)
+
+
+@pytest.fixture
+def chaos_platform(tmp_path, chaos_executor):
+    cfg = load_config(overrides={
+        "data_dir": str(tmp_path / "data"),
+        "executor": "fake",
+        "terraform_bin": "",
+        "task_workers": 2,
+        "node_forks": 8,
+        "repo_host": "127.0.0.1",
+        **FAST_FT,
+    })
+    p = Platform(config=cfg, store=Store(), executor=chaos_executor)
+    yield p
+    p.shutdown()
+
+
+def _manual_cluster(platform, executor, name="ft"):
+    """1 master + 2 workers over whatever executor the platform wires."""
+    fake = executor.inner if isinstance(executor, ChaosExecutor) else executor
+    cred = platform.create_credential(f"{name}-key", private_key="FAKE KEY")
+    nodes = {}
+    for i, ip in enumerate(("10.3.0.1", "10.3.0.2", "10.3.0.3")):
+        fake.host(ip).facts.update(CPU_FACTS)
+        role = "master" if i == 0 else "worker"
+        h = platform.register_host(f"{name}-{role}-{i}", ip, cred.id)
+        nodes[ip] = (h, [role])
+    cluster = platform.create_cluster(name, template="SINGLE",
+                                      configs={"registry": "reg.local:8082"})
+    for h, roles in nodes.values():
+        platform.add_node(cluster, h, roles)
+    return cluster
+
+
+# ---------------------------------------------------------------------------
+# step-level retry with backoff, recorded in the execution
+# ---------------------------------------------------------------------------
+
+def test_step_retry_records_count_and_backoff(chaos_platform, chaos_executor):
+    _manual_cluster(chaos_platform, chaos_executor)
+    # exec_retry=0 forces the flake to escalate to the step driver
+    chaos_platform.config["exec_retry"] = 0
+    chaos_executor.fail_next(1, pattern="mkdir")    # prepare, attempt 1 only
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    steps = {s["name"]: s for s in ex.steps}
+    assert steps["prepare"]["retries"] == 1
+    assert steps["prepare"]["backoff_s"] > 0
+    assert steps["prepare"]["status"] == StepState.SUCCESS
+    # untouched steps record zero retries (key always present)
+    assert all("retries" in s for s in ex.steps)
+    assert steps["etcd"]["retries"] == 0
+
+
+def test_step_retry_budget_exhausts_to_failure(chaos_platform, chaos_executor):
+    _manual_cluster(chaos_platform, chaos_executor)
+    chaos_platform.config["exec_retry"] = 0
+    chaos_platform.config["step_retry"] = 1
+    # every master etcd command flakes forever -> critical, not quarantinable
+    chaos_executor.flake(r"etcd", 1.0)
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.FAILURE
+    steps = {s["name"]: s for s in ex.steps}
+    failed = [s for s in ex.steps if s["status"] == StepState.ERROR]
+    assert len(failed) == 1
+    # catalog prepare override (retry: 2) or config default both bounded
+    assert failed[0]["retries"] <= 2
+    assert steps["control-plane"]["status"] == StepState.PENDING
+
+
+# ---------------------------------------------------------------------------
+# per-step deadline
+# ---------------------------------------------------------------------------
+
+def test_step_deadline_fails_fast_and_retries(platform, manual_cluster, monkeypatch):
+    import copy
+
+    from kubeoperator_tpu.engine import operations
+
+    platform.config.update(FAST_FT)
+    catalog = copy.deepcopy(platform.catalog)
+    old = catalog.steps["etcd-backup"]
+    catalog.steps["etcd-backup"] = type(old)(
+        name=old.name, module=old.module, targets=old.targets,
+        retry=1, timeout_s=0.2)
+    platform.catalog = catalog
+
+    real_load = operations.load_step
+    def hanging_load(step_def):
+        if step_def.name == "etcd-backup":
+            return lambda ctx: time.sleep(60)
+        return real_load(step_def)
+    monkeypatch.setattr(operations, "load_step", hanging_load)
+
+    t0 = time.monotonic()
+    ex = platform.run_operation("demo", "backup")
+    elapsed = time.monotonic() - t0
+    assert ex.state == ExecutionState.FAILURE
+    assert "deadline" in ex.result["error"]
+    steps = {s["name"]: s for s in ex.steps}
+    # deadline overruns are transient: the retry budget was spent first
+    assert steps["etcd-backup"]["retries"] == 1
+    assert elapsed < 10, "deadline must fail fast, not wait out the hang"
+
+
+# ---------------------------------------------------------------------------
+# host quarantine / graceful degradation
+# ---------------------------------------------------------------------------
+
+def test_down_worker_is_quarantined_not_fatal(chaos_platform, chaos_executor):
+    """Acceptance: a permanently-down non-critical worker yields a
+    succeeded-with-quarantine operation whose result names the host."""
+    _manual_cluster(chaos_platform, chaos_executor)
+    chaos_executor.inner.set_down("10.3.0.2")       # worker ft-worker-1
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert list(ex.result["quarantined"]) == ["ft-worker-1"]
+    assert "prepare" in ex.result["quarantined"]["ft-worker-1"]
+    steps = {s["name"]: s for s in ex.steps}
+    assert "quarantined" in steps["prepare"]["message"]
+    # the cluster surfaces the degradation for the healing beat
+    cluster = chaos_platform.store.get_by_name(Cluster, "ft", scoped=False)
+    assert cluster.status == ClusterStatus.WARNING
+    # the healthy worker still converged fully
+    assert chaos_executor.inner.host("10.3.0.3").services["kubelet"] == "started"
+    # and the quarantined host stopped being targeted after prepare
+    assert not chaos_executor.inner.ran("10.3.0.2", "kubelet")
+
+
+def test_down_master_stays_fatal(chaos_platform, chaos_executor):
+    _manual_cluster(chaos_platform, chaos_executor)
+    chaos_executor.inner.set_down("10.3.0.1")       # the master
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.FAILURE
+    assert "quarantined" not in ex.result
+    cluster = chaos_platform.store.get_by_name(Cluster, "ft", scoped=False)
+    assert cluster.status == ClusterStatus.ERROR
+
+
+def test_quarantine_disabled_by_config(chaos_platform, chaos_executor):
+    _manual_cluster(chaos_platform, chaos_executor)
+    chaos_platform.config["quarantine"] = False
+    chaos_executor.inner.set_down("10.3.0.2")
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.FAILURE
+    assert "quarantined" not in ex.result
+
+
+# ---------------------------------------------------------------------------
+# operation-level resume_from (satellite: test coverage)
+# ---------------------------------------------------------------------------
+
+def _fail_post_check(fake_executor, ip="10.0.0.1"):
+    # rc-1 (permanent) failure on the conformance probe: no retry, no
+    # quarantine (first-master is critical) -> clean deterministic failure
+    fake_executor.fail_on(ip, "get nodes")
+
+
+def test_resume_skips_converged_steps(platform, fake_executor, manual_cluster):
+    platform.config.update(FAST_FT)
+    _fail_post_check(fake_executor)
+    failed = platform.run_operation("demo", "install")
+    assert failed.state == ExecutionState.FAILURE
+    assert {s["name"]: s["status"] for s in failed.steps}["post-check"] == StepState.ERROR
+
+    fake_executor.host("10.0.0.1").fail_patterns.clear()
+    retry = platform.retry_execution(failed.id)
+    platform.tasks.wait(retry.id)
+    retry = platform.store.get(type(failed), retry.id, scoped=False)
+    assert retry.state == ExecutionState.SUCCESS, retry.result
+    statuses = {s["name"]: s["status"] for s in retry.steps}
+    assert statuses["post-check"] == StepState.SUCCESS
+    # everything before the failed step was skipped, not re-run
+    before = [s["name"] for s in retry.steps[:-1]]
+    assert all(statuses[n] == StepState.SKIPPED for n in before)
+    # SKIPPED steps count toward progress: a finished resume reads 100%
+    assert retry.progress == 1.0
+
+
+def test_resume_unknown_step_runs_all(platform, fake_executor, manual_cluster):
+    platform.config.update(FAST_FT)
+    ex = platform.create_execution("demo", "install",
+                                   {"resume_from": "no-such-step"})
+    platform.start_execution(ex, wait=True)
+    ex = platform.store.get(type(ex), ex.id, scoped=False)
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    statuses = [s["status"] for s in ex.steps]
+    assert StepState.SKIPPED not in statuses
+    assert all(s == StepState.SUCCESS for s in statuses)
+
+
+def test_resume_mid_way_progress_counts_skipped(platform, fake_executor,
+                                                manual_cluster):
+    """A resume that fails again later still counts its SKIPPED prefix
+    toward progress — the bar must not start from zero."""
+    platform.config.update(FAST_FT)
+    _fail_post_check(fake_executor)
+    failed = platform.run_operation("demo", "install")
+    assert failed.state == ExecutionState.FAILURE
+
+    retry = platform.retry_execution(failed.id)     # post-check still fails
+    platform.tasks.wait(retry.id)
+    retry = platform.store.get(type(failed), retry.id, scoped=False)
+    assert retry.state == ExecutionState.FAILURE
+    skipped = sum(1 for s in retry.steps if s["status"] == StepState.SKIPPED)
+    assert skipped == len(retry.steps) - 1
+    # all steps are terminal (skipped prefix + the one error) -> progress 1.0
+    assert retry.progress == 1.0
+
+
+# ---------------------------------------------------------------------------
+# deterministic chaos smoke (tier-1 acceptance: AUTOMATIC install converges
+# under injected transient faults with retry counts recorded)
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_automatic_install_converges(chaos_platform, chaos_executor):
+    from kubeoperator_tpu.resources.entities import Plan, Region, Zone
+
+    region = Region(name="us-central2", provider="gce",
+                    vars={"project": "t", "gce_region": "us-central2"})
+    chaos_platform.store.save(region)
+    zone = Zone(name="us-central2-b", region_id=region.id,
+                vars={"gce_zone": "us-central2-b"},
+                ip_pool=[f"10.4.0.{i}" for i in range(10, 30)])
+    chaos_platform.store.save(zone)
+    plan = Plan(name="tpu-plan", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=1,
+                tpu_pools=[{"slice_type": "v5e-8", "count": 1,
+                            "zone": zone.name}])
+    chaos_platform.store.save(plan)
+    chaos_platform.create_cluster("auto", template="SINGLE",
+                                  deploy_type="AUTOMATIC", plan_id=plan.id,
+                                  configs={"registry": "reg.local:8082"})
+
+    # flake rate >= 0.2 on prepare/worker-shaped commands; transport retries
+    # absorb most, the step driver the rest
+    chaos_platform.config["exec_retry"] = 4
+    chaos_platform.config["step_retry"] = 3
+    chaos_executor.flake(r"swapoff|sysctl|mkdir|systemctl (enable|restart)", 0.25)
+
+    ex = chaos_platform.run_operation("auto", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert chaos_executor.injected > 0, "chaos never fired"
+    assert all("retries" in s for s in ex.steps)
+    assert "quarantined" not in ex.result   # flakes retried, nobody dropped
+    # bounded retries: nothing exceeded its per-step budget
+    assert all(s["retries"] <= 3 for s in ex.steps)
