@@ -15,6 +15,7 @@ import csv
 import io
 import json
 import os
+import time
 from dataclasses import asdict
 from functools import partial
 from typing import Any, Callable
@@ -31,12 +32,20 @@ from kubeoperator_tpu.resources.entities import Setting
 from kubeoperator_tpu.services.platform import (
     Platform, PlatformError, WebkubectlSessionError,
 )
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.telemetry.tracing import TraceRecord
 from kubeoperator_tpu.utils.logs import get_logger
+from kubeoperator_tpu.version import __version__
 
 log = get_logger(__name__)
 
 HIDDEN_FIELDS = {"password", "password_hash", "salt", "private_key"}
-PUBLIC_ROUTES = {("POST", "/api/v1/auth/login"), ("GET", "/healthz")}
+PUBLIC_ROUTES = {("POST", "/api/v1/auth/login"), ("GET", "/healthz"),
+                 ("GET", "/api/v1/healthz")}
+
+# process birth for the liveness report's uptime (monotonic: wall-clock
+# steps must not make uptime jump)
+_PROCESS_START = time.monotonic()
 
 
 def dump(entity: Any) -> dict:
@@ -206,7 +215,47 @@ async def mark_message_read(request: web.Request) -> web.Response:
 
 
 async def healthz(request: web.Request) -> web.Response:
-    return web.json_response({"status": "ok"})
+    """Liveness plus the two numbers a probe actually wants before routing
+    work here: how long the process has been up and how backed-up the task
+    engine is. Unauthenticated at both /healthz and /api/v1/healthz."""
+    platform: Platform = request.app["platform"]
+    summary = await _sync(request, platform.tasks.summary)
+    return web.json_response({
+        "status": "ok",
+        "version": __version__,
+        "uptime_s": round(time.monotonic() - _PROCESS_START, 1),
+        "queue_depth": summary["queue_depth"],
+    })
+
+
+async def metrics_exposition(request: web.Request) -> web.Response:
+    """Prometheus text exposition (0.0.4) of the control plane's own
+    registry — scraping the controller works exactly like scraping the
+    clusters it manages."""
+    platform: Platform = request.app["platform"]
+    summary = await _sync(request, platform.tasks.summary)
+    # gauges sampled at scrape time (counters/histograms update inline)
+    tm.TASK_QUEUE_DEPTH.set(summary["queue_depth"])
+    return web.Response(
+        body=tm.REGISTRY.render().encode(),
+        headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
+
+async def get_execution_trace(request: web.Request) -> web.Response:
+    """Persisted span tree for one execution (``ko trace`` consumes this)."""
+    platform: Platform = request.app["platform"]
+    ex = await _sync(request, platform.store.get, DeployExecution,
+                     request.match_info["id"], scoped=False)
+    if ex is None:
+        return json_error(404, "execution not found")
+    if ex.project:
+        check_cluster_access(request, ex.project, write=False)
+    rec = await _sync(request, platform.store.get_by_name, TraceRecord,
+                      ex.id, scoped=False)
+    if rec is None:
+        return json_error(404, "no trace recorded for this execution")
+    return web.json_response({"execution": ex.id, "operation": ex.operation,
+                              "spans": rec.spans, "dropped": rec.dropped})
 
 
 # ---------------------------------------------------------------------------
@@ -1092,6 +1141,8 @@ def create_app(platform: Platform) -> web.Application:
     app["platform"] = platform
     r = app.router
     r.add_get("/healthz", healthz)
+    r.add_get("/api/v1/healthz", healthz)
+    r.add_get("/metrics", metrics_exposition)
     r.add_post("/api/v1/auth/login", login)
     r.add_get("/api/v1/profile", profile)
 
@@ -1113,6 +1164,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/clusters/{name}/backups", list_backups)
     r.add_get("/api/v1/clusters/{name}/errorlogs", cluster_error_logs)
     r.add_get("/api/v1/executions/{id}", get_execution)
+    r.add_get("/api/v1/executions/{id}/trace", get_execution_trace)
     r.add_post("/api/v1/executions/{id}/retry", retry_execution)
     r.add_get("/api/v1/tasks", tasks_monitor)
     r.add_get("/api/v1/tasks/{id}", get_task)

@@ -45,6 +45,9 @@ DEFAULTS: dict[str, Any] = {
     # executor "chaos" (fake transport + fault injection): "<rate>:<regex>"
     # flakes matching commands, e.g. KO_CHAOS_FLAKE="0.3:mkdir|sysctl"
     "chaos_flake": "",
+    # telemetry (ISSUE 3): per-execution span cap — a runaway operation
+    # must not bloat the store; overflow increments TraceRecord.dropped
+    "trace_max_spans": 4000,
     "ssh_connect_timeout": 10,
     # api
     "bind_host": "127.0.0.1",

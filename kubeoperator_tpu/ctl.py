@@ -10,6 +10,7 @@ hosts, packages, and executions. Zero dependencies — stdlib urllib.
     ko cluster demo
     ko op demo install            # streams step progress until done
     ko retry <execution-id>
+    ko trace <execution-id> --slowest 3
     ko hosts | ko packages | ko logs --query error
 """
 
@@ -240,6 +241,20 @@ def cmd_tasks(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Render an execution's persisted span tree: an indented timeline by
+    default, or the N slowest spans with their ancestry (--slowest N) —
+    the critical-path answer to "where did my provision time go"."""
+    d = Client().call("GET", f"/api/v1/executions/{args.id}/trace")
+    # rendering lives next to the tracer so the API and CLI can't drift
+    from kubeoperator_tpu.telemetry.tracing import format_trace
+    print(f"execution {d['execution']} ({d['operation']}) — "
+          f"{len(d['spans'])} spans"
+          + (f", {d['dropped']} dropped" if d.get("dropped") else ""))
+    print(format_trace(d["spans"], slowest=args.slowest))
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     d = Client().call("GET", "/api/v1/dashboard/all")
     print(f"clusters: {d['cluster_count']} (running {d['running']}, "
@@ -277,6 +292,12 @@ def build_parser(sub) -> None:
     retry.add_argument("--no-wait", action="store_true")
     retry.set_defaults(fn=cmd_retry)
 
+    trace = sub.add_parser("trace", help="span-tree timeline of an execution")
+    trace.add_argument("id", help="execution id")
+    trace.add_argument("--slowest", type=int, default=0, metavar="N",
+                       help="show only the N slowest spans (critical path)")
+    trace.set_defaults(fn=cmd_trace)
+
     apps = sub.add_parser("apps", help="runtime app store on a cluster")
     apps.add_argument("action", choices=("list", "install", "uninstall"))
     apps.add_argument("cluster")
@@ -311,6 +332,11 @@ def main(argv: list[str] | None = None) -> int:
     except ApiError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # `ko trace … | head` closes stdout early; exit quietly like
+        # other unix tools instead of tracebacking
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

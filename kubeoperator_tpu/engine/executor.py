@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from kubeoperator_tpu.resources.entities import Credential, Host
+from kubeoperator_tpu.telemetry import metrics, tracing
 from kubeoperator_tpu.utils.secrets import default_box
 
 
@@ -525,18 +526,27 @@ class ChaosExecutor(Executor):
             self._kill.pop(ip, None)
 
     # -- fault evaluation --------------------------------------------------
+    def _record(self, kind: str, ip: str) -> None:
+        """Every injection is auditable: a span event on the active exec
+        span (when an operation is tracing) plus a chaos counter sample —
+        the soak's output stops being a black box. Caller holds _lock;
+        telemetry uses its own locks, so no ordering hazard."""
+        self.injected += 1
+        metrics.CHAOS_INJECTIONS.inc(kind=kind)
+        tracing.add_event("chaos", kind=kind, ip=ip)
+
     def _chaos(self, ip: str, command: str) -> ExecResult | None:
         with self._lock:
             self.calls += 1
             if ip in self._dead:
-                self.injected += 1
+                self._record("host_dead", ip)
                 return ExecResult(255, "", "chaos: host is dead")
             if ip in self._kill:
                 self._kill[ip] -= 1
                 if self._kill[ip] < 0:
                     del self._kill[ip]
                     self._dead.add(ip)
-                    self.injected += 1
+                    self._record("host_death", ip)
                     return ExecResult(255, "", "chaos: host died mid-operation")
             for idx, (pat, left) in enumerate(self._fail_next):
                 if pat is None or pat.search(command):
@@ -544,11 +554,11 @@ class ChaosExecutor(Executor):
                         del self._fail_next[idx]
                     else:
                         self._fail_next[idx] = (pat, left - 1)
-                    self.injected += 1
+                    self._record("reset", ip)
                     return ExecResult(255, "", "chaos: injected connection reset")
             for pat, rate in self._flakes:
                 if pat.search(command) and self.rng.random() < rate:
-                    self.injected += 1
+                    self._record("timeout", ip)
                     return ExecResult(124, "", "chaos: injected timeout")
         return None
 

@@ -28,6 +28,7 @@ from kubeoperator_tpu.resources.entities import (
     Cluster, ClusterStatus, DeployExecution, ExecutionState, ExecutionStep,
     Message, StepState,
 )
+from kubeoperator_tpu.telemetry import metrics, tracing
 from kubeoperator_tpu.utils.logs import get_logger
 from kubeoperator_tpu.utils.timeutil import iso
 
@@ -118,7 +119,24 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
         execution.result = {"error": f"cluster {execution.project} not found"}
         store.save(execution)
         return execution
+    # root span: the whole operation, persisted as a TraceRecord on exit
+    # (`ko trace <execution>` / GET .../trace render it)
+    with tracing.trace(store, execution,
+                       max_spans=int(platform.config.get(
+                           "trace_max_spans", tracing.DEFAULT_MAX_SPANS))) as root:
+        try:
+            return _run_steps(platform, execution, cluster)
+        finally:
+            root.attributes["state"] = execution.state
+            if execution.state == ExecutionState.FAILURE:
+                root.status = "error"
+            metrics.OPERATIONS.inc(operation=execution.operation,
+                                   state=execution.state)
 
+
+def _run_steps(platform, execution: DeployExecution,
+               cluster: Cluster) -> DeployExecution:
+    store = platform.store
     steps = platform.catalog.operation_steps(execution.operation)
     execution.steps = [asdict(ExecutionStep(name=s.name)) for s in steps]
     execution.state = ExecutionState.STARTED
@@ -162,76 +180,100 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
                    else int(platform.config.get("step_retry", 1)))
         attempt = 0
         quarantine_rounds = 0
-        while True:
-            try:
-                cluster = store.get_by_name(Cluster, execution.project) or cluster
-                ctx = StepContext(
-                    cluster=cluster,
-                    store=store,
-                    inventory=build_inventory(store, cluster, platform.catalog),
-                    executor=platform.executor,
-                    catalog=platform.catalog,
-                    config=platform.config,
-                    vars={k: v for k, v in {
-                          **cluster.configs,
-                          **execution.params.get("upgrade_vars", {}),
-                          **execution.params.get("vars", {})}.items()
-                          if v != UPGRADE_DROP},
-                    step=step_def,
-                    provider=platform.provider_for(cluster),
-                    params=execution.params,
-                    operation=execution.operation,
-                    quarantined=quarantined,
-                )
-                result = _call_step(load_step(step_def), ctx, step_def)
-                execution.steps[i]["status"] = StepState.SUCCESS
-                if quarantine_rounds:
-                    execution.steps[i]["message"] = (
-                        "succeeded with quarantined hosts: "
-                        + ", ".join(sorted(quarantined)))
-                elif execution.steps[i].get("retries"):
-                    # drop the stale retry complaint; the count survives in
-                    # the ``retries`` field
-                    execution.steps[i]["message"] = ""
-                if isinstance(result, dict):
-                    execution.result[step_def.name] = result
-            except Exception as e:  # noqa: BLE001 — step boundary
-                if getattr(e, "transient", False) and attempt < retries:
-                    attempt += 1
-                    delay = _backoff(platform.config, attempt)
-                    execution.steps[i]["retries"] = attempt
-                    execution.steps[i]["backoff_s"] = round(
-                        execution.steps[i]["backoff_s"] + delay, 3)
-                    execution.steps[i]["message"] = (
-                        f"retry {attempt}/{retries} after transient failure: {e}")
-                    store.save(execution)   # progress stream sees the retry
-                    log.warning("[%s] step %s attempt %d/%d failed "
-                                "transiently (%s); backing off %.1fs",
-                                execution.project, step_def.name, attempt,
-                                retries + 1, e, delay)
-                    time.sleep(delay)
-                    continue
-                # graceful degradation: retries exhausted, but every failure
-                # sits on a non-critical, transiently-failing host while the
-                # step succeeded elsewhere — quarantine those hosts and
-                # re-run the step without them instead of failing the
-                # operation; the healing beat replaces them later
-                quarantinable = getattr(e, "quarantinable", None)
-                if (quarantinable and platform.config.get("quarantine", True)
-                        and quarantine_rounds < MAX_QUARANTINE_ROUNDS):
-                    quarantine_rounds += 1
-                    for name, why in quarantinable.items():
-                        quarantined[name] = f"{step_def.name}: {why}"
-                    log.warning("[%s] step %s: quarantining %s (%s)",
-                                execution.project, step_def.name,
-                                ", ".join(sorted(quarantinable)), e)
-                    continue
-                error = f"{step_def.name}: {e}"
-                execution.steps[i]["status"] = StepState.ERROR
-                execution.steps[i]["message"] = str(e)
-                log.error("[%s] step %s failed: %s", execution.project,
-                          step_def.name, e)
-            break
+        step_t0 = time.perf_counter()
+        # child span per step; the retry loop (including its backoff
+        # sleeps) is the step's wall-clock story, so the span wraps it all
+        with tracing.span(f"step:{step_def.name}", kind="step",
+                          index=i) as sp:
+            while True:
+                try:
+                    cluster = store.get_by_name(Cluster, execution.project) or cluster
+                    ctx = StepContext(
+                        cluster=cluster,
+                        store=store,
+                        inventory=build_inventory(store, cluster, platform.catalog),
+                        executor=platform.executor,
+                        catalog=platform.catalog,
+                        config=platform.config,
+                        vars={k: v for k, v in {
+                              **cluster.configs,
+                              **execution.params.get("upgrade_vars", {}),
+                              **execution.params.get("vars", {})}.items()
+                              if v != UPGRADE_DROP},
+                        step=step_def,
+                        provider=platform.provider_for(cluster),
+                        params=execution.params,
+                        operation=execution.operation,
+                        quarantined=quarantined,
+                    )
+                    result = _call_step(load_step(step_def), ctx, step_def)
+                    execution.steps[i]["status"] = StepState.SUCCESS
+                    if quarantine_rounds:
+                        execution.steps[i]["message"] = (
+                            "succeeded with quarantined hosts: "
+                            + ", ".join(sorted(quarantined)))
+                    elif execution.steps[i].get("retries"):
+                        # drop the stale retry complaint; the count survives in
+                        # the ``retries`` field
+                        execution.steps[i]["message"] = ""
+                    if isinstance(result, dict):
+                        execution.result[step_def.name] = result
+                except Exception as e:  # noqa: BLE001 — step boundary
+                    if getattr(e, "transient", False) and attempt < retries:
+                        attempt += 1
+                        delay = _backoff(platform.config, attempt)
+                        execution.steps[i]["retries"] = attempt
+                        execution.steps[i]["backoff_s"] = round(
+                            execution.steps[i]["backoff_s"] + delay, 3)
+                        execution.steps[i]["message"] = (
+                            f"retry {attempt}/{retries} after transient failure: {e}")
+                        store.save(execution)   # progress stream sees the retry
+                        metrics.STEP_RETRIES.inc(operation=execution.operation,
+                                                 step=step_def.name)
+                        tracing.add_event("retry", attempt=attempt,
+                                          backoff_s=round(delay, 3),
+                                          error=str(e)[:200])
+                        log.warning("[%s] step %s attempt %d/%d failed "
+                                    "transiently (%s); backing off %.1fs",
+                                    execution.project, step_def.name, attempt,
+                                    retries + 1, e, delay)
+                        time.sleep(delay)
+                        continue
+                    # graceful degradation: retries exhausted, but every failure
+                    # sits on a non-critical, transiently-failing host while the
+                    # step succeeded elsewhere — quarantine those hosts and
+                    # re-run the step without them instead of failing the
+                    # operation; the healing beat replaces them later
+                    quarantinable = getattr(e, "quarantinable", None)
+                    if (quarantinable and platform.config.get("quarantine", True)
+                            and quarantine_rounds < MAX_QUARANTINE_ROUNDS):
+                        quarantine_rounds += 1
+                        for name, why in quarantinable.items():
+                            quarantined[name] = f"{step_def.name}: {why}"
+                        metrics.QUARANTINED.inc(len(quarantinable),
+                                                operation=execution.operation,
+                                                step=step_def.name)
+                        tracing.add_event("quarantine",
+                                          hosts=sorted(quarantinable))
+                        log.warning("[%s] step %s: quarantining %s (%s)",
+                                    execution.project, step_def.name,
+                                    ", ".join(sorted(quarantinable)), e)
+                        continue
+                    error = f"{step_def.name}: {e}"
+                    execution.steps[i]["status"] = StepState.ERROR
+                    execution.steps[i]["message"] = str(e)
+                    log.error("[%s] step %s failed: %s", execution.project,
+                              step_def.name, e)
+                break
+            if sp is not None:
+                sp.attributes["retries"] = execution.steps[i].get("retries", 0)
+                sp.attributes["backoff_s"] = execution.steps[i].get("backoff_s", 0)
+                sp.attributes["result"] = execution.steps[i]["status"]
+                if execution.steps[i]["status"] == StepState.ERROR:
+                    sp.status = "error"
+        metrics.STEP_DURATION.observe(time.perf_counter() - step_t0,
+                                      operation=execution.operation,
+                                      step=step_def.name)
         execution.steps[i]["finished_at"] = iso()
         done = sum(1 for s in execution.steps
                    if s["status"] in (StepState.SUCCESS, StepState.ERROR,

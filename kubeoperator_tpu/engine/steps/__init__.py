@@ -28,6 +28,7 @@ from kubeoperator_tpu.engine.inventory import Inventory, TargetHost
 from kubeoperator_tpu.engine.ops import HostOps, split_failures
 from kubeoperator_tpu.resources.entities import Cluster
 from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.telemetry import tracing
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -117,10 +118,18 @@ class StepContext:
         results: dict[str, Any] = {}
         failures: dict[str, tuple[str, bool]] = {}   # name -> (msg, transient)
         workers = max(1, min(int(self.config.get("node_forks", 10)), len(targets)))
+
+        def traced(th: TargetHost):
+            # per-host child span under the step span each worker inherited
+            # via copy_context (alongside CURRENT_TASK log routing); exec
+            # grandchildren land under it through the TracingExecutor
+            with tracing.span(f"host:{th.name}", kind="host", ip=th.conn.ip):
+                return fn(th)
+
         with ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-fanout") as pool:
             # copy_context per host: worker threads inherit CURRENT_TASK so
             # their log records reach the owning task's log file
-            futs = {pool.submit(contextvars.copy_context().run, fn, th): th
+            futs = {pool.submit(contextvars.copy_context().run, traced, th): th
                     for th in targets}
             for fut, th in futs.items():
                 try:
@@ -146,7 +155,9 @@ class StepContext:
         failures: dict[str, tuple[str, bool]] = {}
         for th in targets:
             try:
-                results[th.name] = fn(th)
+                with tracing.span(f"host:{th.name}", kind="host",
+                                  ip=th.conn.ip, rolling=True):
+                    results[th.name] = fn(th)
             except TransientError as e:
                 failures[th.name] = (str(e), True)
             except (StepError, ExecError) as e:

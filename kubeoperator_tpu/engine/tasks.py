@@ -17,12 +17,14 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 import traceback
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from kubeoperator_tpu.telemetry import metrics
 from kubeoperator_tpu.utils.logs import CURRENT_TASK, TaskLogHandler, get_logger
 from kubeoperator_tpu.utils.timeutil import iso
 
@@ -74,6 +76,9 @@ class TaskEngine:
         with self._lock:
             return list(self.tasks.values())[::-1]
 
+    def _queue_depth_locked(self) -> int:
+        return sum(1 for r in self.tasks.values() if r.state == "PENDING")
+
     # -- one-shot tasks ----------------------------------------------------
     def submit(self, task_id: str, name: str, fn: Callable, *args: Any, **kwargs: Any) -> TaskRecord:
         with self._lock:
@@ -82,11 +87,14 @@ class TaskEngine:
                 return existing   # idempotent dispatch
             rec = TaskRecord(id=task_id, name=name)
             self.tasks[task_id] = rec
+            metrics.TASK_QUEUE_DEPTH.set(self._queue_depth_locked())
             rec.future = self.pool.submit(self._run, rec, fn, args, kwargs)
             return rec
 
     def _run(self, rec: TaskRecord, fn: Callable, args: tuple, kwargs: dict) -> Any:
         rec.state = "STARTED"
+        with self._lock:
+            metrics.TASK_QUEUE_DEPTH.set(self._queue_depth_locked())
         rec.started_at = iso()
         token = CURRENT_TASK.set(rec.id)
         handler = TaskLogHandler(self.task_log_path(rec.id), task_id=rec.id)
@@ -139,9 +147,15 @@ class TaskEngine:
     def every(self, interval_s: float, name: str, fn: Callable) -> None:
         """Beat-style recurring task (reference cadence: 5-min monitor/health
         loops)."""
+        # when the *next* tick is due; beat lag = how late it actually fires
+        # (a saturated worker pool or a long GC shows up here first)
+        expected = [time.monotonic() + interval_s]
+
         def tick():
             if self._closed:
                 return
+            metrics.BEAT_LAG.set(
+                max(0.0, round(time.monotonic() - expected[0], 6)), beat=name)
             try:
                 fn()
             except Exception:  # noqa: BLE001
@@ -157,6 +171,7 @@ class TaskEngine:
                 # prune fired timers so the list doesn't grow one entry per tick
                 self._periodic = [p for p in self._periodic if p.is_alive()]
                 self._periodic.append(t)
+            expected[0] = time.monotonic() + interval_s
             t.start()
 
         schedule()

@@ -16,10 +16,12 @@ import os
 import re
 from typing import Any
 
-# utils/logs.FORMAT: "%(asctime)s %(levelname)s %(name)s %(message)s"
+# utils/logs.FORMAT: "%(asctime)s %(levelname)s %(name)s%(task_tag)s
+# %(message)s" — the optional " [task <id>]" tag is consumed (the file
+# already names its task), keeping ``message`` clean for substring search
 LINE_RE = re.compile(
-    r"^(?P<ts>\d{4}-\d{2}-\d{2} [\d:,]+) (?P<level>[A-Z]+) (?P<logger>\S+) "
-    r"(?P<message>.*)$")
+    r"^(?P<ts>\d{4}-\d{2}-\d{2} [\d:,]+) (?P<level>[A-Z]+) (?P<logger>\S+)"
+    r"(?: \[task [^\]]*\])? (?P<message>.*)$")
 
 LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
 

@@ -30,6 +30,7 @@ from kubeoperator_tpu.resources.entities import (
     Region, User, Zone,
 )
 from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.telemetry.instrument import TracingExecutor
 from kubeoperator_tpu.utils.logs import get_logger
 from kubeoperator_tpu.utils.secrets import default_box
 
@@ -66,6 +67,10 @@ class Platform:
                 self.executor.flake(pattern, float(rate))
         else:
             self.executor = SSHExecutor(connect_timeout=self.config.ssh_connect_timeout)
+        # every transport goes through the telemetry shim: exec spans under
+        # the active host span + ko_exec_* metrics; transport-specific API
+        # (FakeExecutor.host/fail_on, chaos fault programming) delegates
+        self.executor = TracingExecutor(self.executor)
         self._ensure_auth_secret()
         self.tasks = TaskEngine(workers=self.config.task_workers,
                                 log_dir=self.config.task_logs)

@@ -1,0 +1,15 @@
+"""First-party telemetry: span tracing + in-process metrics + exposition.
+
+Re-exports the engine-independent halves only. ``instrument`` (the
+executor wrapper) imports ``engine.executor`` and must be imported
+directly — pulling it in here would create an import cycle, because
+``engine.executor`` itself records chaos injections through this package.
+"""
+
+from kubeoperator_tpu.telemetry.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, Metric, REGISTRY, Registry,
+)
+from kubeoperator_tpu.telemetry.tracing import (  # noqa: F401
+    CURRENT_SPAN, Span, Trace, TraceRecord, add_event, build_tree,
+    format_trace, span, trace,
+)

@@ -1,0 +1,109 @@
+"""TracingExecutor — the transport instrumentation shim.
+
+Platform wraps whichever executor it selected (SSH/Local/Fake/Chaos) in
+this delegating proxy once, at construction; every ``run``/``put_file``/
+``get_file`` then lands an ``exec`` grandchild span under the active host
+span plus an ``ko_exec_latency_seconds`` observation and an
+``ko_exec_commands_total`` count by outcome. Transport-specific surface
+(FakeExecutor's ``host``/``fail_on``/``ran``, ChaosExecutor's fault
+programming, SSH key cleanup) keeps working through ``__getattr__``.
+
+Kept separate from ``telemetry/__init__`` on purpose: this module imports
+``engine.executor`` while ``engine.executor`` imports the (engine-free)
+``telemetry.metrics``/``tracing`` pair — importing this from the package
+root would close that cycle.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeoperator_tpu.engine.executor import Conn, ExecResult, Executor
+from kubeoperator_tpu.telemetry import metrics, tracing
+
+
+def _outcome(res: ExecResult) -> str:
+    if res.ok:
+        return "ok"
+    return "transient" if res.transient else "error"
+
+
+class TracingExecutor(Executor):
+    """Delegating wrapper adding exec spans + transport metrics."""
+
+    def __init__(self, inner: Executor):
+        self.inner = inner
+        self.transport = (type(inner).__name__.removesuffix("Executor")
+                          .lower() or "unknown")
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    def __repr__(self) -> str:
+        return f"TracingExecutor({self.inner!r})"
+
+    # -- instrumented interface -------------------------------------------
+    def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
+        head = command.split(None, 1)[0] if command.strip() else "sh"
+        t0 = time.perf_counter()
+        with tracing.span(f"exec:{head}", kind="exec", ip=conn.ip) as sp:
+            res = self.inner.run(conn, command, timeout=timeout)
+            if sp is not None and not res.ok:
+                sp.status = "error"
+                sp.attributes["rc"] = res.rc
+        metrics.EXEC_LATENCY.observe(time.perf_counter() - t0,
+                                     transport=self.transport)
+        metrics.EXEC_COMMANDS.inc(transport=self.transport,
+                                  outcome=_outcome(res))
+        return res
+
+    def _file_op(self, op: str, conn: Conn, path: str, call):
+        t0 = time.perf_counter()
+        try:
+            with tracing.span(f"exec:{op}", kind="exec", ip=conn.ip,
+                              path=path):
+                result = call()
+        except Exception as e:
+            metrics.EXEC_COMMANDS.inc(
+                transport=self.transport,
+                outcome="transient" if getattr(e, "transient", False)
+                else "error")
+            raise
+        finally:
+            metrics.EXEC_LATENCY.observe(time.perf_counter() - t0,
+                                         transport=self.transport)
+        metrics.EXEC_COMMANDS.inc(transport=self.transport, outcome="ok")
+        return result
+
+    def put_file(self, conn: Conn, path: str, content: bytes,
+                 mode: int = 0o644) -> None:
+        self._file_op("put_file", conn, path,
+                      lambda: self.inner.put_file(conn, path, content,
+                                                  mode=mode))
+
+    def get_file(self, conn: Conn, path: str) -> bytes:
+        return self._file_op("get_file", conn, path,
+                             lambda: self.inner.get_file(conn, path))
+
+    def run_many(self, targets: list[tuple[Conn, str]], timeout: int = 300,
+                 max_parallel: int = 32) -> list[ExecResult]:
+        # one span for the whole batch — delegating preserves the inner
+        # transport's native fan-out (SSH's GIL-free koagent pool)
+        t0 = time.perf_counter()
+        with tracing.span(f"exec:fanout[{len(targets)}]", kind="exec",
+                          hosts=len(targets)) as sp:
+            results = self.inner.run_many(targets, timeout=timeout,
+                                          max_parallel=max_parallel)
+            if sp is not None and any(not r.ok for r in results):
+                sp.status = "error"
+        metrics.EXEC_LATENCY.observe(time.perf_counter() - t0,
+                                     transport=self.transport)
+        for res in results:
+            metrics.EXEC_COMMANDS.inc(transport=self.transport,
+                                      outcome=_outcome(res))
+        return results
+
+    def tty_argv(self, conn: Conn, command: str) -> list[str] | None:
+        # explicit: the inherited base method (returns None) would shadow
+        # the inner transport's PTY support before __getattr__ ever ran
+        return self.inner.tty_argv(conn, command)

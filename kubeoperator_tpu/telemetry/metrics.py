@@ -1,0 +1,298 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+The monitoring stack (``services/monitor.py``) scrapes *deployed clusters'*
+Prometheus; this module is the control plane instrumenting **itself** —
+counters, gauges, and fixed-bucket histograms with zero dependencies,
+rendered in the Prometheus text exposition format (0.0.4) at ``GET
+/metrics`` so a scrape of the controller works exactly like a scrape of
+the clusters it manages.
+
+Design points:
+
+* label sets are declared at metric creation and enforced on every sample
+  call — a typo'd label name raises instead of silently minting a new
+  series;
+* every family emits its ``# HELP``/``# TYPE`` header even with zero
+  samples, so scrapers (and the README lint test) can see the full
+  vocabulary from boot;
+* per-metric locks make increments safe under the step fan-out thread
+  pool and the task engine's workers.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable
+
+# Latency buckets spanning a fake-executor exec (~µs) to a full real-SSH
+# step with retries (minutes). Shared by the exec and step histograms so
+# the two are directly comparable on one dashboard.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_suffix(names: tuple[str, ...], values: tuple[str, ...],
+                   extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [f'{k}="{_escape_label(v)}"' for k, v in zip(names, values)]
+    pairs += [f'{k}="{_escape_label(v)}"' for k, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Metric:
+    """Base family: a name, a help string, declared label names, and one
+    sample slot per observed label-value tuple."""
+
+    type = "untyped"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self._lock = threading.Lock()
+        self._samples: dict[tuple[str, ...], object] = {}
+
+    def _key(self, label_values: dict) -> tuple[str, ...]:
+        if set(label_values) != set(self.labels):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(label_values)}, "
+                f"declared {sorted(self.labels)}")
+        return tuple(str(label_values[k]) for k in self.labels)
+
+    def samples(self) -> dict[tuple[str, ...], object]:
+        with self._lock:
+            return dict(self._samples)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    type = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = self._samples.get(key, 0.0) + amount
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return float(self._samples.get(self._key(labels), 0.0))
+
+    def render(self) -> list[str]:
+        with self._lock:
+            return [f"{self.name}{_labels_suffix(self.labels, key)} "
+                    f"{_format_value(v)}"
+                    for key, v in sorted(self._samples.items())]
+
+
+class Gauge(Metric):
+    type = "gauge"
+
+    def set(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._samples[key] = self._samples.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: object) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return float(self._samples.get(self._key(labels), 0.0))
+
+    def render(self) -> list[str]:
+        with self._lock:
+            return [f"{self.name}{_labels_suffix(self.labels, key)} "
+                    f"{_format_value(v)}"
+                    for key, v in sorted(self._samples.items())]
+
+
+class Histogram(Metric):
+    type = "histogram"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = (),
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labels)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds or bounds[-1] != math.inf:
+            bounds.append(math.inf)
+        self.buckets = tuple(bounds)
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            slot = self._samples.get(key)
+            if slot is None:
+                slot = {"counts": [0] * len(self.buckets), "sum": 0.0,
+                        "count": 0}
+                self._samples[key] = slot
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    slot["counts"][i] += 1
+                    break
+            slot["sum"] += value
+            slot["count"] += 1
+
+    def count(self, **labels: object) -> int:
+        with self._lock:
+            slot = self._samples.get(self._key(labels))
+            return slot["count"] if slot else 0
+
+    def sum(self, **labels: object) -> float:
+        with self._lock:
+            slot = self._samples.get(self._key(labels))
+            return slot["sum"] if slot else 0.0
+
+    def render(self) -> list[str]:
+        lines: list[str] = []
+        with self._lock:
+            for key, slot in sorted(self._samples.items()):
+                cumulative = 0
+                for bound, n in zip(self.buckets, slot["counts"]):
+                    cumulative += n
+                    le = (("le", _format_value(bound)),)
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{_labels_suffix(self.labels, key, le)} {cumulative}")
+                lines.append(f"{self.name}_sum{_labels_suffix(self.labels, key)} "
+                             f"{_format_value(slot['sum'])}")
+                lines.append(f"{self.name}_count{_labels_suffix(self.labels, key)} "
+                             f"{slot['count']}")
+        return lines
+
+
+class Registry:
+    """Holds metric families in registration order. Re-declaring a name
+    with the same type and labels returns the existing family (module
+    reloads under pytest importmode quirks must not double-register);
+    re-declaring with a different shape is a programming error."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+
+    def _register(self, cls, name: str, help: str,
+                  labels: tuple[str, ...], **kwargs) -> Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labels != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.type}{existing.labels}")
+                return existing
+            m = cls(name, help, tuple(labels), **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str,
+                labels: tuple[str, ...] = ()) -> Counter:
+        return self._register(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str,
+              labels: tuple[str, ...] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str, labels: tuple[str, ...] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, labels, buckets=buckets)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._metrics)
+
+    def get(self, name: str) -> Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Clear every family's samples (tests); families stay declared."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: list[str] = []
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.type}")
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the control plane's metric vocabulary — every family the registry serves
+# is documented in README "Observability" (test_monitoring_stack.py lints
+# the two against each other, so additions must land in both places)
+# ---------------------------------------------------------------------------
+
+REGISTRY = Registry()
+
+STEP_DURATION = REGISTRY.histogram(
+    "ko_step_duration_seconds",
+    "Wall-clock duration of one engine step (includes retries and backoff).",
+    labels=("operation", "step"))
+STEP_RETRIES = REGISTRY.counter(
+    "ko_step_retries_total",
+    "Step re-runs after a transient failure (driver-level retry).",
+    labels=("operation", "step"))
+QUARANTINED = REGISTRY.counter(
+    "ko_quarantined_hosts_total",
+    "Hosts quarantined out of an operation after exhausting retries.",
+    labels=("operation", "step"))
+EXEC_LATENCY = REGISTRY.histogram(
+    "ko_exec_latency_seconds",
+    "Latency of one transport command (run/put_file/get_file).",
+    labels=("transport",))
+EXEC_COMMANDS = REGISTRY.counter(
+    "ko_exec_commands_total",
+    "Transport commands by outcome (ok | transient | error).",
+    labels=("transport", "outcome"))
+OPERATIONS = REGISTRY.counter(
+    "ko_operations_total",
+    "Completed operations by terminal execution state.",
+    labels=("operation", "state"))
+TASK_QUEUE_DEPTH = REGISTRY.gauge(
+    "ko_task_queue_depth",
+    "Tasks waiting for a task-engine worker (PENDING records).")
+BEAT_LAG = REGISTRY.gauge(
+    "ko_beat_lag_seconds",
+    "How late the last beat tick fired versus its schedule.",
+    labels=("beat",))
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "ko_chaos_injections_total",
+    "Faults injected by the chaos harness, by kind.",
+    labels=("kind",))

@@ -1,0 +1,266 @@
+"""Span tracing for the operation engine.
+
+Every operation opens a root span, each step a child span, and each
+executor command a grandchild — the structure of "where did my provision
+time go" made first-class. Context propagation rides the same mechanism
+as ``CURRENT_TASK`` log routing: a ``ContextVar`` carried into the step
+fan-out workers and the deadline side-thread by
+``contextvars.copy_context()``, so no plumbing changes were needed in the
+thread pools.
+
+Spans record monotonic (``perf_counter``) durations plus events (retry,
+quarantine, chaos injection) and are persisted per-execution as a
+``TraceRecord`` in the resource store next to ``execution.steps`` —
+rendered by ``ko trace <execution>`` and served at
+``GET /api/v1/executions/{id}/trace``.
+
+Spans are collected at *finish*: a span opened inside a deadline-abandoned
+step thread simply never lands in the record (by design — the wedged
+thread must not touch a persisted trace later).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+from kubeoperator_tpu.utils.ids import new_id
+from kubeoperator_tpu.utils.logs import get_logger
+from kubeoperator_tpu.utils.timeutil import iso
+
+log = get_logger(__name__)
+
+# The active span in this execution context. Root default is None: spans
+# opened outside an operation (ad-hoc fact gathering, monitor probes) are
+# no-ops rather than orphan trees.
+CURRENT_SPAN: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "ko_current_span", default=None)
+
+DEFAULT_MAX_SPANS = 4000
+
+
+class Trace:
+    """Per-execution span collector. ``trace_id`` is the execution id;
+    offsets are relative to the root span's ``perf_counter`` origin so the
+    serialized tree orders deterministically without wall-clock skew."""
+
+    def __init__(self, trace_id: str, max_spans: int = DEFAULT_MAX_SPANS):
+        self.trace_id = trace_id
+        self.t0 = time.perf_counter()
+        self.max_spans = max_spans
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+
+    def record(self, span: "Span") -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            self._spans.append(span)
+
+    def to_dicts(self) -> list[dict]:
+        with self._lock:
+            return [s.to_dict() for s in
+                    sorted(self._spans, key=lambda s: s.start_offset_s)]
+
+
+class Span:
+    def __init__(self, name: str, kind: str, trace: Trace,
+                 parent_id: str = "", attributes: dict | None = None):
+        self.name = name
+        self.kind = kind                  # operation | step | host | exec
+        self.trace_id = trace.trace_id
+        self.span_id = new_id()[:16]
+        self.parent_id = parent_id
+        self.started_at = iso()
+        self.status = "ok"
+        self.attributes: dict[str, Any] = dict(attributes or {})
+        self.events: list[dict] = []
+        self.duration_s: float = 0.0
+        self._trace = trace
+        self._t0 = time.perf_counter()
+        self.start_offset_s = round(self._t0 - trace.t0, 6)
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        self.events.append({
+            "name": name,
+            "offset_s": round(time.perf_counter() - self._trace.t0, 6),
+            **attrs,
+        })
+
+    def finish(self) -> None:
+        self.duration_s = round(time.perf_counter() - self._t0, 6)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind,
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "started_at": self.started_at,
+            "start_offset_s": self.start_offset_s,
+            "duration_s": self.duration_s, "status": self.status,
+            "attributes": self.attributes, "events": self.events,
+        }
+
+
+@dataclass
+class TraceRecord:
+    """Persisted span tree for one execution (``name`` = execution id, so
+    ``get_by_name(TraceRecord, execution.id)`` is the lookup — the same
+    convention MonitorSnapshot uses for per-cluster data)."""
+
+    KIND = "trace"
+    project: str | None = None
+    name: str = ""                       # execution id
+    operation: str = ""
+    spans: list = field(default_factory=list)
+    dropped: int = 0
+    id: str = field(default_factory=new_id)
+    created_at: str = field(default_factory=iso)
+
+
+@contextmanager
+def trace(store, execution, max_spans: int = DEFAULT_MAX_SPANS) -> Iterator[Span]:
+    """Open the root span for ``execution`` and persist the collected tree
+    on exit — success, failure, or crash alike (the persist sits in a
+    ``finally``, and a store error must never mask the operation's own
+    outcome)."""
+    tr = Trace(execution.id, max_spans=max_spans)
+    root = Span(f"operation:{execution.operation}", kind="operation", trace=tr)
+    token = CURRENT_SPAN.set(root)
+    try:
+        yield root
+    except BaseException:
+        root.status = "error"
+        raise
+    finally:
+        CURRENT_SPAN.reset(token)
+        root.finish()
+        tr.record(root)
+        try:
+            store.save(TraceRecord(
+                project=execution.project, name=execution.id,
+                operation=execution.operation, spans=tr.to_dicts(),
+                dropped=tr.dropped))
+        except Exception:  # noqa: BLE001 — telemetry must not fail the op
+            log.exception("failed to persist trace for execution %s",
+                          execution.id)
+
+
+@contextmanager
+def span(name: str, kind: str = "internal", **attributes: Any) -> Iterator[Span | None]:
+    """Child span under the current one. Outside an active trace this
+    yields ``None`` and costs (almost) nothing — instrumented code paths
+    (executor commands, host fan-outs) run fine without an operation."""
+    parent = CURRENT_SPAN.get()
+    if parent is None:
+        yield None
+        return
+    sp = Span(name, kind=kind, trace=parent._trace,
+              parent_id=parent.span_id, attributes=attributes)
+    token = CURRENT_SPAN.set(sp)
+    try:
+        yield sp
+    except BaseException:
+        sp.status = "error"
+        raise
+    finally:
+        CURRENT_SPAN.reset(token)
+        sp.finish()
+        sp._trace.record(sp)
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Attach an event to the active span (retry, quarantine, chaos…);
+    silently a no-op outside a trace."""
+    sp = CURRENT_SPAN.get()
+    if sp is not None:
+        sp.add_event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# rendering (ko trace)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    if seconds >= 1:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1000:.1f}ms"
+
+
+def _decorations(s: dict) -> str:
+    bits = []
+    attrs = s.get("attributes", {})
+    if attrs.get("retries"):
+        bits.append(f"retries={attrs['retries']}")
+    if attrs.get("backoff_s"):
+        bits.append(f"backoff={attrs['backoff_s']}s")
+    if attrs.get("rc") not in (None, 0):
+        bits.append(f"rc={attrs['rc']}")
+    for ev in s.get("events", []):
+        if ev["name"] == "quarantine":
+            bits.append(f"quarantined={','.join(ev.get('hosts', []))}")
+        elif ev["name"] == "chaos":
+            bits.append(f"chaos:{ev.get('kind', '?')}")
+    if s.get("status") == "error":
+        bits.append("ERROR")
+    return ("  [" + " ".join(bits) + "]") if bits else ""
+
+
+def build_tree(spans: list[dict]) -> tuple[list[dict], dict[str, list[dict]]]:
+    """(roots, children-by-parent), both ordered by start offset."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in sorted(spans, key=lambda s: s.get("start_offset_s", 0.0)):
+        parent = s.get("parent_id", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    return roots, children
+
+
+def format_trace(spans: list[dict], slowest: int = 0) -> str:
+    """Indented timeline of the span tree; with ``slowest=N`` instead the
+    N slowest spans with their ancestry path (the critical-path view)."""
+    if not spans:
+        return "(no spans recorded)"
+    if slowest > 0:
+        by_id = {s["span_id"]: s for s in spans}
+
+        def path(s: dict) -> str:
+            parts, cur, hops = [s["name"]], s, 0
+            while cur.get("parent_id") in by_id and hops < 64:
+                cur = by_id[cur["parent_id"]]
+                parts.append(cur["name"])
+                hops += 1
+            return " > ".join(reversed(parts))
+
+        top = sorted(spans, key=lambda s: -s.get("duration_s", 0.0))[:slowest]
+        width = max(len(_fmt_dur(s.get("duration_s", 0.0))) for s in top)
+        return "\n".join(
+            f"{_fmt_dur(s.get('duration_s', 0.0)).rjust(width)}  "
+            f"{path(s)}{_decorations(s)}" for s in top)
+
+    roots, children = build_tree(spans)
+    lines: list[str] = []
+
+    def walk(s: dict, depth: int) -> None:
+        lines.append(
+            f"{'  ' * depth}{s['name']}  {_fmt_dur(s.get('duration_s', 0.0))}"
+            f"  (+{_fmt_dur(s.get('start_offset_s', 0.0))})"
+            f"{_decorations(s)}")
+        for c in children.get(s["span_id"], []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)

@@ -13,7 +13,10 @@ import logging
 import os
 import threading
 
-FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+# %(task_tag)s renders " [task <id>]" when the record was emitted from a
+# task context (CURRENT_TASK set), else "" — every handler using FORMAT
+# must install a filter that stamps the attribute (see _TaskTagFilter)
+FORMAT = "%(asctime)s %(levelname)s %(name)s%(task_tag)s %(message)s"
 
 # Which task the current execution context belongs to. Set by TaskEngine._run
 # and propagated into step fan-out worker threads via contextvars.copy_context
@@ -24,6 +27,30 @@ _initialized = False
 _init_lock = threading.Lock()
 
 
+class _TaskTagFilter(logging.Filter):
+    """Stamps ``record.task_tag`` so FORMAT can interpolate it. Attached
+    per-handler (not per-logger): records from child loggers propagate to
+    ancestor *handlers* without running ancestor loggers' filters."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        task = CURRENT_TASK.get()
+        record.task_tag = f" [task {task}]" if task else ""
+        return True
+
+
+def apply_log_level(logger: logging.Logger, value: str | None) -> None:
+    """Set the level from ``KO_LOG_LEVEL``-style input. An invalid value
+    used to fall back to INFO *silently* — now the fallback announces the
+    bad value once (this runs once, from the init block below)."""
+    try:
+        logger.setLevel((value or "INFO").upper())
+    except (ValueError, TypeError):
+        logger.setLevel(logging.INFO)
+        logger.warning(
+            "invalid KO_LOG_LEVEL %r — falling back to INFO "
+            "(want DEBUG|INFO|WARNING|ERROR|CRITICAL)", value)
+
+
 def get_logger(name: str) -> logging.Logger:
     global _initialized
     if not _initialized:
@@ -32,13 +59,10 @@ def get_logger(name: str) -> logging.Logger:
                 root = logging.getLogger("kubeoperator_tpu")
                 h = logging.StreamHandler()
                 h.setFormatter(logging.Formatter(FORMAT))
+                h.addFilter(_TaskTagFilter())
                 root.addHandler(h)
-                level = os.environ.get("KO_LOG_LEVEL", "INFO").upper()
-                try:
-                    root.setLevel(level)
-                except ValueError:
-                    root.setLevel(logging.INFO)
                 _initialized = True
+                apply_log_level(root, os.environ.get("KO_LOG_LEVEL", "INFO"))
     return logging.getLogger(name)
 
 
@@ -56,6 +80,10 @@ class TaskLogHandler(logging.FileHandler):
         self.task_id = task_id
 
     def filter(self, record: logging.LogRecord) -> bool:
+        task = CURRENT_TASK.get()
+        # this handler formats with FORMAT too, and its filter() override
+        # bypasses the Filter list — stamp the tag here
+        record.task_tag = f" [task {task}]" if task else ""
         if not self.task_id:
             return True
-        return CURRENT_TASK.get() == self.task_id
+        return task == self.task_id

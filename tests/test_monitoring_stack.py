@@ -119,3 +119,27 @@ def test_history_accumulates_for_charts(platform, installed):  # noqa: F811
     assert set(points[0]) >= {"time", "cpu_usage", "cpu_total",
                               "mem_used_bytes", "mem_total_bytes",
                               "tpu_utilization", "pod_count"}
+
+
+# ---------------------------------------------------------------------------
+# first-party telemetry (ISSUE 3 satellite): the README "Observability"
+# metric table and the registry's vocabulary must not drift — same
+# cross-check stance as the PROMQL/exporter pairing above
+# ---------------------------------------------------------------------------
+
+def test_readme_metric_table_matches_registry():
+    import os
+
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+
+    readme = os.path.join(os.path.dirname(__file__), "..", "README.md")
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    assert "## Observability" in text, "README lost its Observability section"
+    section = text.split("## Observability", 1)[1].split("\n## ", 1)[0]
+    documented = set(re.findall(r"^\| `(ko_[a-z0-9_]+)`", section, re.M))
+    registered = set(REGISTRY.names())
+    assert documented == registered, (
+        f"README table vs registry drift — undocumented: "
+        f"{sorted(registered - documented)}, stale rows: "
+        f"{sorted(documented - registered)}")

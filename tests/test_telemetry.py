@@ -1,0 +1,447 @@
+"""First-party telemetry (ISSUE 3): metrics registry + exposition, span
+tracing over a full fake-executor install, /metrics + trace API routes,
+``ko trace`` CLI, enriched healthz, log satellites, and the gauges fed by
+the task engine. Zero real infrastructure — fake/chaos transports only."""
+
+import logging
+import re
+import threading
+
+import pytest
+
+from kubeoperator_tpu import ctl
+from kubeoperator_tpu.api.app import ensure_admin
+from kubeoperator_tpu.config.loader import load_config
+from kubeoperator_tpu.engine.executor import ChaosExecutor, Conn, FakeExecutor
+from kubeoperator_tpu.engine.tasks import TaskEngine
+from kubeoperator_tpu.resources.entities import ExecutionState, StepState
+from kubeoperator_tpu.resources.store import Store
+from kubeoperator_tpu.services.platform import Platform
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.telemetry import tracing
+from kubeoperator_tpu.telemetry.instrument import TracingExecutor
+from kubeoperator_tpu.telemetry.tracing import TraceRecord, format_trace
+from kubeoperator_tpu.utils.logs import (
+    CURRENT_TASK, FORMAT, _TaskTagFilter, apply_log_level,
+)
+
+from tests.conftest import CPU_FACTS
+from tests.test_api import login, run_api
+from tests.test_ctl import run_with_server
+
+
+# ---------------------------------------------------------------------------
+# registry unit behavior (fresh Registry instances: the global REGISTRY
+# accumulates across the tier-1 run, so exactness lives here)
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_label_enforcement():
+    reg = tm.Registry()
+    c = reg.counter("t_total", "help", labels=("op",))
+    c.inc(op="install")
+    c.inc(2, op="install")
+    assert c.value(op="install") == 3
+    assert c.value(op="scale") == 0
+    with pytest.raises(ValueError):
+        c.inc(wrong="x")            # undeclared label name
+    with pytest.raises(ValueError):
+        c.inc(-1, op="install")     # counters only go up
+    g = reg.gauge("t_depth", "help")
+    g.set(4)
+    g.dec()
+    assert g.value() == 3
+
+
+def test_registry_redeclare_same_shape_is_idempotent():
+    reg = tm.Registry()
+    a = reg.counter("x_total", "help", labels=("k",))
+    assert reg.counter("x_total", "help", labels=("k",)) is a
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "help", labels=("other",))
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "help", labels=("k",))
+
+
+def test_histogram_buckets_cumulative():
+    reg = tm.Registry()
+    h = reg.histogram("t_seconds", "help", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(6.25)
+    lines = h.render()
+    assert 't_seconds_bucket{le="0.1"} 1' in lines
+    assert 't_seconds_bucket{le="1"} 3' in lines
+    assert 't_seconds_bucket{le="+Inf"} 4' in lines
+    assert "t_seconds_count 4" in lines
+
+
+def test_concurrent_increments_are_exact():
+    """8 writers × 1000 increments under the same thread-pool pressure the
+    step fan-out produces — no lost updates."""
+    reg = tm.Registry()
+    c = reg.counter("c_total", "help", labels=("who",))
+    h = reg.histogram("h_seconds", "help", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc(who="w")
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(who="w") == 8000
+    assert h.count() == 8000
+    assert h.sum() == pytest.approx(800.0)
+
+
+def test_exposition_golden():
+    """Byte-for-byte exposition for a small known registry — the format
+    contract /metrics serves (text format 0.0.4)."""
+    reg = tm.Registry()
+    c = reg.counter("ko_t_ops_total", "Completed ops.", labels=("op", "state"))
+    g = reg.gauge("ko_t_depth", "Queue depth.")
+    h = reg.histogram("ko_t_lat_seconds", "Latency.", labels=("t",),
+                      buckets=(0.1, 1.0))
+    c.inc(op="install", state="SUCCESS")
+    c.inc(2, op="scale", state="FAILURE")
+    g.set(3)
+    h.observe(0.05, t="fake")
+    h.observe(0.5, t="fake")
+    assert reg.render() == (
+        "# HELP ko_t_ops_total Completed ops.\n"
+        "# TYPE ko_t_ops_total counter\n"
+        'ko_t_ops_total{op="install",state="SUCCESS"} 1\n'
+        'ko_t_ops_total{op="scale",state="FAILURE"} 2\n'
+        "# HELP ko_t_depth Queue depth.\n"
+        "# TYPE ko_t_depth gauge\n"
+        "ko_t_depth 3\n"
+        "# HELP ko_t_lat_seconds Latency.\n"
+        "# TYPE ko_t_lat_seconds histogram\n"
+        'ko_t_lat_seconds_bucket{t="fake",le="0.1"} 1\n'
+        'ko_t_lat_seconds_bucket{t="fake",le="1"} 2\n'
+        'ko_t_lat_seconds_bucket{t="fake",le="+Inf"} 2\n'
+        'ko_t_lat_seconds_sum{t="fake"} 0.55\n'
+        'ko_t_lat_seconds_count{t="fake"} 2\n'
+    )
+
+
+def test_label_values_are_escaped():
+    reg = tm.Registry()
+    c = reg.counter("e_total", "help", labels=("msg",))
+    c.inc(msg='a"b\\c\nd')
+    assert c.render() == ['e_total{msg="a\\"b\\\\c\\nd"} 1']
+
+
+# ---------------------------------------------------------------------------
+# the tentpole acceptance: a full fake install persists the span tree
+# ---------------------------------------------------------------------------
+
+def test_install_persists_span_tree(platform, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    rec = platform.store.get_by_name(TraceRecord, ex.id, scoped=False)
+    assert rec is not None, "install did not persist a TraceRecord"
+    spans = rec.spans
+    by_id = {s["span_id"]: s for s in spans}
+    roots = [s for s in spans if s["kind"] == "operation"]
+    steps = [s for s in spans if s["kind"] == "step"]
+    hosts = [s for s in spans if s["kind"] == "host"]
+    execs = [s for s in spans if s["kind"] == "exec"]
+    assert len(roots) == 1 and steps and hosts and execs
+    root = roots[0]
+    assert root["name"] == "operation:install"
+    assert root["trace_id"] == ex.id
+    assert all(s["trace_id"] == ex.id for s in spans)
+    # tree shape: step -> operation, host -> step, exec -> host|step
+    assert all(s["parent_id"] == root["span_id"] for s in steps)
+    assert all(by_id[s["parent_id"]]["kind"] == "step" for s in hosts)
+    for s in execs:
+        assert by_id[s["parent_id"]]["kind"] in ("host", "step")
+    # every executed step of the execution has a span, same order
+    executed = [s["name"] for s in ex.steps
+                if s["status"] == StepState.SUCCESS]
+    assert [s["name"] for s in steps] == [f"step:{n}" for n in executed]
+    # steps run sequentially under the root: the root's duration bounds
+    # the critical path (the acceptance inequality)
+    assert root["duration_s"] >= sum(s["duration_s"] for s in steps) - 1e-6
+    assert all(s["duration_s"] >= 0 for s in spans)
+    assert rec.dropped == 0
+
+
+def test_span_cap_counts_dropped(platform, manual_cluster):
+    platform.config["trace_max_spans"] = 5
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    rec = platform.store.get_by_name(TraceRecord, ex.id, scoped=False)
+    assert len(rec.spans) == 5
+    assert rec.dropped > 0
+    # the root is recorded last (at finish) — under the cap it is dropped,
+    # but the persisted record still names the operation
+    assert rec.operation == "install"
+
+
+def test_span_noop_outside_trace(fake_executor):
+    """Instrumented paths outside an operation cost nothing and record
+    nothing (no orphan trees from ad-hoc fact gathering)."""
+    with tracing.span("exec:ls", kind="exec") as sp:
+        assert sp is None
+    tracing.add_event("chaos", kind="reset")   # must not raise
+
+
+def test_tracing_executor_delegates_transport_api():
+    fake = FakeExecutor()
+    wrapped = TracingExecutor(fake)
+    wrapped.host("10.9.9.9").facts.update(CPU_FACTS)   # FakeExecutor surface
+    res = wrapped.run(Conn(ip="10.9.9.9"), "nproc")
+    assert res.ok and res.stdout == "8"
+    assert wrapped.ran("10.9.9.9", "nproc")
+    assert wrapped.transport == "fake"
+    assert wrapped.tty_argv(Conn(ip="10.9.9.9"), "sh") is None
+    before = tm.EXEC_COMMANDS.value(transport="fake", outcome="ok")
+    wrapped.put_file(Conn(ip="10.9.9.9"), "/tmp/x", b"hi")
+    assert wrapped.get_file(Conn(ip="10.9.9.9"), "/tmp/x") == b"hi"
+    assert tm.EXEC_COMMANDS.value(transport="fake", outcome="ok") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# chaos auditability: injections land in the counter and as span events
+# ---------------------------------------------------------------------------
+
+def _chaos_platform(tmp_path):
+    chaos = ChaosExecutor(FakeExecutor(), seed=77)
+    cfg = load_config(overrides={
+        "data_dir": str(tmp_path / "data"), "executor": "fake",
+        "terraform_bin": "", "task_workers": 2, "node_forks": 8,
+        "repo_host": "127.0.0.1",
+        "step_backoff_s": 0.001, "step_backoff_max_s": 0.002,
+        "exec_backoff_s": 0.0,
+    })
+    p = Platform(config=cfg, store=Store(), executor=chaos)
+    cred = p.create_credential("key", private_key="FAKE KEY")
+    for i, ip in enumerate(("10.7.0.1", "10.7.0.2")):
+        chaos.inner.host(ip).facts.update(CPU_FACTS)
+        h = p.register_host(f"ct-{i}", ip, cred.id)
+        if i == 0:
+            m = h
+        else:
+            w = h
+    c = p.create_cluster("ct", template="SINGLE",
+                         configs={"registry": "reg.local:8082"})
+    p.add_node(c, m, ["master"])
+    p.add_node(c, w, ["worker"])
+    return p, chaos
+
+
+def test_chaos_injection_records_counter_and_span_event(tmp_path):
+    p, chaos = _chaos_platform(tmp_path)
+    try:
+        p.config["exec_retry"] = 0    # escalate the flake to the step driver
+        before_reset = tm.CHAOS_INJECTIONS.value(kind="reset")
+        before_retry = tm.STEP_RETRIES.value(operation="install",
+                                             step="prepare")
+        chaos.fail_next(1, pattern="mkdir")
+        ex = p.run_operation("ct", "install")
+        assert ex.state == ExecutionState.SUCCESS, ex.result
+        assert tm.CHAOS_INJECTIONS.value(kind="reset") == before_reset + 1
+        assert tm.STEP_RETRIES.value(operation="install",
+                                     step="prepare") == before_retry + 1
+        rec = p.store.get_by_name(TraceRecord, ex.id, scoped=False)
+        events = [e for s in rec.spans for e in s["events"]]
+        chaos_events = [e for e in events if e["name"] == "chaos"]
+        assert chaos_events and chaos_events[0]["kind"] == "reset"
+        retry_events = [e for e in events if e["name"] == "retry"]
+        assert retry_events and retry_events[0]["attempt"] == 1
+        # the step span carries the retry verdict the CLI renders
+        step = next(s for s in rec.spans if s["name"] == "step:prepare")
+        assert step["attributes"]["retries"] == 1
+        assert step["attributes"]["backoff_s"] > 0
+    finally:
+        p.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /metrics + healthz + trace over the API
+# ---------------------------------------------------------------------------
+
+EXPOSITION_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+)$")
+
+
+def test_metrics_endpoint_serves_prometheus_text(platform, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS
+    ensure_admin(platform)
+
+    async def scenario(client):
+        r = await client.get("/metrics")     # unauthenticated, like a scrape
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in r.headers["Content-Type"]
+        return await r.text()
+
+    text = run_api(platform, scenario)
+    for line in text.strip().splitlines():
+        assert EXPOSITION_LINE.match(line), f"invalid exposition line: {line!r}"
+    # acceptance: the step histogram and retry counter are present
+    assert "# TYPE ko_step_duration_seconds histogram" in text
+    assert 'ko_step_duration_seconds_bucket{operation="install"' in text
+    assert 'le="+Inf"' in text
+    assert "# TYPE ko_step_retries_total counter" in text
+    assert "# TYPE ko_exec_latency_seconds histogram" in text
+    assert 'ko_operations_total{operation="install",state="SUCCESS"}' in text
+    assert "ko_task_queue_depth" in text
+
+
+def test_healthz_reports_version_uptime_queue(platform):
+    ensure_admin(platform)
+
+    async def scenario(client):
+        for path in ("/healthz", "/api/v1/healthz"):
+            r = await client.get(path)       # no auth header on purpose
+            assert r.status == 200, path
+            d = await r.json()
+            assert d["status"] == "ok"
+            assert d["version"]
+            assert d["uptime_s"] >= 0
+            assert d["queue_depth"] >= 0
+        return True
+
+    assert run_api(platform, scenario)
+
+
+def test_trace_endpoint_requires_auth_and_serves_spans(platform, manual_cluster):
+    ex = platform.run_operation("demo", "install")
+    ensure_admin(platform)
+
+    async def scenario(client):
+        r = await client.get(f"/api/v1/executions/{ex.id}/trace")
+        assert r.status == 401               # /api is protected
+        hdrs = await login(client)
+        r = await client.get(f"/api/v1/executions/{ex.id}/trace", headers=hdrs)
+        assert r.status == 200
+        d = await r.json()
+        assert d["execution"] == ex.id and d["operation"] == "install"
+        assert any(s["kind"] == "operation" for s in d["spans"])
+        r = await client.get("/api/v1/executions/nope/trace", headers=hdrs)
+        assert r.status == 404
+        return True
+
+    assert run_api(platform, scenario)
+
+
+# ---------------------------------------------------------------------------
+# ko trace CLI
+# ---------------------------------------------------------------------------
+
+def test_ko_trace_renders_timeline_and_slowest(platform, manual_cluster,
+                                               tmp_path, monkeypatch, capsys):
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS
+    ensure_admin(platform)
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+
+    def drive(url):
+        assert ctl.main(["login", url, "admin",
+                         "--password", "KubeOperator@tpu1"]) == 0
+        assert ctl.main(["trace", ex.id]) == 0
+        assert ctl.main(["trace", ex.id, "--slowest", "3"]) == 0
+        return True
+
+    assert run_with_server(platform, drive)
+    out = capsys.readouterr().out
+    assert "operation:install" in out
+    assert "step:prepare" in out
+    # the indented timeline nests host spans under steps
+    assert re.search(r"\n    host:demo-master-1 ", out)
+    # --slowest 3 prints exactly three ranked spans with ancestry paths
+    slowest = out.strip().rsplit(f"execution {ex.id}", 1)[1].splitlines()[1:]
+    assert len(slowest) == 3
+    assert all(re.match(r"\s*[\d.]+m?s  operation:install", l)
+               for l in slowest)
+
+
+def test_format_trace_handles_empty_and_orphans():
+    assert format_trace([]) == "(no spans recorded)"
+    spans = [{"name": "a", "kind": "step", "span_id": "1",
+              "parent_id": "missing", "start_offset_s": 0.0,
+              "duration_s": 0.5, "status": "ok", "attributes": {},
+              "events": []}]
+    # orphaned parent -> rendered as a root, not lost
+    assert "a" in format_trace(spans)
+
+
+# ---------------------------------------------------------------------------
+# logs satellites
+# ---------------------------------------------------------------------------
+
+def test_apply_log_level_warns_once_on_bad_value(caplog):
+    lg = logging.getLogger("kubeoperator_tpu.test_loglevel")
+    apply_log_level(lg, "VERBOSE")
+    assert lg.level == logging.INFO
+    assert any("invalid KO_LOG_LEVEL 'VERBOSE'" in r.getMessage()
+               for r in caplog.records)
+    caplog.clear()
+    apply_log_level(lg, "debug")           # case-insensitive valid value
+    assert lg.level == logging.DEBUG
+    assert not caplog.records
+
+
+def test_format_includes_task_id_when_set():
+    fmt = logging.Formatter(FORMAT)
+    filt = _TaskTagFilter()
+    rec = logging.LogRecord("kubeoperator_tpu.x", logging.INFO, "f", 1,
+                            "hello", (), None)
+    token = CURRENT_TASK.set("abc123")
+    try:
+        filt.filter(rec)
+    finally:
+        CURRENT_TASK.reset(token)
+    assert "[task abc123] hello" in fmt.format(rec)
+    rec2 = logging.LogRecord("kubeoperator_tpu.x", logging.INFO, "f", 1,
+                             "hello", (), None)
+    filt.filter(rec2)
+    assert "[task" not in fmt.format(rec2)
+    assert "hello" in fmt.format(rec2)
+
+
+# ---------------------------------------------------------------------------
+# task-engine gauges
+# ---------------------------------------------------------------------------
+
+def test_queue_depth_gauge_tracks_pending(tmp_path):
+    eng = TaskEngine(workers=1, log_dir=str(tmp_path))
+    gate = threading.Event()
+    started = threading.Event()
+    try:
+        eng.submit("t-block", "blocker",
+                   lambda: (started.set(), gate.wait(5)))
+        assert started.wait(5)
+        eng.submit("t-q1", "queued", lambda: None)
+        eng.submit("t-q2", "queued", lambda: None)
+        assert tm.TASK_QUEUE_DEPTH.value() == 2
+        gate.set()
+        eng.wait("t-q2", timeout=5)
+        eng.wait("t-q1", timeout=5)
+        assert tm.TASK_QUEUE_DEPTH.value() == 0
+    finally:
+        gate.set()
+        eng.shutdown()
+
+
+def test_beat_lag_gauge_updates(tmp_path):
+    eng = TaskEngine(workers=1, log_dir=str(tmp_path))
+    ticked = threading.Event()
+    try:
+        eng.every(0.02, "unit-beat", ticked.set)
+        assert ticked.wait(5)
+        # the gauge has a sample for this beat (lag ≥ 0 by construction)
+        assert tm.BEAT_LAG.value(beat="unit-beat") >= 0
+        assert ("unit-beat",) in tm.BEAT_LAG.samples()
+    finally:
+        eng.shutdown()
