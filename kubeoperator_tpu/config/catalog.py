@@ -25,6 +25,12 @@ class StepDef:
     # fault-tolerance overrides; None -> the config defaults apply
     retry: int | None = None         # transient-failure retries for this step
     timeout_s: float | None = None   # hard per-step deadline in the driver
+    # DAG edges (ISSUE 4): names of steps this one must run after. None
+    # (unset) keeps today's behavior — depend on the previous step of
+    # whichever operation list the step appears in; an explicit [] means
+    # "no dependencies" and is only valid where that is true in every
+    # operation using the step. Validated per operation at catalog load.
+    needs: tuple[str, ...] | None = None
 
 
 @dataclass(frozen=True)
@@ -58,12 +64,27 @@ class Catalog:
     tpu_slices: dict[str, TpuSlice] = field(default_factory=dict)
     compute_models: dict[str, ComputeModel] = field(default_factory=dict)
     apps: list[dict] = field(default_factory=list)
+    # per-operation effective dependency edges (after applying the
+    # default-previous rule): operation -> {step name -> dep step names}
+    dags: dict[str, dict[str, tuple[str, ...]]] = field(default_factory=dict)
 
     # -- queries ----------------------------------------------------------
     def operation_steps(self, operation: str) -> list[StepDef]:
+        """Steps of ``operation`` in deterministic topological order (stable
+        Kahn, original-list-position tie-break — identical to the list order
+        whenever that order is already topologically valid, so resume_from
+        prefixes and progress displays are unchanged for linear flows)."""
         if operation not in self.operations:
             raise KeyError(f"unknown operation {operation!r}; have {sorted(self.operations)}")
         return [self.steps[s] for s in self.operations[operation]]
+
+    def operation_dag(self, operation: str) -> list[tuple[StepDef, tuple[int, ...]]]:
+        """``operation_steps`` plus edges: each entry is ``(step, deps)``
+        where ``deps`` are indices into this same (topological) list."""
+        steps = self.operation_steps(operation)
+        index = {s.name: i for i, s in enumerate(steps)}
+        deps = self.dags[operation]
+        return [(s, tuple(index[d] for d in deps[s.name])) for s in steps]
 
     def template(self, name: str) -> dict:
         for t in self.templates:
@@ -107,17 +128,68 @@ class Catalog:
         return "minimal"
 
 
+def _resolve_dag(op: str, names: list[str],
+                 steps: dict[str, StepDef]) -> list[tuple[str, tuple[str, ...]]]:
+    """Validate one operation's step list against the steps' ``needs``
+    edges and return ``[(step name, dep names), ...]`` in deterministic
+    topological order (stable Kahn; ready steps run in original list
+    order). Raises ValueError naming the operation and offending step for
+    undefined steps, unknown/cross-operation/self ``needs`` refs, duplicate
+    list entries, and cycles."""
+    for s in names:
+        if s not in steps:
+            raise ValueError(
+                f"operation {op!r} references undefined step {s!r}")
+    if len(set(names)) != len(names):
+        dupes = sorted({s for s in names if names.count(s) > 1})
+        raise ValueError(f"operation {op!r} lists steps more than once: {dupes}")
+    in_op = set(names)
+    deps: dict[str, tuple[str, ...]] = {}
+    for i, name in enumerate(names):
+        needs = steps[name].needs
+        if needs is None:                       # default: previous list entry
+            deps[name] = (names[i - 1],) if i else ()
+            continue
+        for n in needs:
+            if n == name:
+                raise ValueError(
+                    f"operation {op!r}: step {name!r} depends on itself")
+            if n not in steps:
+                raise ValueError(
+                    f"operation {op!r}: step {name!r} needs unknown step {n!r}")
+            if n not in in_op:
+                raise ValueError(
+                    f"operation {op!r}: step {name!r} needs {n!r}, which is "
+                    f"not part of this operation")
+        deps[name] = tuple(dict.fromkeys(needs))
+    index = {n: i for i, n in enumerate(names)}
+    order: list[str] = []
+    placed: set[str] = set()
+    pending = list(names)
+    while pending:
+        ready = [n for n in pending if all(d in placed for d in deps[n])]
+        if not ready:
+            raise ValueError(
+                f"operation {op!r} has a dependency cycle among {sorted(pending)}")
+        nxt = min(ready, key=index.__getitem__)
+        order.append(nxt)
+        placed.add(nxt)
+        pending.remove(nxt)
+    return [(n, deps[n]) for n in order]
+
+
 def _parse(raw: dict[str, Any]) -> Catalog:
     cat = Catalog(raw=raw)
     for name, spec in raw.get("steps", {}).items():
+        needs = spec.get("needs")
         cat.steps[name] = StepDef(
             name=name, module=spec["module"], targets=tuple(spec["targets"]),
-            retry=spec.get("retry"), timeout_s=spec.get("timeout_s"))
-    cat.operations = {k: list(v) for k, v in raw.get("operations", {}).items()}
-    for op, steps in cat.operations.items():
-        missing = [s for s in steps if s not in cat.steps]
-        if missing:
-            raise ValueError(f"operation {op!r} references undefined steps {missing}")
+            retry=spec.get("retry"), timeout_s=spec.get("timeout_s"),
+            needs=None if needs is None else tuple(needs))
+    for op, listed in raw.get("operations", {}).items():
+        resolved = _resolve_dag(op, list(listed), cat.steps)
+        cat.operations[op] = [n for n, _ in resolved]
+        cat.dags[op] = dict(resolved)
     cat.roles = raw.get("roles", {})
     cat.networks = raw.get("networks", [])
     cat.storages = raw.get("storages", [])

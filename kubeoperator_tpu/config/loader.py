@@ -29,6 +29,9 @@ DEFAULTS: dict[str, Any] = {
     # engine
     "task_workers": 4,                      # ref: celery -c 4 (core/kubeops.py:28)
     "node_forks": 10,                       # ref: ansible forks=5 (runner.py:39); TPU pools are bigger
+    # DAG scheduler (ISSUE 4): how many ready steps of one operation may
+    # run concurrently; 1 degenerates to the old sequential walk
+    "step_forks": 4,
     # fault tolerance (ISSUE 1): step-level retries for transient failures
     # (catalog per-step `retry` overrides), exponential backoff + jitter
     # between attempts, capped; plus transport-level command retries inside
@@ -49,6 +52,11 @@ DEFAULTS: dict[str, Any] = {
     # must not bloat the store; overflow increments TraceRecord.dropped
     "trace_max_spans": 4000,
     "ssh_connect_timeout": 10,
+    # OpenSSH ControlMaster multiplexing: per-host persistent control
+    # sockets so each of the hundreds of per-step execs reuses one TCP+auth
+    # handshake; sockets live under the run dir and are cleaned on exit
+    "ssh_multiplex": True,
+    "ssh_control_persist": "60s",
     # api
     "bind_host": "127.0.0.1",
     "repo_host": "",                        # node-reachable controller addr for

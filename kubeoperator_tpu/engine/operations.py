@@ -1,13 +1,15 @@
 """Operation driver — the engine behind every Day-1/Day-2 flow.
 
 Replaces ``DeployExecution.start`` (reference ``deploy.py:36-145``): set
-cluster status, walk the catalog's step list for the operation, track
-per-step state + progress (consumed by the progress stream, reference
+cluster status, run the catalog's step DAG for the operation (bounded-
+concurrency ready-set scheduler, ``engine/scheduler.py``), track per-step
+state + progress (consumed by the progress stream, reference
 ``ws.py:8-30``), flip cluster status on completion/failure, and fan a
 message into the message center.
 
-Inventory is rebuilt before every step: the provider step mutates it
-(creates hosts/nodes) for AUTOMATIC clusters.
+Inventory is cached per execution and invalidated only by steps whose
+module mutates the node set (provider/scale) — not rebuilt before every
+attempt of every step.
 """
 
 from __future__ import annotations
@@ -20,6 +22,7 @@ from dataclasses import asdict
 
 from kubeoperator_tpu.config.catalog import StepDef
 from kubeoperator_tpu.engine.inventory import build_inventory
+from kubeoperator_tpu.engine.scheduler import run_dag
 from kubeoperator_tpu.engine.steps import (
     StepContext, StepDeadline, StepError, load_step,
 )
@@ -61,6 +64,32 @@ DONE_STATUS = {
 # least one new host, so this only trips on a pathological cluster where
 # workers keep dying one by one mid-step
 MAX_QUARANTINE_ROUNDS = 8
+
+# step modules that create/destroy hosts or nodes — the only events that
+# make a cached inventory stale mid-operation
+MUTATING_MODULES = {"provider_create", "provider_destroy", "remove_node"}
+
+
+class InventoryCache:
+    """``build_inventory`` memoized for one execution. Steps share the
+    resolved inventory; ``invalidate()`` (around provider/scale steps)
+    forces the next reader to rebuild."""
+
+    def __init__(self, store, catalog):
+        self._store = store
+        self._catalog = catalog
+        self._lock = threading.Lock()
+        self._inv = None
+
+    def get(self, cluster):
+        with self._lock:
+            if self._inv is None:
+                self._inv = build_inventory(self._store, cluster, self._catalog)
+            return self._inv
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._inv = None
 
 
 def _backoff(config, attempt: int) -> float:
@@ -137,7 +166,9 @@ def _run(platform, execution: DeployExecution) -> DeployExecution:
 def _run_steps(platform, execution: DeployExecution,
                cluster: Cluster) -> DeployExecution:
     store = platform.store
-    steps = platform.catalog.operation_steps(execution.operation)
+    dag = platform.catalog.operation_dag(execution.operation)
+    steps = [s for s, _ in dag]
+    deps = [d for _, d in dag]
     execution.steps = [asdict(ExecutionStep(name=s.name)) for s in steps]
     execution.state = ExecutionState.STARTED
     execution.started_at = iso()
@@ -149,8 +180,9 @@ def _run_steps(platform, execution: DeployExecution,
 
     # operation-level resume (beyond the reference, which re-runs every
     # step of a failed install): a retry execution carries
-    # params.resume_from = the failed step's name; earlier steps — already
-    # converged and idempotent — are skipped, not re-run
+    # params.resume_from = the failed step's name; the topological prefix
+    # before it — already converged and idempotent — is skipped, not
+    # re-run (deterministic because operation_steps is a stable order)
     start_index = 0
     resume_from = execution.params.get("resume_from")
     if resume_from:
@@ -163,15 +195,24 @@ def _run_steps(platform, execution: DeployExecution,
             log.warning("[%s] resume_from %r not in %s steps; running all",
                         execution.project, resume_from, execution.operation)
 
-    error: str | None = None
+    errors: list[str] = []
     quarantined: dict[str, str] = {}   # host -> reason, shared across steps
-    for i, step_def in enumerate(steps):
-        if i < start_index:
-            continue
-        execution.current_step = step_def.name
-        execution.steps[i]["status"] = StepState.RUNNING
-        execution.steps[i]["started_at"] = iso()
-        store.save(execution)
+    # one lock serializes every shared mutation under the DAG scheduler:
+    # execution.steps / result / progress writes, store.save, and the
+    # quarantine map (steps get read-only snapshots per attempt)
+    drv_lock = threading.RLock()
+    inv_cache = InventoryCache(store, platform.catalog)
+
+    def run_one(i: int, queue_wait_s: float) -> bool:
+        step_def = steps[i]
+        with drv_lock:
+            execution.current_step = step_def.name
+            execution.steps[i]["status"] = StepState.RUNNING
+            execution.steps[i]["started_at"] = iso()
+            execution.steps[i]["queue_wait_s"] = round(queue_wait_s, 4)
+            store.save(execution)
+        metrics.QUEUE_WAIT.observe(queue_wait_s, operation=execution.operation,
+                                   step=step_def.name)
         log.info("[%s] %s: step %s (%d/%d)", execution.project,
                  execution.operation, step_def.name, i + 1, len(steps))
         # retry budget: catalog per-step `retry` override, else config
@@ -181,53 +222,66 @@ def _run_steps(platform, execution: DeployExecution,
         attempt = 0
         quarantine_rounds = 0
         step_t0 = time.perf_counter()
+        ok = False
         # child span per step; the retry loop (including its backoff
         # sleeps) is the step's wall-clock story, so the span wraps it all
-        with tracing.span(f"step:{step_def.name}", kind="step",
-                          index=i) as sp:
+        with tracing.span(f"step:{step_def.name}", kind="step", index=i,
+                          queue_wait_s=round(queue_wait_s, 4)) as sp:
             while True:
                 try:
-                    cluster = store.get_by_name(Cluster, execution.project) or cluster
+                    cl = store.get_by_name(Cluster, execution.project) or cluster
+                    if step_def.module in MUTATING_MODULES:
+                        inv_cache.invalidate()   # retries must see fresh state
+                    with drv_lock:
+                        quarantined_snapshot = dict(quarantined)
                     ctx = StepContext(
-                        cluster=cluster,
+                        cluster=cl,
                         store=store,
-                        inventory=build_inventory(store, cluster, platform.catalog),
+                        inventory=inv_cache.get(cl),
                         executor=platform.executor,
                         catalog=platform.catalog,
                         config=platform.config,
                         vars={k: v for k, v in {
-                              **cluster.configs,
+                              **cl.configs,
                               **execution.params.get("upgrade_vars", {}),
                               **execution.params.get("vars", {})}.items()
                               if v != UPGRADE_DROP},
                         step=step_def,
-                        provider=platform.provider_for(cluster),
+                        provider=platform.provider_for(cl),
                         params=execution.params,
                         operation=execution.operation,
-                        quarantined=quarantined,
+                        quarantined=quarantined_snapshot,
                     )
-                    result = _call_step(load_step(step_def), ctx, step_def)
-                    execution.steps[i]["status"] = StepState.SUCCESS
-                    if quarantine_rounds:
-                        execution.steps[i]["message"] = (
-                            "succeeded with quarantined hosts: "
-                            + ", ".join(sorted(quarantined)))
-                    elif execution.steps[i].get("retries"):
-                        # drop the stale retry complaint; the count survives in
-                        # the ``retries`` field
-                        execution.steps[i]["message"] = ""
-                    if isinstance(result, dict):
-                        execution.result[step_def.name] = result
+                    try:
+                        result = _call_step(load_step(step_def), ctx, step_def)
+                    finally:
+                        ctx.close()
+                    if step_def.module in MUTATING_MODULES:
+                        inv_cache.invalidate()   # downstream sees new nodes
+                    with drv_lock:
+                        execution.steps[i]["status"] = StepState.SUCCESS
+                        if quarantine_rounds:
+                            execution.steps[i]["message"] = (
+                                "succeeded with quarantined hosts: "
+                                + ", ".join(sorted(quarantined)))
+                        elif execution.steps[i].get("retries"):
+                            # drop the stale retry complaint; the count
+                            # survives in the ``retries`` field
+                            execution.steps[i]["message"] = ""
+                        if isinstance(result, dict):
+                            execution.result[step_def.name] = result
+                    ok = True
                 except Exception as e:  # noqa: BLE001 — step boundary
                     if getattr(e, "transient", False) and attempt < retries:
                         attempt += 1
                         delay = _backoff(platform.config, attempt)
-                        execution.steps[i]["retries"] = attempt
-                        execution.steps[i]["backoff_s"] = round(
-                            execution.steps[i]["backoff_s"] + delay, 3)
-                        execution.steps[i]["message"] = (
-                            f"retry {attempt}/{retries} after transient failure: {e}")
-                        store.save(execution)   # progress stream sees the retry
+                        with drv_lock:
+                            execution.steps[i]["retries"] = attempt
+                            execution.steps[i]["backoff_s"] = round(
+                                execution.steps[i]["backoff_s"] + delay, 3)
+                            execution.steps[i]["message"] = (
+                                f"retry {attempt}/{retries} after transient failure: {e}")
+                            store.save(execution)  # progress stream sees the retry
                         metrics.STEP_RETRIES.inc(operation=execution.operation,
                                                  step=step_def.name)
                         tracing.add_event("retry", attempt=attempt,
@@ -248,8 +302,9 @@ def _run_steps(platform, execution: DeployExecution,
                     if (quarantinable and platform.config.get("quarantine", True)
                             and quarantine_rounds < MAX_QUARANTINE_ROUNDS):
                         quarantine_rounds += 1
-                        for name, why in quarantinable.items():
-                            quarantined[name] = f"{step_def.name}: {why}"
+                        with drv_lock:
+                            for name, why in quarantinable.items():
+                                quarantined[name] = f"{step_def.name}: {why}"
                         metrics.QUARANTINED.inc(len(quarantinable),
                                                 operation=execution.operation,
                                                 step=step_def.name)
@@ -259,9 +314,10 @@ def _run_steps(platform, execution: DeployExecution,
                                     execution.project, step_def.name,
                                     ", ".join(sorted(quarantinable)), e)
                         continue
-                    error = f"{step_def.name}: {e}"
-                    execution.steps[i]["status"] = StepState.ERROR
-                    execution.steps[i]["message"] = str(e)
+                    with drv_lock:
+                        errors.append(f"{step_def.name}: {e}")
+                        execution.steps[i]["status"] = StepState.ERROR
+                        execution.steps[i]["message"] = str(e)
                     log.error("[%s] step %s failed: %s", execution.project,
                               step_def.name, e)
                 break
@@ -274,15 +330,38 @@ def _run_steps(platform, execution: DeployExecution,
         metrics.STEP_DURATION.observe(time.perf_counter() - step_t0,
                                       operation=execution.operation,
                                       step=step_def.name)
-        execution.steps[i]["finished_at"] = iso()
-        done = sum(1 for s in execution.steps
-                   if s["status"] in (StepState.SUCCESS, StepState.ERROR,
-                                      StepState.SKIPPED))
-        execution.progress = round(done / len(steps), 3)
-        store.save(execution)
-        if error:
-            break
+        with drv_lock:
+            execution.steps[i]["finished_at"] = iso()
+            done = sum(1 for s in execution.steps
+                       if s["status"] in (StepState.SUCCESS, StepState.ERROR,
+                                          StepState.SKIPPED))
+            execution.progress = round(done / len(steps), 3)
+            store.save(execution)
+        return ok
 
+    forks = int(platform.config.get("step_forks", 4))
+    # snapshot the driver's context *before* opening the scheduler span:
+    # step spans stay children of the operation root (the flat tree every
+    # trace consumer expects), with the scheduler span a sibling that
+    # carries the walk-level attributes
+    base_ctx = contextvars.copy_context()
+    with tracing.span("scheduler", kind="scheduler", forks=forks,
+                      steps=len(steps)) as ssp:
+        outcome = run_dag(deps, run_one, forks=forks,
+                          done=range(start_index), context=base_ctx)
+        if ssp is not None:
+            ssp.attributes["failed"] = len(outcome.failed)
+            ssp.attributes["cancelled"] = len(outcome.cancelled)
+            if outcome.failed:
+                ssp.status = "error"
+    if outcome.cancelled:
+        # dependents of a failed step never ran — they stay PENDING, the
+        # same shape a sequential walk's `break` left behind
+        log.info("[%s] %s: cancelled %d dependent step(s) after failure",
+                 execution.project, execution.operation, len(outcome.cancelled))
+    error = "; ".join(errors) or None
+    cluster = store.get_by_name(Cluster, execution.project) or cluster
+    execution.current_step = ""   # operation over: nothing is running
     execution.finished_at = iso()
     if quarantined:
         # hand-off to the healing beat (services/healing.py): the hosts are
@@ -352,8 +431,13 @@ def progress_payload(execution: DeployExecution) -> dict:
         "state": execution.state,
         "progress": execution.progress,
         "current_step": execution.current_step,
-        # steps carry per-step retries/backoff_s so clients can render
-        # "retry n/m" live; quarantined hosts surface once recorded
+        # DAG runs execute several steps at once; `ko watch` renders the
+        # whole running set, not just the latest-started one
+        "running_steps": [s["name"] for s in execution.steps
+                          if s["status"] == StepState.RUNNING],
+        # steps carry per-step retries/backoff_s/queue_wait_s so clients
+        # can render "retry n/m" live; quarantined hosts surface once
+        # recorded
         "steps": execution.steps,
         "quarantined": execution.result.get("quarantined", {}),
     }

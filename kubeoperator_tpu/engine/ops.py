@@ -12,7 +12,9 @@ import random
 import shlex
 import time
 
-from kubeoperator_tpu.engine.executor import Conn, ExecResult, Executor
+from kubeoperator_tpu.engine.executor import (
+    Conn, ExecResult, Executor, TransientError,
+)
 
 # roles whose failure must always fail the step: losing a master or etcd
 # member is never gracefully degradable (quorum/control-plane at stake),
@@ -73,6 +75,19 @@ class HostOps:
     def exists(self, path: str) -> bool:
         return self.x.run(self.conn, f"test -e {shlex.quote(path)}").ok
 
+    def put(self, path: str, data: bytes, mode: int = 0o644) -> None:
+        """put_file with the same transport-level retry policy as sh()."""
+        for attempt in range(self.retries + 1):
+            try:
+                self.x.put_file(self.conn, path, data, mode=mode)
+                return
+            except TransientError:
+                if attempt == self.retries:
+                    raise
+                if self.backoff_s:
+                    time.sleep(self.backoff_s * (2 ** attempt)
+                               * (0.5 + random.random() / 2))
+
     # -- converging operations --------------------------------------------
     def ensure_dir(self, path: str) -> None:
         self.sh(f"mkdir -p {shlex.quote(path)}")
@@ -81,23 +96,94 @@ class HostOps:
         """Write ``path`` only if its sha256 differs. Returns True if written."""
         data = content.encode() if isinstance(content, str) else content
         want = hashlib.sha256(data).hexdigest()
-        r = self.x.run(self.conn, f"sha256sum {shlex.quote(path)} 2>/dev/null | cut -d' ' -f1")
+        r = self.sh(f"sha256sum {shlex.quote(path)} 2>/dev/null | cut -d' ' -f1",
+                    check=False)
+        if not r.ok and r.transient:
+            r.check("sha256sum probe")   # unreachable host, not a missing file
         if r.ok and r.stdout.strip() == want:
             return False
-        self.x.put_file(self.conn, path, data, mode=mode)
+        self.put(path, data, mode=mode)
         return True
 
+    def ensure_files(self, files) -> list[str]:
+        """Converge a batch of files in one warm-path round trip: a single
+        ``sha256sum`` over every path, then writes only for the missing or
+        different ones. ``files`` is a sequence of ``(path, content)`` or
+        ``(path, content, mode)``. Returns the paths written."""
+        want: dict[str, tuple[bytes, int, str]] = {}
+        for spec in files:
+            path, content, mode = spec if len(spec) == 3 else (*spec, 0o644)
+            data = content.encode() if isinstance(content, str) else content
+            want[path] = (data, mode, hashlib.sha256(data).hexdigest())
+        if not want:
+            return []
+        r = self.sh("sha256sum "
+                    + " ".join(shlex.quote(p) for p in want) + " 2>/dev/null",
+                    check=False)
+        if not r.ok and r.transient:
+            r.check("sha256sum probe")   # unreachable host, not missing files
+        have: dict[str, str] = {}
+        for line in (r.stdout or "").splitlines():
+            parts = line.split()
+            if len(parts) >= 2:
+                have[parts[-1]] = parts[0]
+        changed = []
+        for path, (data, mode, digest) in want.items():
+            if have.get(path) != digest:
+                self.put(path, data, mode=mode)
+                changed.append(path)
+        return changed
+
     def ensure_service(self, unit: str, unit_content: str | None = None) -> None:
-        """Install a systemd unit (if content given) and enable+start it."""
+        """Install a systemd unit (if content given) and enable+start it.
+        One round trip when the unit file changed (reload+enable+restart
+        chained; the trailing restart's rc decides success), one when it is
+        already active — a converged host that's active was enabled when
+        first installed, so the warm path skips the redundant enable."""
         changed = False
         if unit_content is not None:
             changed = self.ensure_file(f"/etc/systemd/system/{unit}.service", unit_content)
         if changed:
-            self.sh("systemctl daemon-reload")
-        self.sh(f"systemctl enable {unit}", check=False)
-        if self.x.run(self.conn, f"systemctl is-active {unit}").ok and not changed:
+            self.sh(f"systemctl daemon-reload; systemctl enable {unit}; "
+                    f"systemctl restart {unit}")
             return
-        self.sh(f"systemctl restart {unit}")
+        if self.x.run(self.conn, f"systemctl is-active {unit}").ok:
+            return
+        self.sh(f"systemctl enable {unit}; systemctl restart {unit}")
+
+    def ensure_services(self, units: dict[str, str],
+                        extras: dict[str, list] | None = None) -> None:
+        """Converge several systemd units in two warm-path round trips: one
+        batched sha probe over every unit file (plus per-unit ``extras``
+        file specs — configs whose change must restart that unit), one
+        combined daemon-reload + enable + restart chain for whatever
+        changed. Units whose files are all unchanged get an is-active probe
+        and are only restarted if inactive. Declaration order is restart
+        order, so list dependencies (e.g. the apiserver) first."""
+        extras = extras or {}
+        specs: list[tuple] = []
+        owner: dict[str, str] = {}
+        for unit, content in units.items():
+            path = f"/etc/systemd/system/{unit}.service"
+            specs.append((path, content))
+            owner[path] = unit
+            for spec in extras.get(unit, ()):
+                specs.append(spec)
+                owner[spec[0]] = unit
+        written = self.ensure_files(specs)
+        stale = {owner[p] for p in written}
+        if stale:
+            chain = ["systemctl daemon-reload"]
+            for unit in units:
+                if unit in stale:
+                    chain += [f"systemctl enable {unit}",
+                              f"systemctl restart {unit}"]
+            self.sh("; ".join(chain))
+        for unit in units:
+            if unit in stale:
+                continue
+            if not self.x.run(self.conn, f"systemctl is-active {unit}").ok:
+                self.sh(f"systemctl enable {unit}; systemctl restart {unit}")
 
     def service_stopped(self, unit: str) -> None:
         self.sh(f"systemctl stop {unit}", check=False)
@@ -113,30 +199,63 @@ class HostOps:
         step — air-gapped mirrors are exactly where silent corruption
         hides."""
         dest = f"{dest_dir}/{name}"
+        fetch = (f"mkdir -p {shlex.quote(dest_dir)} && "
+                 f"curl -fsSL -o {shlex.quote(dest)} {shlex.quote(source_url)}"
+                 f" && chmod 0755 {shlex.quote(dest)}")
 
         def verified() -> bool:
             return self.sh(
                 f"echo {shlex.quote(sha256 + '  ' + dest)} | sha256sum -c -",
                 check=False).ok
 
-        if self.exists(dest):
-            if sha256 is None or verified():
-                return
-            # a partial download from an earlier failed run would otherwise
-            # be accepted forever — refetch instead
-            self.sh(f"rm -f {shlex.quote(dest)}", check=False)
-        self.ensure_dir(dest_dir)
-        self.sh(f"curl -fsSL -o {shlex.quote(dest)} {shlex.quote(source_url)} && chmod 0755 {shlex.quote(dest)}",
-                timeout=600)
-        if sha256 and not verified():
+        if sha256 is None:
+            # one round trip: fetch only when absent
+            self.sh(f"test -e {shlex.quote(dest)} || {{ {fetch}; }}", timeout=600)
+            return
+        # the -c probe fails for an absent file too, so it doubles as the
+        # existence check; curl -o overwrites, so a partial download from
+        # an earlier failed run is refetched rather than accepted forever
+        if verified():
+            return
+        self.sh(fetch, timeout=600)
+        if not verified():
             self.sh(f"rm -f {shlex.quote(dest)}", check=False)
             raise RuntimeError(
                 f"checksum mismatch for {name} from {source_url}: "
                 f"expected sha256 {sha256}")
 
+    def ensure_binaries(self, specs, dest_dir: str = "/usr/local/bin") -> None:
+        """Batch ensure_binary: every unverified binary (no sha) shares one
+        round trip of chained ``test -e || fetch`` guards — the warm path
+        (binaries pre-distributed by the ``kube-binaries`` step) costs a
+        single exec. Specs carrying a sha256 keep the per-binary verified
+        path. ``specs`` is a sequence of ``(name, source_url, sha256)``."""
+        parts = []
+        for name, source_url, sha256 in specs:
+            if sha256 is not None:
+                self.ensure_binary(name, source_url, dest_dir=dest_dir,
+                                   sha256=sha256)
+                continue
+            dest = f"{dest_dir}/{name}"
+            fetch = (f"mkdir -p {shlex.quote(dest_dir)} && "
+                     f"curl -fsSL -o {shlex.quote(dest)} {shlex.quote(source_url)}"
+                     f" && chmod 0755 {shlex.quote(dest)}")
+            parts.append(f"test -e {shlex.quote(dest)} || {{ {fetch}; }}")
+        if parts:
+            self.sh("; ".join(parts), timeout=600)
+
     def ensure_line(self, path: str, line: str) -> None:
-        q = shlex.quote(line)
-        self.sh(f"grep -qxF {q} {shlex.quote(path)} 2>/dev/null || echo {q} >> {shlex.quote(path)}")
+        self.ensure_lines([(path, line)])
+
+    def ensure_lines(self, items) -> None:
+        """Batch ensure_line: one round trip appends every missing
+        ``(path, line)`` pair."""
+        parts = []
+        for path, line in items:
+            q, p = shlex.quote(line), shlex.quote(path)
+            parts.append(f"grep -qxF {q} {p} 2>/dev/null || echo {q} >> {p}")
+        if parts:
+            self.sh("; ".join(parts))
 
     def ensure_sysctl(self, key: str, value: str) -> None:
         self.ensure_line("/etc/sysctl.d/95-kubeoperator.conf", f"{key} = {value}")

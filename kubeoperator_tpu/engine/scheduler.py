@@ -1,0 +1,140 @@
+"""Bounded-concurrency ready-set scheduler over an operation's step DAG.
+
+The operation driver used to walk steps strictly sequentially; with the
+catalog's ``needs:`` edges (``Catalog.operation_dag``) the step list is a
+DAG and independent branches — disjoint host groups, control-plane vs.
+worker work — can overlap. This module owns only the *scheduling*: which
+node runs when, on how many threads, and what happens downstream of a
+failure. Everything inside a node (retry/backoff/deadline/quarantine from
+ISSUE 1, spans and step-state writes) stays in the driver's callback.
+
+Semantics:
+
+* at most ``forks`` nodes run concurrently (a ``ThreadPoolExecutor`` slot
+  pool; ready nodes beyond that queue, and their wait is measured);
+* a node becomes ready when every dependency is DONE (or pre-satisfied,
+  e.g. skipped by ``resume_from``);
+* a failed node **cancels** its not-yet-started transitive dependents
+  (they never run — the driver leaves them PENDING) while every branch
+  not downstream of the failure keeps draining to completion — exactly
+  the old ``break`` behavior when the DAG is a linear chain;
+* ``queue_wait_s`` per node = time from ready (submitted to the pool) to
+  the worker actually picking it up — the "waiting for a slot" signal
+  ``ko trace`` and ``ko_step_queue_wait_seconds`` surface.
+
+Determinism: ready nodes are submitted in topological-index order, and
+cancellation depends only on graph shape, never on timing — a dependent
+of a failed node is cancelled even if it would have become ready later.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+PENDING = "pending"
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+
+@dataclass
+class DagOutcome:
+    states: dict[int, str] = field(default_factory=dict)
+    failed: list[int] = field(default_factory=list)
+    cancelled: list[int] = field(default_factory=list)
+    queue_wait_s: dict[int, float] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed
+
+
+def run_dag(deps: Sequence[Sequence[int]],
+            run_node: Callable[[int, float], bool],
+            forks: int = 4,
+            done: Sequence[int] = (),
+            context: contextvars.Context | None = None) -> DagOutcome:
+    """Execute nodes ``0..len(deps)-1`` respecting ``deps`` (``deps[i]`` =
+    indices node ``i`` needs finished first) on at most ``forks`` threads.
+
+    ``run_node(index, queue_wait_s)`` returns True on success; False (or an
+    escaped exception) fails the node and cancels its transitive
+    dependents. ``done`` nodes count as already satisfied and are never
+    run. Each worker runs in a copy of ``context`` (default: the caller's
+    context at call time) so contextvars — current span, task log routing —
+    propagate onto the pool threads.
+    """
+    n = len(deps)
+    base_ctx = context if context is not None else contextvars.copy_context()
+    out = DagOutcome(states={i: (DONE if i in set(done) else PENDING)
+                             for i in range(n)})
+    states = out.states
+    dependents: list[list[int]] = [[] for _ in range(n)]
+    for i, ds in enumerate(deps):
+        for d in ds:
+            if not 0 <= d < n:
+                raise ValueError(f"node {i} depends on out-of-range node {d}")
+            dependents[d].append(i)
+    cond = threading.Condition()
+    ready_at: dict[int, float] = {}
+    inflight = 0
+
+    def _cancel_dependents(i: int) -> None:
+        # under cond: a dependent can only be PENDING here — RUNNING/QUEUED
+        # would mean its (transitively failed) deps were all DONE
+        stack = list(dependents[i])
+        while stack:
+            j = stack.pop()
+            if states[j] == PENDING:
+                states[j] = CANCELLED
+                out.cancelled.append(j)
+                stack.extend(dependents[j])
+
+    def _worker(i: int) -> None:
+        nonlocal inflight
+        t0 = time.perf_counter()
+        wait = max(0.0, t0 - ready_at[i])
+        with cond:
+            states[i] = RUNNING
+            out.queue_wait_s[i] = wait
+        try:
+            ok = bool(run_node(i, wait))
+        except BaseException:  # noqa: BLE001 — a node must never kill the walk
+            ok = False
+        with cond:
+            states[i] = DONE if ok else FAILED
+            if ok:
+                for j in dependents[i]:
+                    _maybe_submit(j)
+            else:
+                out.failed.append(i)
+                _cancel_dependents(i)
+            inflight -= 1
+            cond.notify_all()
+
+    def _maybe_submit(j: int) -> None:
+        # under cond
+        nonlocal inflight
+        if states[j] == PENDING and all(states[d] == DONE for d in deps[j]):
+            states[j] = QUEUED
+            ready_at[j] = time.perf_counter()
+            inflight += 1
+            pool.submit(base_ctx.copy().run, _worker, j)
+
+    with ThreadPoolExecutor(max_workers=max(1, int(forks)),
+                            thread_name_prefix="ko-sched") as pool:
+        with cond:
+            for i in range(n):
+                _maybe_submit(i)
+            while inflight:
+                cond.wait()
+    out.failed.sort()
+    out.cancelled.sort()
+    return out

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import contextvars
 import importlib
-from concurrent.futures import ThreadPoolExecutor
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -87,10 +88,35 @@ class StepContext:
     params: dict[str, Any] = field(default_factory=dict)  # operation params
     operation: str = ""           # the running operation (install/scale/...)
     quarantined: dict[str, str] = field(default_factory=dict)
-    # ^ host name -> reason, shared across the operation's steps: hosts the
+    # ^ host name -> reason, snapshot per attempt from the driver: hosts the
     #   driver quarantined stop being targeted and are excluded from checks
+    # one fan-out pool per step attempt, created lazily and reused across
+    # every fan_out call the step makes (the driver calls close() after the
+    # attempt) — a multi-phase step no longer pays pool setup/teardown per
+    # phase
+    _pool: ThreadPoolExecutor | None = field(default=None, repr=False)
+    _pool_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False)
 
     # -- helpers usable by every step -------------------------------------
+    def _fanout_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                workers = max(1, int(self.config.get("node_forks", 10)))
+                self._pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="ko-fanout")
+            return self._pool
+
+    def close(self) -> None:
+        """Release the step's fan-out pool (driver-owned lifecycle).
+        Non-blocking: after a deadline overrun the abandoned attempt may
+        still hold workers — queued host tasks are cancelled and running
+        ones finish on their own without stalling the driver."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
     def targets(self) -> list[TargetHost]:
         assert self.step is not None
         out: list[TargetHost] = []
@@ -117,7 +143,6 @@ class StepContext:
             return {}
         results: dict[str, Any] = {}
         failures: dict[str, tuple[str, bool]] = {}   # name -> (msg, transient)
-        workers = max(1, min(int(self.config.get("node_forks", 10)), len(targets)))
 
         def traced(th: TargetHost):
             # per-host child span under the step span each worker inherited
@@ -126,20 +151,24 @@ class StepContext:
             with tracing.span(f"host:{th.name}", kind="host", ip=th.conn.ip):
                 return fn(th)
 
-        with ThreadPoolExecutor(max_workers=workers, thread_name_prefix="ko-fanout") as pool:
-            # copy_context per host: worker threads inherit CURRENT_TASK so
-            # their log records reach the owning task's log file
-            futs = {pool.submit(contextvars.copy_context().run, traced, th): th
-                    for th in targets}
-            for fut, th in futs.items():
-                try:
-                    results[th.name] = fut.result()
-                except TransientError as e:
-                    failures[th.name] = (str(e), True)
-                except (StepError, ExecError) as e:
-                    failures[th.name] = (str(e), bool(getattr(e, "transient", False)))
-                except Exception as e:  # noqa: BLE001 — per-host boundary
-                    failures[th.name] = (f"{type(e).__name__}: {e}", False)
+        # one shared pool per step attempt (see _fanout_pool); harvested in
+        # completion order so a fast-failing host surfaces immediately
+        # instead of waiting behind the slowest host's future
+        pool = self._fanout_pool()
+        # copy_context per host: worker threads inherit CURRENT_TASK so
+        # their log records reach the owning task's log file
+        futs = {pool.submit(contextvars.copy_context().run, traced, th): th
+                for th in targets}
+        for fut in as_completed(futs):
+            th = futs[fut]
+            try:
+                results[th.name] = fut.result()
+            except TransientError as e:
+                failures[th.name] = (str(e), True)
+            except (StepError, ExecError) as e:
+                failures[th.name] = (str(e), bool(getattr(e, "transient", False)))
+            except Exception as e:  # noqa: BLE001 — per-host boundary
+                failures[th.name] = (f"{type(e).__name__}: {e}", False)
         if failures:
             raise HostFailures(targets, failures)
         return results

@@ -29,13 +29,17 @@ def run(ctx: StepContext):
 
     def per(th):
         o = ctx.ops(th)
+        manifests = []
         for name in apps:
             manifest = render_app(name, registry=registry, vars=ctx.vars)
-            if manifest is None:
-                continue
-            path = f"{k8s.MANIFESTS}/app-{name}.yaml"
-            o.ensure_file(path, manifest)
-            o.sh(f"{k8s.KUBECTL} apply -f {path}", timeout=300)
+            if manifest is not None:
+                manifests.append((f"{k8s.MANIFESTS}/app-{name}.yaml", manifest))
+        if not manifests:
+            return
+        # batch: one sha probe for every manifest, one kubectl apply
+        o.ensure_files(manifests)
+        o.sh(f"{k8s.KUBECTL} apply "
+             + " ".join(f"-f {path}" for path, _ in manifests), timeout=600)
 
     ctx.fan_out(per)
     return {"apps": apps}

@@ -28,10 +28,12 @@ def run(ctx: StepContext):
         for b in ("containerd", "runc", "crictl"):
             o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
                                 sha256=k8s.checksum(ctx, b))
-        o.ensure_file("/etc/containerd/config.toml",
-                      CONTAINERD_CONFIG.format(registry=registry, registry_url=registry_url))
-        o.ensure_file("/etc/crictl.yaml",
-                      "runtime-endpoint: unix:///run/containerd/containerd.sock\n")
+        o.ensure_files([
+            ("/etc/containerd/config.toml",
+             CONTAINERD_CONFIG.format(registry=registry, registry_url=registry_url)),
+            ("/etc/crictl.yaml",
+             "runtime-endpoint: unix:///run/containerd/containerd.sock\n"),
+        ])
         o.ensure_service("containerd", k8s.unit(
             "containerd container runtime",
             f"{k8s.BIN}/containerd --config /etc/containerd/config.toml",

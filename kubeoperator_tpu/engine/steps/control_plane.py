@@ -1,59 +1,28 @@
 """Control plane (reference: ``kube-master`` role): apiserver/controller-
-manager/scheduler systemd units, component certs + kubeconfigs, healthz."""
+manager/scheduler systemd units + healthz.
+
+Binaries and the credential bundle (certs, sa keypair, kubeconfigs) are
+NOT converged here: the ``needs: [etcd, master-certs, kube-binaries]``
+edges in the catalog guarantee both warm-path steps finished first, so
+this critical-path step spends its wall-clock only on starting services.
+"""
 
 from __future__ import annotations
-
-import os
-import subprocess
 
 from kubeoperator_tpu.engine.steps import StepContext, StepError
 from kubeoperator_tpu.engine.steps import k8s
 
-SVC_CIDR = "10.68.0.0/16"
-POD_CIDR = "172.20.0.0/16"
-SVC_API_IP = "10.68.0.1"
+SVC_CIDR = k8s.SVC_CIDR
+POD_CIDR = k8s.POD_CIDR
+SVC_API_IP = k8s.SVC_API_IP
 
 
 def run(ctx: StepContext):
-    pki = k8s.pki_for(ctx)
-    masters = ctx.inventory.masters()
-    if not masters:
+    if not ctx.inventory.masters():
         raise StepError("no master nodes in inventory")
-    sans = ["127.0.0.1", SVC_API_IP, "kubernetes", "kubernetes.default",
-            "kubernetes.default.svc", "localhost"] + [th.host.ip for th in masters]
-    if ctx.vars.get("lb_vip"):
-        sans.append(ctx.vars["lb_vip"])
-    pki.ensure_cert("apiserver", "kube-apiserver", sans=sans)
-    pki.ensure_cert("admin", "kubernetes-admin", org="system:masters")
-    pki.ensure_cert("controller-manager", "system:kube-controller-manager")
-    pki.ensure_cert("scheduler", "system:kube-scheduler")
-    # service-account signing keypair
-    if not os.path.exists(pki.path("sa.key")):
-        subprocess.run(["openssl", "genrsa", "-out", pki.path("sa.key"), "2048"],
-                       capture_output=True, check=True)
-        subprocess.run(["openssl", "rsa", "-in", pki.path("sa.key"), "-pubout",
-                        "-out", pki.path("sa.pub")], capture_output=True, check=True)
-
-    server = k8s.apiserver_url(ctx)
-    admin_conf = pki.kubeconfig("admin", server)
-    cm_conf = pki.kubeconfig("controller-manager", server)
-    sched_conf = pki.kubeconfig("scheduler", server)
-    repo = k8s.repo_url(ctx)
 
     def per(th):
         o = ctx.ops(th)
-        for b in ("kube-apiserver", "kube-controller-manager", "kube-scheduler", "kubectl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
-                                sha256=k8s.checksum(ctx, b))
-        for name in ("apiserver", "admin", "controller-manager", "scheduler"):
-            o.ensure_file(f"{k8s.SSL}/{name}.crt", pki.read(f"{name}.crt"))
-            o.ensure_file(f"{k8s.SSL}/{name}.key", pki.read(f"{name}.key"), mode=0o600)
-        o.ensure_file(f"{k8s.SSL}/sa.key", pki.read("sa.key"), mode=0o600)
-        o.ensure_file(f"{k8s.SSL}/sa.pub", pki.read("sa.pub"))
-        o.ensure_file(f"{k8s.KCFG}/admin.conf", admin_conf, mode=0o600)
-        o.ensure_file(f"{k8s.KCFG}/controller-manager.conf", cm_conf, mode=0o600)
-        o.ensure_file(f"{k8s.KCFG}/scheduler.conf", sched_conf, mode=0o600)
-
         apiserver = (
             f"{k8s.BIN}/kube-apiserver"
             f" --advertise-address={th.host.ip}"
@@ -75,31 +44,22 @@ def run(ctx: StepContext):
             f" --kubeconfig={k8s.KCFG}/controller-manager.conf"
             f" --cluster-cidr={POD_CIDR} --service-cluster-ip-range={SVC_CIDR}"
             f" --cluster-signing-cert-file={k8s.SSL}/ca.crt"
-            f" --cluster-signing-key-file={pki_key_path()}"
+            f" --cluster-signing-key-file={k8s.SSL}/ca.key"
             f" --root-ca-file={k8s.SSL}/ca.crt"
             f" --service-account-private-key-file={k8s.SSL}/sa.key"
             f" --use-service-account-credentials=true --leader-elect=true"
         )
         sched = (f"{k8s.BIN}/kube-scheduler --kubeconfig={k8s.KCFG}/scheduler.conf"
                  f" --leader-elect=true")
-        o.ensure_service("kube-apiserver", k8s.unit("Kubernetes API server", apiserver,
-                                                    after="etcd.service"))
-        o.ensure_service("kube-controller-manager",
-                         k8s.unit("Kubernetes controller manager", cm,
-                                  after="kube-apiserver.service"))
-        o.ensure_service("kube-scheduler", k8s.unit("Kubernetes scheduler", sched,
-                                                    after="kube-apiserver.service"))
+        o.ensure_services({
+            "kube-apiserver": k8s.unit("Kubernetes API server", apiserver,
+                                       after="etcd.service"),
+            "kube-controller-manager": k8s.unit("Kubernetes controller manager",
+                                                cm, after="kube-apiserver.service"),
+            "kube-scheduler": k8s.unit("Kubernetes scheduler", sched,
+                                       after="kube-apiserver.service"),
+        })
         o.sh(f"curl -sk --max-time 30 --retry 10 --retry-delay 3 --retry-connrefused "
              f"https://127.0.0.1:6443/healthz", check=True, timeout=120)
 
-    def pki_key_path() -> str:
-        # CA key must be on masters for CSR signing (kubelet serving certs)
-        return f"{k8s.SSL}/ca.key"
-
-    ca_key = pki.read("ca.key")
-
-    def per_with_ca(th):
-        ctx.ops(th).ensure_file(f"{k8s.SSL}/ca.key", ca_key, mode=0o600)
-        per(th)
-
-    ctx.fan_out(per_with_ca)
+    ctx.fan_out(per)

@@ -3,6 +3,8 @@ initial-cluster bootstrap, systemd unit, health check."""
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
+
 from kubeoperator_tpu.engine.steps import StepContext, StepError
 from kubeoperator_tpu.engine.steps import k8s
 
@@ -13,22 +15,24 @@ def run(ctx: StepContext):
     if not members:
         raise StepError("no etcd members in inventory")
     initial = ",".join(f"{th.name}=https://{th.host.ip}:2380" for th in members)
-    pki.ensure_cert("etcd-client", "etcd-client")
+    # usually pre-issued by master-certs on its parallel branch; when not
+    # (standalone flows), issue member + client certs concurrently
+    jobs = [lambda: pki.ensure_cert("etcd-client", "etcd-client")]
+    jobs += [lambda th=th: pki.ensure_cert(
+        f"etcd-{th.name}", th.name, sans=[th.host.ip, "127.0.0.1", th.name])
+        for th in members]
+    with ThreadPoolExecutor(max_workers=len(jobs),
+                            thread_name_prefix="ko-pki") as pool:
+        for f in [pool.submit(j) for j in jobs]:
+            f.result()
     client_crt, client_key = pki.read("etcd-client.crt"), pki.read("etcd-client.key")
 
     def per(th):
         name = f"etcd-{th.name}"
-        pki.ensure_cert(name, th.name, sans=[th.host.ip, "127.0.0.1", th.name])
         o = ctx.ops(th)
-        repo = k8s.repo_url(ctx)
-        for b in ("etcd", "etcdctl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
-                                sha256=k8s.checksum(ctx, b))
-        o.ensure_dir(k8s.ETCD_DATA)
-        o.ensure_file(f"{k8s.SSL}/etcd.crt", pki.read(f"{name}.crt"))
-        o.ensure_file(f"{k8s.SSL}/etcd.key", pki.read(f"{name}.key"), mode=0o600)
-        o.ensure_file(f"{k8s.SSL}/etcd-client.crt", client_crt)
-        o.ensure_file(f"{k8s.SSL}/etcd-client.key", client_key, mode=0o600)
+        # etcd/etcdctl landed via the `needs: [kube-binaries]` edge — no
+        # per-member refetch on the critical path; the data dir is a
+        # systemd StateDirectory, so no mkdir round trip either
         exec_start = (
             f"{k8s.BIN}/etcd --name={th.name} --data-dir={k8s.ETCD_DATA}"
             f" --listen-peer-urls=https://{th.host.ip}:2380"
@@ -41,7 +45,16 @@ def run(ctx: StepContext):
             f" --trusted-ca-file={k8s.SSL}/ca.crt --peer-trusted-ca-file={k8s.SSL}/ca.crt"
             f" --client-cert-auth --peer-client-cert-auth"
         )
-        o.ensure_service("etcd", k8s.unit("etcd key-value store", exec_start))
+        # unit + cert material converge through one batched sha probe; a
+        # changed cert restarts the member
+        o.ensure_services({"etcd": k8s.unit("etcd key-value store", exec_start,
+                                            state_dir="etcd")},
+                          extras={"etcd": [
+                              (f"{k8s.SSL}/etcd.crt", pki.read(f"{name}.crt")),
+                              (f"{k8s.SSL}/etcd.key", pki.read(f"{name}.key"), 0o600),
+                              (f"{k8s.SSL}/etcd-client.crt", client_crt),
+                              (f"{k8s.SSL}/etcd-client.key", client_key, 0o600),
+                          ]})
         o.sh(f"{k8s.BIN}/etcdctl {k8s.etcd_flags(ctx)} endpoint health", check=True, timeout=60)
 
     ctx.fan_out(per)

@@ -1,0 +1,50 @@
+"""Warm-path binary pre-distribution (``kube-binaries`` step).
+
+Fetches each node's role-appropriate kube/etcd binaries from the offline
+package repo on a DAG branch parallel to ``container-runtime``/
+``load-images`` (ISSUE 4). ``etcd`` and ``control-plane`` rely on their
+``needs: [kube-binaries]`` edge and do not refetch; ``worker`` (shared
+with the scale flows' ``join-worker``, which has no such edge) keeps its
+``ensure_binary`` calls, which converge here-warmed hosts with one sha
+probe each. Downloads within a host run concurrently — each is an
+independent HTTP fetch, and the SSH transport multiplexes the extra
+sessions over one ControlMaster connection.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+from kubeoperator_tpu.engine.steps import StepContext
+from kubeoperator_tpu.engine.steps import k8s
+
+
+def binaries_for(roles: list[str]) -> list[str]:
+    wanted = ["kubectl"]
+    if "etcd" in roles:
+        wanted += ["etcd", "etcdctl"]
+    if "master" in roles:
+        wanted += ["kube-apiserver", "kube-controller-manager", "kube-scheduler"]
+    if "worker" in roles:
+        wanted += ["kubelet", "kube-proxy"]
+    return wanted
+
+
+def run(ctx: StepContext):
+    repo = k8s.repo_url(ctx)
+
+    def per(th):
+        o = ctx.ops(th)
+        wanted = binaries_for(th.roles)
+
+        def fetch(b):
+            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
+                            sha256=k8s.checksum(ctx, b))
+
+        with ThreadPoolExecutor(max_workers=len(wanted),
+                                thread_name_prefix="ko-fetch") as pool:
+            list(pool.map(fetch, wanted))
+        return {"binaries": wanted}
+
+    results = ctx.fan_out(per)
+    return {"hosts": sorted(results)}

@@ -17,6 +17,9 @@ from kubeoperator_tpu.engine.pki import ClusterPKI
 BIN = "/opt/kube/bin"
 SSL = "/etc/kubernetes/ssl"
 KCFG = "/etc/kubernetes"
+SVC_CIDR = "10.68.0.0/16"
+POD_CIDR = "172.20.0.0/16"
+SVC_API_IP = "10.68.0.1"
 MANIFESTS = "/etc/kubernetes/addons"
 ETCD_DATA = "/var/lib/etcd"
 KUBECTL = f"{BIN}/kubectl --kubeconfig={KCFG}/admin.conf"
@@ -83,14 +86,17 @@ def etcd_flags(ctx) -> str:
 
 
 def unit(description: str, exec_start: str, after: str = "network.target",
-         env_file: str | None = None) -> str:
+         env_file: str | None = None, state_dir: str | None = None) -> str:
     env = f"EnvironmentFile=-{env_file}\n" if env_file else ""
+    # StateDirectory: systemd owns the data dir (creation + perms) — no
+    # separate mkdir round trip per host
+    state = f"StateDirectory={state_dir}\n" if state_dir else ""
     return f"""[Unit]
 Description={description}
 After={after}
 
 [Service]
-{env}ExecStart={exec_start}
+{env}{state}ExecStart={exec_start}
 Restart=always
 RestartSec=5
 LimitNOFILE=65536

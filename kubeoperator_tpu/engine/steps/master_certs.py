@@ -1,0 +1,100 @@
+"""Pre-issue and distribute the control-plane credential bundle.
+
+Runs on a DAG branch parallel to the runtime/image pulls (ISSUE 4): certs,
+the service-account keypair and the component kubeconfigs land on the
+masters *before* ``control-plane`` starts. ``control-plane`` relies on its
+``needs: [master-certs]`` edge rather than re-converging the bundle, so
+the critical-path step spends its wall-clock only on starting services —
+this module is the single author of the credential files.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from concurrent.futures import ThreadPoolExecutor
+
+from kubeoperator_tpu.engine.steps import StepContext, StepError
+from kubeoperator_tpu.engine.steps import k8s
+
+# the files place() writes under /etc/kubernetes/ssl per component
+CERT_NAMES = ("apiserver", "admin", "controller-manager", "scheduler")
+
+
+def issue(ctx: StepContext, pki) -> dict[str, str]:
+    """Issue (idempotently) every control-plane cert plus the sa keypair;
+    return the rendered component kubeconfigs keyed by component."""
+    masters = ctx.inventory.masters()
+    if not masters:
+        raise StepError("no master nodes in inventory")
+    sans = ["127.0.0.1", k8s.SVC_API_IP, "kubernetes", "kubernetes.default",
+            "kubernetes.default.svc", "localhost"] + [th.host.ip for th in masters]
+    if ctx.vars.get("lb_vip"):
+        sans.append(ctx.vars["lb_vip"])
+    def sa_keypair():
+        # service-account signing keypair
+        if not os.path.exists(pki.path("sa.key")):
+            subprocess.run(["openssl", "genrsa", "-out", pki.path("sa.key"), "2048"],
+                           capture_output=True, check=True)
+            subprocess.run(["openssl", "rsa", "-in", pki.path("sa.key"), "-pubout",
+                            "-out", pki.path("sa.pub")], capture_output=True, check=True)
+
+    # keygen dominates issuance and each openssl call is its own process,
+    # so issue the bundle concurrently (the PKI serializes only CA serial
+    # allocation); CA first so the workers don't all queue on its lock.
+    # etcd's member/client certs lead the list: the etcd step is the next
+    # critical-path consumer and blocks on their per-name locks, while
+    # node credentials are deliberately NOT pre-issued here — the worker
+    # step issues them on its own off-path branch, keeping this burst of
+    # CPU-bound openssl work short while etcd/control-plane wait on it.
+    pki.ensure_ca()
+    issuers = []
+    for th in ctx.inventory.targets("etcd"):
+        issuers.append(lambda th=th: pki.ensure_cert(
+            f"etcd-{th.name}", th.name, sans=[th.host.ip, "127.0.0.1", th.name]))
+    issuers += [
+        lambda: pki.ensure_cert("etcd-client", "etcd-client"),
+        lambda: pki.ensure_cert("apiserver", "kube-apiserver", sans=sans),
+        lambda: pki.ensure_cert("admin", "kubernetes-admin", org="system:masters"),
+        lambda: pki.ensure_cert("controller-manager",
+                                "system:kube-controller-manager"),
+        lambda: pki.ensure_cert("scheduler", "system:kube-scheduler"),
+        sa_keypair,
+    ]
+    with ThreadPoolExecutor(max_workers=len(issuers),
+                            thread_name_prefix="ko-pki") as pool:
+        for f in [pool.submit(j) for j in issuers]:
+            f.result()
+    server = k8s.apiserver_url(ctx)
+    return {"admin": pki.kubeconfig("admin", server),
+            "controller-manager": pki.kubeconfig("controller-manager", server),
+            "scheduler": pki.kubeconfig("scheduler", server)}
+
+
+def place(o, pki, confs: dict[str, str]) -> None:
+    """Converge one master's on-disk credential bundle (certs, keys, sa
+    keypair, CA key for CSR signing, component kubeconfigs) — a single
+    batched sha probe plus writes for whatever differs."""
+    files = [(f"{k8s.SSL}/ca.key", pki.read("ca.key"), 0o600)]
+    for name in CERT_NAMES:
+        files.append((f"{k8s.SSL}/{name}.crt", pki.read(f"{name}.crt"), 0o644))
+        files.append((f"{k8s.SSL}/{name}.key", pki.read(f"{name}.key"), 0o600))
+    files += [
+        (f"{k8s.SSL}/sa.key", pki.read("sa.key"), 0o600),
+        (f"{k8s.SSL}/sa.pub", pki.read("sa.pub"), 0o644),
+        (f"{k8s.KCFG}/admin.conf", confs["admin"], 0o600),
+        (f"{k8s.KCFG}/controller-manager.conf", confs["controller-manager"], 0o600),
+        (f"{k8s.KCFG}/scheduler.conf", confs["scheduler"], 0o600),
+    ]
+    o.ensure_files(files)
+
+
+def run(ctx: StepContext):
+    pki = k8s.pki_for(ctx)
+    confs = issue(ctx, pki)
+
+    def per(th):
+        place(ctx.ops(th), pki, confs)
+
+    results = ctx.fan_out(per)
+    return {"masters": sorted(results)}

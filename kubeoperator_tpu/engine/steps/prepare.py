@@ -4,8 +4,15 @@ distribution."""
 
 from __future__ import annotations
 
+import shlex
+
 from kubeoperator_tpu.engine.steps import StepContext
 from kubeoperator_tpu.engine.steps import k8s
+
+
+SYSCTLS = ("net.ipv4.ip_forward = 1\n"
+           "net.bridge.bridge-nf-call-iptables = 1\n"
+           "fs.inotify.max_user_watches = 524288\n")
 
 
 def run(ctx: StepContext):
@@ -16,21 +23,29 @@ def run(ctx: StepContext):
 
     def per(th):
         o = ctx.ops(th)
-        o.sh(f"hostnamectl set-hostname {th.name}", check=False)
-        o.ensure_dir(k8s.BIN)
-        o.ensure_dir(k8s.SSL)
-        o.ensure_dir(k8s.MANIFESTS)
-        o.sh("swapoff -a", check=False)
-        o.sh("sed -i '/ swap / s/^/#/' /etc/fstab", check=False)
-        o.sh("modprobe br_netfilter", check=False)
-        o.ensure_sysctl("net.ipv4.ip_forward", "1")
-        o.ensure_sysctl("net.bridge.bridge-nf-call-iptables", "1")
-        o.ensure_sysctl("fs.inotify.max_user_watches", "524288")
-        o.sh("systemctl stop firewalld 2>/dev/null; systemctl disable firewalld 2>/dev/null",
+        # one round trip for the whole imperative base-state block — every
+        # command in it is idempotent and order-independent. The sysctl
+        # conf is tiny and static, so it is rewritten inline (ansible
+        # sysctl-module style) rather than spending a probe round trip,
+        # and the /etc/hosts + profile appends chain on the same exec.
+        appends = [("/etc/hosts", line) for line in host_lines]
+        appends.append(("/etc/profile.d/kubeoperator.sh",
+                        f"export PATH=$PATH:{k8s.BIN}"))
+        append_sh = "; ".join(
+            f"grep -qxF {shlex.quote(line)} {path} 2>/dev/null"
+            f" || echo {shlex.quote(line)} >> {path}"
+            for path, line in appends)
+        o.sh(f"hostnamectl set-hostname {th.name}; "
+             f"mkdir -p {k8s.BIN} {k8s.SSL} {k8s.MANIFESTS}; "
+             "swapoff -a; sed -i '/ swap / s/^/#/' /etc/fstab; "
+             "modprobe br_netfilter; "
+             "systemctl stop firewalld 2>/dev/null; "
+             "systemctl disable firewalld 2>/dev/null; "
+             f"printf '%s' {shlex.quote(SYSCTLS)}"
+             " > /etc/sysctl.d/95-kubeoperator.conf; "
+             "sysctl --system >/dev/null; "
+             + append_sh,
              check=False)
-        for line in host_lines:
-            o.ensure_line("/etc/hosts", line)
         o.ensure_file(f"{k8s.SSL}/ca.crt", ca)
-        o.ensure_line("/etc/profile.d/kubeoperator.sh", f"export PATH=$PATH:{k8s.BIN}")
 
     ctx.fan_out(per)

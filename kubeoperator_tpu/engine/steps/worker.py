@@ -28,14 +28,13 @@ def run(ctx: StepContext):
 
     def per(th):
         o = ctx.ops(th)
-        for b in ("kubelet", "kube-proxy", "kubectl"):
-            o.ensure_binary(b, f"{repo}/{b}", dest_dir=k8s.BIN,
-                                sha256=k8s.checksum(ctx, b))
+        # warm fallback for flows without a kube-binaries step (join-worker):
+        # one chained guard round trip, a no-op on pre-distributed hosts
+        o.ensure_binaries([(b, f"{repo}/{b}", k8s.checksum(ctx, b))
+                           for b in ("kubelet", "kube-proxy", "kubectl")],
+                          dest_dir=k8s.BIN)
         user = f"node-{th.name}"
         pki.ensure_cert(user, f"system:node:{th.name}", org="system:nodes")
-        o.ensure_file(f"{k8s.KCFG}/kubelet.conf", pki.kubeconfig(user, server), mode=0o600)
-        o.ensure_file(f"{k8s.KCFG}/kube-proxy.conf", proxy_conf, mode=0o600)
-        o.ensure_file(f"{k8s.KCFG}/kubelet-config.yaml", KUBELET_CONFIG.format(ssl=k8s.SSL))
         kubelet = (
             f"{k8s.BIN}/kubelet --kubeconfig={k8s.KCFG}/kubelet.conf"
             f" --config={k8s.KCFG}/kubelet-config.yaml"
@@ -43,8 +42,21 @@ def run(ctx: StepContext):
         )
         proxy = (f"{k8s.BIN}/kube-proxy --kubeconfig={k8s.KCFG}/kube-proxy.conf"
                  f" --hostname-override={th.name}")
-        o.ensure_service("kubelet", k8s.unit("Kubernetes kubelet", kubelet,
-                                             after="containerd.service"))
-        o.ensure_service("kube-proxy", k8s.unit("Kubernetes kube-proxy", proxy))
+        # kubeconfigs/config ride the same batched probe as the unit files;
+        # a changed credential or config restarts the owning service
+        o.ensure_services(
+            {"kubelet": k8s.unit("Kubernetes kubelet", kubelet,
+                                 after="containerd.service"),
+             "kube-proxy": k8s.unit("Kubernetes kube-proxy", proxy)},
+            extras={
+                "kubelet": [
+                    (f"{k8s.KCFG}/kubelet.conf", pki.kubeconfig(user, server), 0o600),
+                    (f"{k8s.KCFG}/kubelet-config.yaml",
+                     KUBELET_CONFIG.format(ssl=k8s.SSL)),
+                ],
+                "kube-proxy": [
+                    (f"{k8s.KCFG}/kube-proxy.conf", proxy_conf, 0o600),
+                ],
+            })
 
     ctx.fan_out(per)

@@ -193,6 +193,7 @@ class ExecutionStep:
     finished_at: str = ""
     retries: int = 0          # transient-failure retries the driver spent
     backoff_s: float = 0.0    # total backoff slept between the attempts
+    queue_wait_s: float = 0.0  # DAG scheduler: ready -> actually started
 
 
 @dataclass

@@ -66,7 +66,15 @@ class Platform:
                 rate, pattern = spec.split(":", 1)
                 self.executor.flake(pattern, float(rate))
         else:
-            self.executor = SSHExecutor(connect_timeout=self.config.ssh_connect_timeout)
+            import os as _os
+            self.executor = SSHExecutor(
+                connect_timeout=self.config.ssh_connect_timeout,
+                multiplex=bool(self.config.get("ssh_multiplex", True)),
+                # per-host ControlMaster sockets live under the run dir so
+                # `ko` restarts don't strand them in random tmpdirs
+                control_dir=_os.path.join(self.config.data_dir, "ssh-cm"),
+                control_persist=str(self.config.get("ssh_control_persist", "60s")),
+            )
         # every transport goes through the telemetry shim: exec spans under
         # the active host span + ko_exec_* metrics; transport-specific API
         # (FakeExecutor.host/fail_on, chaos fault programming) delegates

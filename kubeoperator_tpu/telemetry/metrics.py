@@ -265,6 +265,10 @@ STEP_DURATION = REGISTRY.histogram(
     "ko_step_duration_seconds",
     "Wall-clock duration of one engine step (includes retries and backoff).",
     labels=("operation", "step"))
+QUEUE_WAIT = REGISTRY.histogram(
+    "ko_step_queue_wait_seconds",
+    "Time a DAG-ready step waited for a free scheduler slot before starting.",
+    labels=("operation", "step"))
 STEP_RETRIES = REGISTRY.counter(
     "ko_step_retries_total",
     "Step re-runs after a transient failure (driver-level retry).",

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""DAG scheduler microbench: sequential vs parallel simulated install.
+
+Runs the full install operation twice against a FakeExecutor wrapped in
+ChaosExecutor latency injection (every exec costs ``--latency`` seconds,
+the cost model for an SSH round trip) — once with ``step_forks=1``
+(the pre-DAG sequential walk) and once with ``--forks`` — and prints the
+wall-clock ratio. The tier-1 microbench in ``tests/test_scheduler.py``
+enforces >=1.8x on the same shape; this script is for poking at the
+schedule interactively (more hosts, higher latency, different fork caps).
+
+Usage:
+    python scripts/bench_scheduler.py [--forks 4] [--latency 0.05]
+                                      [--workers 2] [--timeline]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubeoperator_tpu.config.loader import load_config              # noqa: E402
+from kubeoperator_tpu.engine.executor import ChaosExecutor, FakeExecutor  # noqa: E402
+from kubeoperator_tpu.resources.entities import ExecutionState      # noqa: E402
+from kubeoperator_tpu.resources.store import Store                  # noqa: E402
+from kubeoperator_tpu.services.platform import Platform             # noqa: E402
+from kubeoperator_tpu.telemetry.tracing import TraceRecord          # noqa: E402
+
+FACTS = {"cpu_core": 8, "memory_mb": 16384, "os": "Ubuntu", "os_version": "22.04"}
+
+
+def build_platform(tmp: str, tag: str, step_forks: int, latency: float,
+                   workers: int) -> Platform:
+    chaos = ChaosExecutor(FakeExecutor(), seed=7, latency_s=latency)
+    cfg = load_config(overrides={
+        "data_dir": os.path.join(tmp, f"data-{tag}"),
+        "executor": "fake",
+        "terraform_bin": "",
+        "task_workers": 2,
+        "node_forks": 16,
+        "step_forks": step_forks,
+        "repo_host": "127.0.0.1",
+        # fast-retry overrides: the bench measures scheduling, not backoff
+        "step_backoff_s": 0.001,
+        "step_backoff_max_s": 0.002,
+        "exec_backoff_s": 0.0,
+    })
+    p = Platform(config=cfg, store=Store(), executor=chaos)
+    cred = p.create_credential("bench-key", private_key="FAKE KEY")
+    nodes = []
+    for i in range(workers + 1):
+        ip = f"10.9.0.{i + 1}"
+        chaos.inner.host(ip).facts.update(FACTS)
+        role = "master" if i == 0 else "worker"
+        h = p.register_host(f"bench-{role}-{i}", ip, cred.id)
+        nodes.append((h, [role]))
+    cluster = p.create_cluster("bench", template="SINGLE",
+                               configs={"registry": "reg.local:8082"})
+    for h, roles in nodes:
+        p.add_node(cluster, h, roles)
+    return p
+
+
+def run_install(p: Platform, timeline: bool) -> float:
+    t0 = time.perf_counter()
+    ex = p.run_operation("bench", "install")
+    wall = time.perf_counter() - t0
+    if ex.state != ExecutionState.SUCCESS:
+        raise SystemExit(f"install failed: {ex.result}")
+    if timeline:
+        rec = p.store.get_by_name(TraceRecord, ex.id, scoped=False)
+        steps = sorted((s for s in rec.spans if s["kind"] == "step"),
+                       key=lambda s: s["start_offset_s"])
+        for s in steps:
+            a, d = s["start_offset_s"], s["duration_s"]
+            bar = " " * int(a * 40) + "#" * max(1, int(d * 40))
+            print(f"  {a:6.3f} +{d:5.3f}  {s['name']:28s} {bar}")
+    return wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--forks", type=int, default=4,
+                    help="step_forks for the DAG run (default 4)")
+    ap.add_argument("--latency", type=float, default=0.05,
+                    help="injected per-exec latency in seconds (default 0.05)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="worker node count (default 2; +1 master)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="print the per-step span timeline of both runs")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="ko-bench-") as tmp:
+        seq = build_platform(tmp, "seq", 1, args.latency, args.workers)
+        try:
+            print(f"== sequential walk (step_forks=1, latency {args.latency}s)")
+            seq_s = run_install(seq, args.timeline)
+        finally:
+            seq.shutdown()
+
+        par = build_platform(tmp, "par", args.forks, args.latency, args.workers)
+        try:
+            print(f"== DAG walk (step_forks={args.forks})")
+            par_s = run_install(par, args.timeline)
+        finally:
+            par.shutdown()
+
+    print(json.dumps({"sequential_s": round(seq_s, 3),
+                      "dag_s": round(par_s, 3),
+                      "step_forks": args.forks,
+                      "latency_s": args.latency,
+                      "speedup": round(seq_s / par_s, 2)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -261,7 +261,7 @@ def test_step_retry_records_count_and_backoff(chaos_platform, chaos_executor):
     _manual_cluster(chaos_platform, chaos_executor)
     # exec_retry=0 forces the flake to escalate to the step driver
     chaos_platform.config["exec_retry"] = 0
-    chaos_executor.fail_next(1, pattern="mkdir")    # prepare, attempt 1 only
+    chaos_executor.fail_next(1, pattern="sha256sum")  # prepare's ca.crt probe, attempt 1 only
     ex = chaos_platform.run_operation("ft", "install")
     assert ex.state == ExecutionState.SUCCESS, ex.result
     steps = {s["name"]: s for s in ex.steps}
@@ -427,6 +427,65 @@ def test_resume_mid_way_progress_counts_skipped(platform, fake_executor,
     assert skipped == len(retry.steps) - 1
     # all steps are terminal (skipped prefix + the one error) -> progress 1.0
     assert retry.progress == 1.0
+
+
+# ---------------------------------------------------------------------------
+# chaos under DAG parallelism (ISSUE 4 satellite): faults on one branch must
+# not leak into concurrently-running independent branches
+# ---------------------------------------------------------------------------
+
+def test_mid_dag_host_death_quarantines_without_aborting_branches(
+        chaos_platform, chaos_executor):
+    """A worker that dies mid-install (after some commands already landed)
+    is quarantined by whichever step first observes the dead transport;
+    the install still converges and the independent branches — running
+    concurrently on other scheduler slots — are untouched."""
+    _manual_cluster(chaos_platform, chaos_executor)
+    chaos_platform.config["exec_retry"] = 1
+    # ft-worker-1 answers its first few commands, then drops off the
+    # network mid-DAG (rc 255, transient -> quarantinable, not fatal)
+    chaos_executor.kill_after("10.3.0.2", 5)
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert list(ex.result["quarantined"]) == ["ft-worker-1"]
+    statuses = {s["name"]: s["status"] for s in ex.steps}
+    # no step was aborted: everything ran, nothing left PENDING/cancelled
+    assert all(st in (StepState.SUCCESS, StepState.SKIPPED)
+               for st in statuses.values()), statuses
+    # the healthy worker branch converged fully while the dead host was
+    # being retried/quarantined on another slot
+    assert chaos_executor.inner.host("10.3.0.3").services["kubelet"] == "started"
+    # master-side branches (network/storage run off control-plane) landed too
+    assert chaos_executor.inner.ran("10.3.0.1", r"apply -f .*network-calico")
+    assert chaos_executor.inner.ran("10.3.0.1", r"apply -f .*storage-local-volume")
+
+
+def test_permanent_branch_failure_cancels_only_dependents(
+        chaos_platform, chaos_executor):
+    """Deterministic cancel-on-failure: a permanent error on the etcd
+    branch fails the execution, leaves every transitive dependent of etcd
+    un-started (PENDING), and still drains the independent branches to
+    SUCCESS. The outcome depends only on the DAG shape, never on timing."""
+    _manual_cluster(chaos_platform, chaos_executor)
+    # rc-1 permanent failure on the master's etcd health probe: critical
+    # host, not quarantinable, no retry
+    chaos_executor.inner.fail_on("10.3.0.1", r"endpoint health")
+    ex = chaos_platform.run_operation("ft", "install")
+    assert ex.state == ExecutionState.FAILURE
+    assert "etcd" in ex.result["error"]
+    statuses = {s["name"]: s["status"] for s in ex.steps}
+    assert statuses["etcd"] == StepState.ERROR
+    # every transitive dependent of etcd was cancelled before starting
+    for name in ("control-plane", "network", "storage",
+                 "accelerator-plugin", "addons", "post-check"):
+        assert statuses[name] == StepState.PENDING, (name, statuses[name])
+    # branches independent of etcd drained to completion — including
+    # `worker`, which converges from pre-issued credentials and doesn't
+    # wait on the control plane
+    for name in ("prepare", "container-runtime", "load-images",
+                 "kube-binaries", "master-certs", "accelerator-stack",
+                 "worker"):
+        assert statuses[name] == StepState.SUCCESS, (name, statuses[name])
 
 
 # ---------------------------------------------------------------------------

@@ -56,7 +56,13 @@ def test_install_failure_marks_cluster_error(platform, fake_executor, manual_clu
     assert cluster.status == ClusterStatus.ERROR
     statuses = {s["name"]: s["status"] for s in execution.steps}
     assert statuses["worker"] == StepState.ERROR
-    assert statuses["network"] == StepState.PENDING   # stopped at failure
+    # DAG fail-fast: transitive dependents of the failed step never ran...
+    assert statuses["accelerator-plugin"] == StepState.PENDING
+    assert statuses["addons"] == StepState.PENDING
+    assert statuses["post-check"] == StepState.PENDING
+    # ...while the independent network branch (needs only control-plane)
+    # drained to completion
+    assert statuses["network"] == StepState.SUCCESS
 
 
 def test_install_is_idempotent(platform, fake_executor, manual_cluster):

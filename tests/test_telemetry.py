@@ -161,13 +161,22 @@ def test_install_persists_span_tree(platform, manual_cluster):
     assert all(by_id[s["parent_id"]]["kind"] == "step" for s in hosts)
     for s in execs:
         assert by_id[s["parent_id"]]["kind"] in ("host", "step")
-    # every executed step of the execution has a span, same order
+    # every executed step of the execution has a span (completion order is
+    # nondeterministic under the DAG scheduler, so compare as sets)
     executed = [s["name"] for s in ex.steps
                 if s["status"] == StepState.SUCCESS]
-    assert [s["name"] for s in steps] == [f"step:{n}" for n in executed]
-    # steps run sequentially under the root: the root's duration bounds
-    # the critical path (the acceptance inequality)
-    assert root["duration_s"] >= sum(s["duration_s"] for s in steps) - 1e-6
+    assert {s["name"] for s in steps} == {f"step:{n}" for n in executed}
+    # the scheduler span records the walk itself as a sibling of the steps
+    sched = [s for s in spans if s["kind"] == "scheduler"]
+    assert len(sched) == 1 and sched[0]["parent_id"] == root["span_id"]
+    assert sched[0]["attributes"]["failed"] == 0
+    # every step span carries its measured scheduler queue wait, and the
+    # execution record mirrors it per step
+    assert all(s["attributes"]["queue_wait_s"] >= 0 for s in steps)
+    assert all(s["queue_wait_s"] >= 0 for s in ex.steps)
+    # steps may overlap now: the root bounds the critical path (each step
+    # nests inside the operation), not the serial sum
+    assert all(root["duration_s"] >= s["duration_s"] - 1e-6 for s in steps)
     assert all(s["duration_s"] >= 0 for s in spans)
     assert rec.dropped == 0
 
@@ -243,7 +252,10 @@ def test_chaos_injection_records_counter_and_span_event(tmp_path):
         before_reset = tm.CHAOS_INJECTIONS.value(kind="reset")
         before_retry = tm.STEP_RETRIES.value(operation="install",
                                              step="prepare")
-        chaos.fail_next(1, pattern="mkdir")
+        # prepare's ca.crt sha probe escalates a transient to the step
+        # driver (the imperative mkdir block is check=False and would
+        # swallow the reset)
+        chaos.fail_next(1, pattern="sha256sum")
         ex = p.run_operation("ct", "install")
         assert ex.state == ExecutionState.SUCCESS, ex.result
         assert tm.CHAOS_INJECTIONS.value(kind="reset") == before_reset + 1
