@@ -46,6 +46,9 @@ QUERIED_METRICS = {
     "ko_serve_queue_depth": "jax-serve",            # batcher, :8080/metrics
     "ko_serve_request_latency_seconds": "jax-serve",
     "ko_serve_tokens_generated_total": "jax-serve",
+    # continuous engine (round 6): pool utilization + first-token latency
+    "ko_serve_slot_occupancy": "jax-serve",
+    "ko_serve_ttft_seconds_bucket": "jax-serve",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -61,6 +64,11 @@ PROMQL = {
     "serve_latency_p95":
         'avg(ko_serve_request_latency_seconds{quantile="0.95"})',
     "serve_tokens_rate": "sum(rate(ko_serve_tokens_generated_total[5m]))",
+    # continuous engine (round 6)
+    "serve_slot_occupancy": "avg(ko_serve_slot_occupancy)",
+    "serve_ttft_p95":
+        "histogram_quantile(0.95, "
+        "sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))",
 }
 
 
@@ -258,6 +266,9 @@ class ClusterMonitor:
         serve_queue = prom.scalar(PROMQL["serve_queue_depth"], default=-1.0)
         serve_p95 = prom.scalar(PROMQL["serve_latency_p95"], default=-1.0)
         serve_rate = prom.scalar(PROMQL["serve_tokens_rate"], default=-1.0)
+        serve_slots = prom.scalar(PROMQL["serve_slot_occupancy"],
+                                  default=-1.0)
+        serve_ttft = prom.scalar(PROMQL["serve_ttft_p95"], default=-1.0)
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -274,6 +285,8 @@ class ClusterMonitor:
             "serve_queue_depth": serve_queue,
             "serve_latency_p95": serve_p95,
             "serve_tokens_rate": serve_rate,
+            "serve_slot_occupancy": serve_slots,
+            "serve_ttft_p95": serve_ttft,
             "time": iso_now(),
         }
         self._save_snapshot(data)
@@ -307,6 +320,8 @@ class ClusterMonitor:
                        "serve_queue_depth": data["serve_queue_depth"],
                        "serve_latency_p95": data["serve_latency_p95"],
                        "serve_tokens_rate": data["serve_tokens_rate"],
+                       "serve_slot_occupancy": data["serve_slot_occupancy"],
+                       "serve_ttft_p95": data["serve_ttft_p95"],
                        "pod_count": data["pod_count"]})
         hist.data = {"points": points[-self.HISTORY_POINTS:]}
         hist.created_at = iso_now()

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import math
 import threading
+from bisect import bisect_left, insort
 from typing import Iterable
 
 # Latency buckets spanning a fake-executor exec (~µs) to a full real-SSH
@@ -190,6 +191,71 @@ class Histogram(Metric):
         return lines
 
 
+class Summary(Metric):
+    """Quantile-labelled summary over a bounded sliding reservoir — the
+    Prometheus summary type (``name{quantile="0.5"}`` series plus
+    ``_sum``/``_count``). Quantiles are computed over the last ``window``
+    observations, so they track current load rather than process history
+    (the serving batcher's p50/p95 semantics)."""
+
+    type = "summary"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = (),
+                 quantiles: tuple[float, ...] = (0.5, 0.95),
+                 window: int = 512):
+        super().__init__(name, help, labels)
+        self.quantiles = tuple(quantiles)
+        self.window = int(window)
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            slot = self._samples.get(key)
+            if slot is None:
+                slot = {"sorted": [], "order": [], "sum": 0.0, "count": 0}
+                self._samples[key] = slot
+            v = float(value)
+            insort(slot["sorted"], v)
+            slot["order"].append(v)
+            if len(slot["order"]) > self.window:
+                old = slot["order"].pop(0)
+                del slot["sorted"][bisect_left(slot["sorted"], old)]
+            slot["sum"] += v
+            slot["count"] += 1
+
+    def quantile(self, q: float, **labels: object) -> float:
+        with self._lock:
+            slot = self._samples.get(self._key(labels))
+            if not slot or not slot["sorted"]:
+                return 0.0
+            i = min(len(slot["sorted"]) - 1, int(q * len(slot["sorted"])))
+            return slot["sorted"][i]
+
+    def count(self, **labels: object) -> int:
+        with self._lock:
+            slot = self._samples.get(self._key(labels))
+            return slot["count"] if slot else 0
+
+    def render(self) -> list[str]:
+        lines: list[str] = []
+        with self._lock:
+            for key, slot in sorted(self._samples.items()):
+                for q in self.quantiles:
+                    data = slot["sorted"]
+                    v = (data[min(len(data) - 1, int(q * len(data)))]
+                         if data else 0.0)
+                    qs = (("quantile", _format_value(q)),)
+                    lines.append(f"{self.name}"
+                                 f"{_labels_suffix(self.labels, key, qs)} "
+                                 f"{_format_value(v)}")
+                lines.append(f"{self.name}_sum{_labels_suffix(self.labels, key)} "
+                             f"{_format_value(slot['sum'])}")
+                lines.append(f"{self.name}_count"
+                             f"{_labels_suffix(self.labels, key)} "
+                             f"{slot['count']}")
+        return lines
+
+
 class Registry:
     """Holds metric families in registration order. Re-declaring a name
     with the same type and labels returns the existing family (module
@@ -225,6 +291,12 @@ class Registry:
     def histogram(self, name: str, help: str, labels: tuple[str, ...] = (),
                   buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._register(Histogram, name, help, labels, buckets=buckets)
+
+    def summary(self, name: str, help: str, labels: tuple[str, ...] = (),
+                quantiles: tuple[float, ...] = (0.5, 0.95),
+                window: int = 512) -> Summary:
+        return self._register(Summary, name, help, labels,
+                              quantiles=quantiles, window=window)
 
     def names(self) -> list[str]:
         with self._lock:
@@ -300,3 +372,65 @@ CHAOS_INJECTIONS = REGISTRY.counter(
     "ko_chaos_injections_total",
     "Faults injected by the chaos harness, by kind.",
     labels=("kind",))
+
+# -- serving-plane families (workloads/serving.BatcherStats) ----------------
+# Fused-batch sizes and continuous-engine slot counts; power-of-two edges
+# matching the batcher's bucketing rule.
+SERVE_BATCH_BUCKETS: tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64)
+# One decode segment is tens of ms on-chip but ~100ms+ through the relay;
+# start finer than DEFAULT_BUCKETS' 5ms floor.
+SERVE_SEGMENT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
+    """Declare the ``ko_serve_*`` vocabulary on ``registry`` and return the
+    families keyed by short name. Each BatcherStats instance owns a private
+    Registry by default (independent batchers must not share counters);
+    the serve job passes the global REGISTRY so one ``/metrics`` scrape
+    covers the whole process. Declared on the global REGISTRY at import so
+    the README drift lint sees the full vocabulary deterministically."""
+    return {
+        "requests": registry.counter(
+            "ko_serve_requests_total",
+            "Generation requests finished, ok or error."),
+        "errors": registry.counter(
+            "ko_serve_errors_total",
+            "Generation requests that finished with an error."),
+        "batches": registry.counter(
+            "ko_serve_batches_total",
+            "Device dispatches: fused batches (dynamic) or decode "
+            "segments (continuous)."),
+        "tokens": registry.counter(
+            "ko_serve_tokens_generated_total",
+            "New tokens delivered to finished requests."),
+        "queue_depth": registry.gauge(
+            "ko_serve_queue_depth",
+            "Requests submitted but not yet finished (queued or in "
+            "flight)."),
+        "latency": registry.summary(
+            "ko_serve_request_latency_seconds",
+            "End-to-end request latency, submit to tokens (sliding "
+            "window).",
+            window=window),
+        "batch_size": registry.histogram(
+            "ko_serve_batch_size",
+            "Rows per device dispatch (dynamic: fused batch; continuous: "
+            "active slots per segment).",
+            buckets=SERVE_BATCH_BUCKETS),
+        "slot_occupancy": registry.gauge(
+            "ko_serve_slot_occupancy",
+            "Occupied decode slots in the continuous engine's pool."),
+        "ttft": registry.histogram(
+            "ko_serve_ttft_seconds",
+            "Time from submit to a request's first generated token "
+            "(continuous engine)."),
+        "segment": registry.histogram(
+            "ko_serve_segment_duration_seconds",
+            "Wall time of one decode-segment dispatch (continuous "
+            "engine).",
+            buckets=SERVE_SEGMENT_BUCKETS),
+    }
+
+
+declare_serve_metrics(REGISTRY)

@@ -338,46 +338,68 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
     tpu_lock = threading.Lock()   # one generation at a time on the chip
 
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
     from kubeoperator_tpu.workloads.serving import (
-        DynamicBatcher, _pow2_at_least, plan_bucket,
+        BatcherStats, ContinuousBatcher, DynamicBatcher, _pow2_at_least,
+        plan_bucket,
     )
 
-    def run_batch(prompts, lens, max_new, temp, prefill, seed):
-        b = _pow2_at_least(len(prompts))
-        # pad the batch dim to its bucket with duplicate rows (cheap; the
-        # batcher never reads them)
-        rows = prompts + [prompts[0]] * (b - len(prompts))
-        row_lens = lens + [lens[0]] * (b - len(lens))
-        with tpu_lock:
-            return decode_fn(b, len(prompts[0]), max_new, temp, prefill)(
-                model_params, jnp.asarray(rows, jnp.int32),
-                jnp.asarray(row_lens, jnp.int32), jax.random.key(seed))
+    # both engines report into the process-global registry: one /metrics
+    # scrape covers the serve plane and any control-plane families
+    stats = BatcherStats(registry=REGISTRY)
 
-    batcher = DynamicBatcher(run_batch, max_batch=args.max_batch,
-                             window_ms=args.batch_window_ms,
-                             max_seq_len=cfg.max_seq_len)
-    decode_fn(1, 8, 4, 0.0, 8)(model_params, jnp.zeros((1, 8), jnp.int32),
-                               jnp.full((1,), 8, jnp.int32),
-                               jax.random.key(0))   # warm trace+compile
-    # pre-compile the expected bucket lattice BEFORE readiness: a cold
-    # (batch, prompt, new) bucket compiles its decode scan on the first
-    # request that needs it — minutes at multi-GB model sizes, which
-    # blows client timeouts under a load spike. "BxPxN" triples, greedy
-    # temperature (sampling buckets trace separately).
-    for spec in (args.warm.split(",") if args.warm else []):
-        b, p_raw, n_raw = (int(x) for x in spec.lower().split("x"))
-        # bucket the spec exactly the way the batcher buckets real
-        # traffic (serving.plan_bucket — ONE rule, including the
-        # shed-padding fallbacks near max_seq_len): a verbatim or
-        # naively-rounded spec would warm a bucket no request ever
-        # lands in, silently re-introducing the cold-compile stall
-        b = _pow2_at_least(b)
-        p, n, prefill = plan_bucket([p_raw] * b, [n_raw] * b,
-                                    cfg.max_seq_len)
-        emit({"job": "serve", "warming": f"{b}x{p}x{n} prefill={prefill}"})
-        decode_fn(b, p, n, 0.0, prefill)(
-            model_params, jnp.zeros((b, p), jnp.int32),
-            jnp.full((b,), p_raw, jnp.int32), jax.random.key(0))
+    if args.engine == "continuous":
+        from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+
+        engine = SlotPoolEngine(cfg, model_params, slots=args.slots,
+                                segment=args.segment)
+        batcher = ContinuousBatcher(engine, stats=stats)
+        # ONE compile to warm: every request shape shares the same segment
+        # dispatch (per-slot vectors, not bucketed dims), and prefill runs
+        # eager — so a single empty-pool segment is full warm-up. --warm
+        # triples are accepted for CLI compatibility but moot here.
+        emit({"job": "serve", "engine": "continuous",
+              "slots": args.slots, "segment": args.segment})
+        engine.run_segment()
+    else:
+        def run_batch(prompts, lens, max_new, temp, prefill, seed):
+            b = _pow2_at_least(len(prompts))
+            # pad the batch dim to its bucket with duplicate rows (cheap;
+            # the batcher never reads them)
+            rows = prompts + [prompts[0]] * (b - len(prompts))
+            row_lens = lens + [lens[0]] * (b - len(lens))
+            with tpu_lock:
+                return decode_fn(b, len(prompts[0]), max_new, temp, prefill)(
+                    model_params, jnp.asarray(rows, jnp.int32),
+                    jnp.asarray(row_lens, jnp.int32), jax.random.key(seed))
+
+        batcher = DynamicBatcher(run_batch, max_batch=args.max_batch,
+                                 window_ms=args.batch_window_ms,
+                                 max_seq_len=cfg.max_seq_len, stats=stats)
+        emit({"job": "serve", "engine": "dynamic"})
+        decode_fn(1, 8, 4, 0.0, 8)(model_params, jnp.zeros((1, 8), jnp.int32),
+                                   jnp.full((1,), 8, jnp.int32),
+                                   jax.random.key(0))   # warm trace+compile
+        # pre-compile the expected bucket lattice BEFORE readiness: a cold
+        # (batch, prompt, new) bucket compiles its decode scan on the first
+        # request that needs it — minutes at multi-GB model sizes, which
+        # blows client timeouts under a load spike. "BxPxN" triples, greedy
+        # temperature (sampling buckets trace separately).
+        for spec in (args.warm.split(",") if args.warm else []):
+            b, p_raw, n_raw = (int(x) for x in spec.lower().split("x"))
+            # bucket the spec exactly the way the batcher buckets real
+            # traffic (serving.plan_bucket — ONE rule, including the
+            # shed-padding fallbacks near max_seq_len): a verbatim or
+            # naively-rounded spec would warm a bucket no request ever
+            # lands in, silently re-introducing the cold-compile stall
+            b = _pow2_at_least(b)
+            p, n, prefill = plan_bucket([p_raw] * b, [n_raw] * b,
+                                        cfg.max_seq_len)
+            emit({"job": "serve",
+                  "warming": f"{b}x{p}x{n} prefill={prefill}"})
+            decode_fn(b, p, n, 0.0, prefill)(
+                model_params, jnp.zeros((b, p), jnp.int32),
+                jnp.full((b,), p_raw, jnp.int32), jax.random.key(0))
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):  # noqa: N802 — quiet access log
@@ -636,6 +658,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="dynamic batcher: max fused requests per step")
     sv.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="dynamic batcher: wait after first request")
+    sv.add_argument("--engine", choices=("dynamic", "continuous"),
+                    default="dynamic",
+                    help="batching engine: run-to-completion fusion "
+                         "(dynamic) or slot-pool continuous batching")
+    sv.add_argument("--slots", type=int, default=16,
+                    help="continuous engine: persistent decode slots")
+    sv.add_argument("--segment", type=int, default=8,
+                    help="continuous engine: tokens per device dispatch")
 
     pp = sub.add_parser("pipeline",
                         help="device-pipelined training over a pp mesh axis")

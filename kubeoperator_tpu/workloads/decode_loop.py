@@ -1,0 +1,300 @@
+"""Persistent slot-pool decode engine for continuous batching.
+
+``generate()`` runs one fused batch to completion: every co-batched row
+decodes ``new_bucket`` (pow2-padded!) tokens whether it asked for 8 or
+128, and a request arriving one step after a batch launches waits out the
+whole run (head-of-line blocking). The r5 load test put the cost of those
+two semantics at ~2.4x (PERF.md: 1,533 aggregate tok/s through the
+endpoint vs 3,696 from the raw decode loop).
+
+This module keeps a fixed pool of S decode *slots* alive on the device
+instead. Each slot owns a row in every per-layer (k, v) cache buffer —
+the same explicit-buffer layout as ``generate._decode_scan``, so XLA
+aliases the cache updates in place — plus per-slot ``pos`` / ``last`` /
+``plen`` / ``temp`` / ``seed`` vectors. One jitted *segment* dispatch
+advances every active slot K tokens (a ``lax.scan`` over K micro-steps,
+amortizing dispatch latency exactly like the solo scan does); rows stop
+at exactly ``prompt_len + max_tokens`` — no decode-length padding — and a
+per-row temperature lets mixed-temperature traffic co-batch. Between
+segments the host retires finished slots with ONE batched fetch and
+admits queued requests into free slots via chunked prefill written into
+the slot's cache region in place.
+
+Bit-exactness: the micro-step reuses ``generate``'s shared helpers
+(``rms_norm`` / ``token_qkv`` / ``attn_out_mlp`` / ``final_logits``) and
+the same einsum strings, cast points, masking constant (-1e30) and cache
+widths as ``_decode_scan``, with per-row rotary/mask forms that are
+elementwise identical to the scalar-position originals. Greedy tokens
+from a slot therefore match a solo ``generate()`` of the same request bit
+for bit (pinned by tests/test_continuous.py). Sampling is deterministic
+per (seed, position) — ``fold_in(key(seed), pos)`` — which makes a
+sampled row invariant to WHEN it was admitted and WHO shares the pool,
+but (documented trade) it is a different stream than solo ``generate``'s
+split-chain.
+
+Inactive rows keep computing (a ``where`` no-op freezes their ``pos`` and
+buffer): masked softmax positions contribute exactly 0.0, a frozen row
+rewrites the same cache entry with the same value, and a stale cache
+entry from a slot's previous occupant is always overwritten (at ``pos``)
+before the mask first exposes it — so garbage never reaches live rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeoperator_tpu.workloads.generate import (
+    attn_out_mlp, final_logits, rms_norm, token_qkv,
+)
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig,
+)
+
+
+def _pow2_at_most(n: int) -> int:
+    v = 1
+    while v * 2 <= n:
+        v *= 2
+    return v
+
+
+def _rope_rows(x: jnp.ndarray, pos: jnp.ndarray,
+               base: float = 10_000.0) -> jnp.ndarray:
+    """Rotary embeddings with a *per-row* position. x: [S, 1, H, D],
+    pos: [S]. Elementwise identical to ``transformer.rope`` evaluated at
+    each row's scalar position (same f32 angle math, same stack/reshape),
+    which is what keeps slot tokens bit-identical to the solo scan."""
+    d = x.shape[-1]
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos[:, None].astype(jnp.float32) * freqs[None, :]   # [S, D/2]
+    cos = jnp.cos(angles)[:, None, None, :]
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin,
+                         x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+class SlotPoolEngine:
+    """Device side of continuous batching: S persistent decode slots.
+
+    The host-facing protocol (``ContinuousBatcher`` drives it; the bench's
+    fake engine mirrors it):
+
+    * ``admit(entries)`` — write queued requests into free slots: one
+      chunked prefill per pow2 prompt bucket fills ``cache[:C]`` in place,
+      the prompt lands in the slot's token buffer, and the per-slot state
+      vectors are set. Returns ``{slot: pos}`` after admission.
+    * ``run_segment()`` — ONE jitted dispatch advancing every active slot
+      ``segment`` tokens.
+    * ``poll()`` — one batched device->host fetch of (token buffers,
+      positions) for retirement.
+
+    Requires the explicit-buffer fast path's preconditions
+    (``scan_layers`` and no MoE), like ``_decode_scan``.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params: Any, *,
+                 slots: int = 16, segment: int = 8, mesh: Any = None):
+        if cfg.moe_experts != 0 or not cfg.scan_layers:
+            raise ValueError(
+                "SlotPoolEngine requires scan_layers=True and no MoE "
+                "(same preconditions as generate's explicit-buffer path)")
+        if slots < 1 or segment < 1:
+            raise ValueError("slots and segment must be >= 1")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.segment = int(segment)
+        self.max_total = int(cfg.max_seq_len)
+        self._decode_cfg = replace(cfg, decode=True, remat=False)
+        self._model = Transformer(self._decode_cfg, mesh=mesh)
+        self._params = nn.unbox(params)
+        self._emb = self._params["embedding"]
+        self._layers = [jax.tree.map(lambda x: x[l], self._params["layers"])
+                        for l in range(cfg.n_layers)]
+
+        s, t = self.slots, self.max_total
+        h, d, dt = cfg.n_heads, cfg.head_dim, cfg.dtype
+        self._buf = jnp.zeros((s, t), jnp.int32)
+        self._pos = jnp.zeros((s,), jnp.int32)
+        self._last = jnp.zeros((s,), jnp.int32)    # final token index; empty=0
+        self._plen = jnp.ones((s,), jnp.int32)
+        self._temp = jnp.zeros((s,), jnp.float32)
+        self._seeds = jnp.zeros((s,), jnp.int32)
+        self._caches = [(jnp.zeros((s, t, h, d), dt),
+                         jnp.zeros((s, t, h, d), dt))
+                        for _ in range(cfg.n_layers)]
+        # buf/pos/caches are dead after each segment — donate them so XLA
+        # updates in place (CPU's donation support is partial and warns;
+        # skip there). last/plen/temp/seeds stay live host-side (admit
+        # rewrites them between segments), so they must NOT be donated.
+        donate = (0, 1, 6) if jax.default_backend() != "cpu" else ()
+        self._seg_fn = jax.jit(self._segment_body, donate_argnums=donate)
+
+    # -- device math --------------------------------------------------------
+    def _micro_step(self, buf, pos, last, plen, temp, seeds, caches):
+        """Advance every active slot one token — ``_decode_scan.step`` with
+        the scalar position replaced by the per-slot ``pos`` vector."""
+        cfg, dt = self._decode_cfg, self._decode_cfg.dtype
+        s = self.slots
+        rows = jnp.arange(s)
+        active = pos < last                                     # [S]
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        token = buf[rows, pos]                                  # [S]
+        x = self._emb[token][:, None, :].astype(dt)             # [S, 1, d]
+        new_caches = []
+        for pl, (ck, cv) in zip(self._layers, caches):
+            h = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
+            q, k, v = token_qkv(pl["attn"], h, dt)
+            q, k = _rope_rows(q, pos), _rope_rows(k, pos)
+            # scatter each row's k/v at its own position. A finished row
+            # rewrites its frozen position with the identical value; an
+            # empty slot writes garbage it alone can see — both no-ops in
+            # effect, and cheaper than masking the write.
+            ck = ck.at[rows, pos].set(k[:, 0].astype(dt))
+            cv = cv.at[rows, pos].set(v[:, 0].astype(dt))
+            new_caches.append((ck, cv))
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                                preferred_element_type=jnp.float32) * scale
+            mask = (jnp.arange(self.max_total)[None, None, None, :]
+                    <= pos[:, None, None, None])                # [S,1,1,T]
+            probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+            x = attn_out_mlp(pl, x, probs, cv, dt)
+        logits = final_logits(cfg, self._params, x, self._emb)[:, 0, :]
+
+        # per-row choose: the given prompt token while pos+1 is inside the
+        # prompt, argmax when temp==0, else a (seed, position)-keyed sample
+        nxt = jnp.minimum(pos + 1, self.max_total - 1)
+        keep_prompt = (pos + 1) < plen
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = jax.vmap(lambda sd, p: jax.random.fold_in(
+            jax.random.key(sd), p))(seeds, pos)
+        safe_t = jnp.where(temp > 0, temp, 1.0)
+        sampled = jax.vmap(jax.random.categorical)(
+            keys, logits / safe_t[:, None]).astype(jnp.int32)
+        model_choice = jnp.where(temp > 0, sampled, greedy)
+        chosen = jnp.where(keep_prompt, buf[rows, nxt], model_choice)
+        # inactive rows: write their CURRENT token back at pos — a no-op
+        # that keeps the jit free of row gathers/dynamic shapes
+        target = jnp.where(active, nxt, pos)
+        value = jnp.where(active, chosen, buf[rows, pos])
+        buf = buf.at[rows, target].set(value)
+        pos = jnp.where(active, pos + 1, pos)
+        return buf, pos, new_caches
+
+    def _segment_body(self, buf, pos, last, plen, temp, seeds, caches):
+        def step(carry, _):
+            buf, pos, caches = carry
+            buf, pos, caches = self._micro_step(
+                buf, pos, last, plen, temp, seeds, caches)
+            return (buf, pos, caches), None
+
+        (buf, pos, caches), _ = jax.lax.scan(
+            step, (buf, pos, caches), None, length=self.segment)
+        return buf, pos, caches
+
+    # -- host protocol ------------------------------------------------------
+    def admit(self, entries: Sequence[tuple[int, Sequence[int], int, float,
+                                            int]]) -> dict[int, int]:
+        """Admit ``(slot, prompt_ids, max_tokens, temperature, seed)``
+        tuples into their (free) slots. Groups by pow2 prefill bucket so
+        one admission wave costs one chunked forward pass per distinct
+        bucket, then writes each slot's cache region / buffer row /
+        state-vector entries in place. Returns {slot: pos}."""
+        by_c: dict[int, list[tuple[int, list[int], int, float, int]]] = {}
+        for slot, prompt_ids, max_tokens, temperature, seed in entries:
+            prompt = list(map(int, prompt_ids))
+            if not prompt:
+                raise ValueError("prompt_ids must be non-empty")
+            if len(prompt) + int(max_tokens) > self.max_total:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
+                    f"exceed max_seq_len ({self.max_total})")
+            if not 0 <= slot < self.slots:
+                raise ValueError(f"slot {slot} outside pool [0, {self.slots})")
+            c = _pow2_at_most(len(prompt))
+            by_c.setdefault(c, []).append(
+                (int(slot), prompt, int(max_tokens), float(temperature),
+                 int(seed)))
+        out: dict[int, int] = {}
+        for c, group in by_c.items():
+            out.update(self._admit_group(c, group))
+        return out
+
+    def _admit_group(self, c: int, group: list) -> dict[int, int]:
+        cfg = self._decode_cfg
+        k = len(group)
+        slots_np = np.array([g[0] for g in group], np.int32)
+        chunk = np.zeros((k, c), np.int32)
+        for i, (_, prompt, _, _, _) in enumerate(group):
+            chunk[i] = prompt[:c]
+        # compact [k, C] prefill: a C-wide scratch cache (transformer.py's
+        # decode branch masks to the cache width) — the full prompt prefix
+        # in one MXU-shaped pass instead of C token dispatches
+        scratch = {"layers": {"attn": {
+            "cached_k": jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
+                                   cfg.head_dim), cfg.dtype),
+            "cached_v": jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
+                                   cfg.head_dim), cfg.dtype)}}}
+        logits, mutated = self._model.apply(
+            {"params": self._params, "cache": scratch}, jnp.asarray(chunk),
+            jnp.arange(c, dtype=jnp.int32), mutable=["cache"])
+        chunk_k = mutated["cache"]["layers"]["attn"]["cached_k"]  # [L,k,C,H,D]
+        chunk_v = mutated["cache"]["layers"]["attn"]["cached_v"]
+        idx = jnp.asarray(slots_np)
+        new_caches = []
+        for l, (ck, cv) in enumerate(self._caches):
+            new_caches.append((ck.at[idx, :c].set(chunk_k[l]),
+                               cv.at[idx, :c].set(chunk_v[l])))
+        self._caches = new_caches
+
+        out: dict[int, int] = {}
+        buf, pos, last = self._buf, self._pos, self._last
+        plen_v, temp_v, seeds_v = self._plen, self._temp, self._seeds
+        for i, (slot, prompt, max_tokens, temperature, seed) in \
+                enumerate(group):
+            plen = len(prompt)
+            row = np.zeros((self.max_total,), np.int32)
+            row[:plen] = prompt
+            row_j = jnp.asarray(row)
+            if c == plen:
+                # pow2-length prompt: position C holds the FIRST generated
+                # token, chosen from the prefill's last-position logits —
+                # the same boundary choose as generate()'s prefill
+                lg = logits[i, -1]
+                if temperature > 0:
+                    key = jax.random.fold_in(jax.random.key(seed), c - 1)
+                    tok = jax.random.categorical(key, lg / temperature)
+                else:
+                    tok = jnp.argmax(lg)
+                row_j = row_j.at[c].set(tok.astype(jnp.int32))
+            buf = buf.at[slot].set(row_j)
+            pos = pos.at[slot].set(c)
+            last = last.at[slot].set(plen + max_tokens - 1)
+            plen_v = plen_v.at[slot].set(plen)
+            temp_v = temp_v.at[slot].set(temperature)
+            seeds_v = seeds_v.at[slot].set(seed)
+            out[slot] = c
+        self._buf, self._pos, self._last = buf, pos, last
+        self._plen, self._temp, self._seeds = plen_v, temp_v, seeds_v
+        return out
+
+    def run_segment(self) -> None:
+        """One device dispatch: every active slot advances ``segment``
+        tokens (finished/empty slots no-op in place)."""
+        self._buf, self._pos, self._caches = self._seg_fn(
+            self._buf, self._pos, self._last, self._plen, self._temp,
+            self._seeds, self._caches)
+
+    def poll(self) -> tuple[np.ndarray, np.ndarray]:
+        """ONE batched device->host fetch: (token buffers [S, max_total],
+        positions [S]) — retirement reads rows out of this, never
+        per-scalar fetches (each scalar fetch is a transport round trip)."""
+        buf, pos = jax.device_get((self._buf, self._pos))
+        return np.asarray(buf), np.asarray(pos)

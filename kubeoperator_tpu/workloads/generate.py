@@ -54,6 +54,18 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
         prefill_len = p if prompt_lens is None else 1
     if not 1 <= prefill_len <= p:
         raise ValueError(f"prefill_len {prefill_len} outside [1, {p}]")
+    if prompt_lens is not None and not isinstance(prompt_lens, jax.core.Tracer):
+        # the chunk positions must all hold GIVEN tokens: a prefill past the
+        # shortest prompt would feed row padding through the model and
+        # poison that row's cache. Checkable only when the lengths are
+        # concrete — under jit (the serve path passes lens as an argument)
+        # the batcher's plan_bucket guarantees it instead.
+        shortest = int(jnp.min(jnp.asarray(prompt_lens)))
+        if prefill_len > shortest:
+            raise ValueError(
+                f"prefill_len {prefill_len} exceeds shortest prompt "
+                f"({shortest}): every prefilled position needs a given "
+                f"token in all rows")
     decode_cfg = replace(cfg, decode=True, remat=False)
     model = Transformer(decode_cfg, mesh=mesh)
     rng = rng if rng is not None else jax.random.key(0)
@@ -139,6 +151,54 @@ def generate(cfg: TransformerConfig, params: Any, prompt: jnp.ndarray,
     return buf
 
 
+def rms_norm(x, w, eps=1e-6):
+    """RMSNorm exactly as transformer.RMSNorm computes it (f32 variance,
+    cast back before the scale) — shared by the solo decode scan and the
+    slot-pool engine (decode_loop.py) so both stay bit-identical to the
+    flax path."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def token_qkv(a: dict, h: jnp.ndarray, dt) -> tuple:
+    """Single-token q/k/v projections for one layer's attention params
+    ``a`` — the exact einsum strings and cast points of the decode scan
+    (fused and split variants)."""
+    if "qkv" in a:
+        qkv = jnp.einsum("bqd,dshk->bqshk", h, a["qkv"]["kernel"].astype(dt))
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = jnp.einsum("bqd,dhk->bqhk", h, a["q"]["kernel"].astype(dt))
+    k = jnp.einsum("bqd,dhk->bqhk", h, a["k"]["kernel"].astype(dt))
+    v = jnp.einsum("bqd,dhk->bqhk", h, a["v"]["kernel"].astype(dt))
+    return q, k, v
+
+
+def attn_out_mlp(pl: dict, x: jnp.ndarray, probs: jnp.ndarray,
+                 cv: jnp.ndarray, dt) -> jnp.ndarray:
+    """Post-softmax tail of one decode layer: attention output projection,
+    residual add, ln2 + SwiGLU MLP, residual add."""
+    a, m = pl["attn"], pl["mlp"]
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), cv)
+    x = x + jnp.einsum("bqhd,hde->bqe", out, a["o"]["kernel"].astype(dt))
+    h2 = rms_norm(x, pl["ln2"]["scale"]).astype(dt)
+    gate = jnp.einsum("bqd,df->bqf", h2, m["gate"]["kernel"].astype(dt))
+    up = jnp.einsum("bqd,df->bqf", h2, m["up"]["kernel"].astype(dt))
+    return x + jnp.einsum("bqf,fd->bqd", nn.silu(gate) * up,
+                          m["down"]["kernel"].astype(dt))
+
+
+def final_logits(cfg: TransformerConfig, params: Any, x: jnp.ndarray,
+                 emb: jnp.ndarray) -> jnp.ndarray:
+    """ln_f + (tied-embedding) logits matmul, honouring ``logits_bf16``."""
+    xf = rms_norm(x, params["ln_f"]["scale"])
+    if cfg.logits_bf16:
+        return jnp.einsum("btd,vd->btv", xf.astype(cfg.dtype),
+                          emb.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("btd,vd->btv", xf.astype(jnp.float32),
+                      emb.astype(jnp.float32))
+
+
 def _decode_scan(cfg: TransformerConfig, params: Any, cache: Any,
                  buf: jnp.ndarray, rng: jax.Array, positions: jnp.ndarray,
                  choose: Callable, b: int) -> jnp.ndarray:
@@ -163,10 +223,6 @@ def _decode_scan(cfg: TransformerConfig, params: Any, cache: Any,
               for l in range(cfg.n_layers)]
     dt, s, scale = cfg.dtype, cfg.max_seq_len, 1.0 / (cfg.head_dim ** 0.5)
 
-    def norm(x, w, eps=1e-6):
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
-        return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
-
     def step(carry, pos):
         buf, rng, caches = carry
         token = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
@@ -174,16 +230,8 @@ def _decode_scan(cfg: TransformerConfig, params: Any, cache: Any,
         pos1 = jnp.full((1,), pos, jnp.int32)
         new_caches = []
         for pl, (ck, cv) in zip(layers, caches):
-            a = pl["attn"]
-            h = norm(x, pl["ln1"]["scale"]).astype(dt)
-            if "qkv" in a:
-                qkv = jnp.einsum("bqd,dshk->bqshk", h,
-                                 a["qkv"]["kernel"].astype(dt))
-                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            else:
-                q = jnp.einsum("bqd,dhk->bqhk", h, a["q"]["kernel"].astype(dt))
-                k = jnp.einsum("bqd,dhk->bqhk", h, a["k"]["kernel"].astype(dt))
-                v = jnp.einsum("bqd,dhk->bqhk", h, a["v"]["kernel"].astype(dt))
+            h = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
+            q, k, v = token_qkv(pl["attn"], h, dt)
             q, k = rope(q, pos1), rope(k, pos1)
             ck = jax.lax.dynamic_update_slice(ck, k.astype(dt), (0, pos, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(dt), (0, pos, 0, 0))
@@ -193,22 +241,8 @@ def _decode_scan(cfg: TransformerConfig, params: Any, cache: Any,
             mask = (jnp.arange(s)[None, None, None, :]
                     <= pos1[None, None, :, None])
             probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), cv)
-            x = x + jnp.einsum("bqhd,hde->bqe", out,
-                               a["o"]["kernel"].astype(dt))
-            m = pl["mlp"]
-            h2 = norm(x, pl["ln2"]["scale"]).astype(dt)
-            gate = jnp.einsum("bqd,df->bqf", h2, m["gate"]["kernel"].astype(dt))
-            up = jnp.einsum("bqd,df->bqf", h2, m["up"]["kernel"].astype(dt))
-            x = x + jnp.einsum("bqf,fd->bqd", nn.silu(gate) * up,
-                               m["down"]["kernel"].astype(dt))
-        xf = norm(x, params["ln_f"]["scale"])
-        if cfg.logits_bf16:
-            logits = jnp.einsum("btd,vd->btv", xf.astype(dt), emb.astype(dt),
-                                preferred_element_type=jnp.float32)
-        else:
-            logits = jnp.einsum("btd,vd->btv", xf.astype(jnp.float32),
-                                emb.astype(jnp.float32))
+            x = attn_out_mlp(pl, x, probs, cv, dt)
+        logits = final_logits(cfg, params, x, emb)
         buf, rng = choose(logits[:, 0, :], pos, buf, rng)
         return (buf, rng, new_caches), None
 

@@ -1,22 +1,32 @@
-"""Dynamic request batching for the token-generation endpoint.
+"""Request batching for the token-generation endpoint — two engines.
 
-The decode step is launch-latency-bound at small batches (PERF.md: one
-lax.scan dispatch per token through the relay), so aggregate throughput
-scales almost linearly with batch size until HBM bandwidth saturates.
-Concurrent ``/generate`` requests therefore queue here; a single worker
-drains up to ``max_batch`` of them (waiting ``window_ms`` after the first
-arrival for company), right-pads prompts into one batch, and runs ONE
-batched generation with per-row prompt lengths (``generate.py``). Each
-reply slices its own row — batching changes throughput, never tokens
-(tests/test_serving.py proves token-equality with solo runs).
+``DynamicBatcher`` (run-to-completion fusion): the decode step is
+launch-latency-bound at small batches (PERF.md: one lax.scan dispatch per
+token through the relay), so aggregate throughput scales almost linearly
+with batch size until HBM bandwidth saturates. Concurrent ``/generate``
+requests queue here; a single worker drains up to ``max_batch`` of them
+(waiting ``window_ms`` after the first arrival for company), right-pads
+prompts into one batch, and runs ONE batched generation with per-row
+prompt lengths (``generate.py``). Each reply slices its own row —
+batching changes throughput, never tokens (tests/test_serving.py proves
+token-equality with solo runs). Static shapes: batch, padded prompt
+length and new-token count are rounded up to powers of two, and the
+prefill chunk down to one, so the number of distinct compiles stays
+logarithmic in every dimension. Requests with different temperatures
+never fuse (temperature selects the sampling branch at trace time).
 
-Static shapes: batch, padded prompt length and new-token count are
-rounded up to powers of two, and the prefill chunk down to one, so the
-number of distinct compiles stays logarithmic in every dimension.
-Requests with different temperatures never fuse (temperature selects the
-sampling branch at trace time); per-request seeds are honoured only for
-batches of one — sampled batches draw from one folded stream, which is
-the standard dynamic-batching trade.
+``ContinuousBatcher`` (in-flight batching, round 6): drives a persistent
+slot-pool engine (``decode_loop.SlotPoolEngine``) instead. Requests are
+admitted into free decode slots *between* fixed K-token segments, each
+row stops at exactly its own ``prompt_len + max_tokens``, finished slots
+retire with one batched fetch, and mixed temperatures co-batch (the
+engine samples per-row). This removes the two defects the r5 load test
+measured — head-of-line blocking and decode-length pow2 padding — worth
+~2.4x aggregate tok/s at 32 clients (PERF.md round 6).
+
+Both engines report through ``BatcherStats``, whose families live in a
+``telemetry.metrics`` registry (private per batcher by default; the serve
+job passes the process-global REGISTRY so ``/metrics`` is one scrape).
 """
 
 from __future__ import annotations
@@ -24,11 +34,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from bisect import bisect_left, insort
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
 import numpy as np
+
+from kubeoperator_tpu.telemetry import metrics as tm
 
 
 def _pow2_at_least(n: int, floor: int = 1) -> int:
@@ -78,98 +90,79 @@ class _Pending:
 
 
 class BatcherStats:
-    """Serving observability for the batcher: counters, the fused-batch
-    size histogram, and a bounded latency reservoir for p50/p95 —
-    exported as JSON (``snapshot``) and Prometheus text (``prometheus``),
-    scraped by services/monitor.py and charted in the UI."""
+    """Serving observability for both batcher engines, backed by the
+    ``telemetry.metrics`` registry: counters, the per-dispatch batch-size
+    histogram, a sliding-window latency summary (p50/p95), plus the
+    continuous engine's slot-occupancy gauge, TTFT and segment-duration
+    histograms. Exported as JSON (``snapshot``) and Prometheus text
+    (``prometheus`` — the registry's exposition, so the batch-size
+    histogram now carries its ``+Inf`` bucket and ``_count``/``_sum``
+    series), scraped by services/monitor.py and charted in the UI.
 
-    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+    Each instance owns a private ``Registry`` unless one is passed —
+    independent batchers (and tests) must not share counters; the serve
+    job passes the global ``telemetry.metrics.REGISTRY``.
+    """
 
-    def __init__(self, window: int = 512):
+    BATCH_BUCKETS = tuple(int(b) for b in tm.SERVE_BATCH_BUCKETS)
+
+    def __init__(self, window: int = 512, registry: tm.Registry | None = None):
         self._lock = threading.Lock()
-        self._window = window
-        self.requests_total = 0
-        self.errors_total = 0
-        self.batches_total = 0
-        self.tokens_generated_total = 0
-        self.queue_depth = 0
-        self.batch_hist = {b: 0 for b in self.BATCH_BUCKETS}
-        self._latencies: list[float] = []   # sorted, bounded reservoir
-        self._latency_order: list[float] = []
+        self.registry = registry if registry is not None else tm.Registry()
+        self._m = tm.declare_serve_metrics(self.registry, window=window)
 
     def enqueued(self) -> None:
-        with self._lock:
-            self.queue_depth += 1
+        self._m["queue_depth"].inc()
 
     def executed(self, batch_size: int) -> None:
-        with self._lock:
-            self.batches_total += 1
-            b = min((x for x in self.BATCH_BUCKETS if x >= batch_size),
-                    default=self.BATCH_BUCKETS[-1])
-            self.batch_hist[b] += 1
+        self._m["batches"].inc()
+        self._m["batch_size"].observe(batch_size)
 
     def finished(self, req: _Pending, ok: bool) -> None:
-        with self._lock:
-            self.queue_depth = max(0, self.queue_depth - 1)
-            self.requests_total += 1
-            if ok:
-                # the tokens this request actually received (its result is
-                # sliced to prompt + max_tokens), not the pow2 bucket the
-                # fused batch decoded at
-                self.tokens_generated_total += req.max_tokens
-            else:
-                self.errors_total += 1
-            lat = time.monotonic() - req.submitted_at
-            insort(self._latencies, lat)
-            self._latency_order.append(lat)
-            if len(self._latency_order) > self._window:
-                old = self._latency_order.pop(0)
-                del self._latencies[bisect_left(self._latencies, old)]
+        with self._lock:   # clamp at 0 needs read-modify-write
+            depth = self._m["queue_depth"].value()
+            self._m["queue_depth"].set(max(0.0, depth - 1))
+        self._m["requests"].inc()
+        if ok:
+            # the tokens this request actually received (its result is
+            # sliced to prompt + max_tokens), not the pow2 bucket the
+            # fused batch decoded at
+            self._m["tokens"].inc(req.max_tokens)
+        else:
+            self._m["errors"].inc()
+        self._m["latency"].observe(time.monotonic() - req.submitted_at)
 
-    def _quantile(self, q: float) -> float:
-        if not self._latencies:
-            return 0.0
-        i = min(len(self._latencies) - 1, int(q * len(self._latencies)))
-        return self._latencies[i]
+    # -- continuous-engine hooks -------------------------------------------
+    def occupancy(self, slots_busy: int) -> None:
+        self._m["slot_occupancy"].set(slots_busy)
+
+    def ttft(self, seconds: float) -> None:
+        self._m["ttft"].observe(seconds)
+
+    def segment(self, seconds: float) -> None:
+        self._m["segment"].observe(seconds)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "requests_total": self.requests_total,
-                "errors_total": self.errors_total,
-                "batches_total": self.batches_total,
-                "tokens_generated_total": self.tokens_generated_total,
-                "queue_depth": self.queue_depth,
-                "batch_size_hist": dict(self.batch_hist),
-                "latency_p50_s": round(self._quantile(0.50), 4),
-                "latency_p95_s": round(self._quantile(0.95), 4),
-            }
+        hist = self._m["batch_size"]
+        slot = hist.samples().get(())
+        counts = slot["counts"] if slot else [0] * len(hist.buckets)
+        batch_hist: dict = {int(b): n for b, n in zip(hist.buckets, counts)
+                            if b != float("inf")}
+        batch_hist["+Inf"] = counts[-1]
+        return {
+            "requests_total": int(self._m["requests"].value()),
+            "errors_total": int(self._m["errors"].value()),
+            "batches_total": int(self._m["batches"].value()),
+            "tokens_generated_total": int(self._m["tokens"].value()),
+            "queue_depth": int(self._m["queue_depth"].value()),
+            "slot_occupancy": int(self._m["slot_occupancy"].value()),
+            "batch_size_hist": batch_hist,
+            "latency_p50_s": round(self._m["latency"].quantile(0.50), 4),
+            "latency_p95_s": round(self._m["latency"].quantile(0.95), 4),
+        }
 
     def prometheus(self) -> str:
-        s = self.snapshot()
-        lines = [
-            "# TYPE ko_serve_requests_total counter",
-            f"ko_serve_requests_total {s['requests_total']}",
-            "# TYPE ko_serve_errors_total counter",
-            f"ko_serve_errors_total {s['errors_total']}",
-            "# TYPE ko_serve_batches_total counter",
-            f"ko_serve_batches_total {s['batches_total']}",
-            "# TYPE ko_serve_tokens_generated_total counter",
-            f"ko_serve_tokens_generated_total {s['tokens_generated_total']}",
-            "# TYPE ko_serve_queue_depth gauge",
-            f"ko_serve_queue_depth {s['queue_depth']}",
-            "# TYPE ko_serve_request_latency_seconds summary",
-            "ko_serve_request_latency_seconds{quantile=\"0.5\"} "
-            f"{s['latency_p50_s']}",
-            "ko_serve_request_latency_seconds{quantile=\"0.95\"} "
-            f"{s['latency_p95_s']}",
-            "# TYPE ko_serve_batch_size_bucket counter",
-        ]
-        cum = 0
-        for b, n in sorted(s["batch_size_hist"].items()):
-            cum += n
-            lines.append(f'ko_serve_batch_size_bucket{{le="{b}"}} {cum}')
-        return "\n".join(lines) + "\n"
+        return self.registry.render()
 
 
 class DynamicBatcher:
@@ -184,12 +177,13 @@ class DynamicBatcher:
     """
 
     def __init__(self, run_fn: Callable[..., Any], *, max_batch: int = 32,
-                 window_ms: float = 5.0, max_seq_len: int = 2048):
+                 window_ms: float = 5.0, max_seq_len: int = 2048,
+                 stats: BatcherStats | None = None):
         self.run_fn = run_fn
         self.max_batch = max_batch
         self.window_s = window_ms / 1000.0
         self.max_seq_len = max_seq_len
-        self.stats = BatcherStats()
+        self.stats = stats if stats is not None else BatcherStats()
         self._q: queue.Queue[_Pending] = queue.Queue()
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ko-serve-batcher")
@@ -219,8 +213,6 @@ class DynamicBatcher:
     def _drain(self) -> list[_Pending]:
         """One request, then whatever arrives within the window."""
         batch = [self._q.get()]
-        import time
-
         deadline = time.monotonic() + self.window_s
         while len(batch) < self.max_batch:
             remaining = deadline - time.monotonic()
@@ -296,3 +288,138 @@ class DynamicBatcher:
                 r.error = e
                 self.stats.finished(r, ok=False)
                 r.done.set()
+
+
+class ContinuousBatcher:
+    """Continuous (in-flight) batching over a persistent slot-pool engine.
+
+    ``engine`` is duck-typed (``decode_loop.SlotPoolEngine`` in
+    production, the bench's latency-injecting fake in tier-1): attributes
+    ``slots`` / ``segment`` / ``max_total`` and methods
+    ``admit(entries) -> {slot: pos}``, ``run_segment()``, ``poll() ->
+    (buf [S, max_total], pos [S])``.
+
+    The worker alternates: admit queued requests into free slots (one
+    prefill pass per pow2 prompt bucket), dispatch ONE segment advancing
+    every active slot K tokens, retire finished slots from one batched
+    fetch, idle when the pool drains. Scheduling needs **no** device
+    reads: admission returns each slot's position and every segment adds
+    exactly K (clamped at the row's stop index), so the host mirror of
+    ``pos`` is exact and ``poll()`` runs only when some row finished.
+    """
+
+    def __init__(self, engine: Any, *, stats: BatcherStats | None = None):
+        self.engine = engine
+        self.stats = stats if stats is not None else BatcherStats()
+        self._cond = threading.Condition()
+        self._queue: deque[_Pending] = deque()
+        self._track: dict[int, dict] = {}       # slot -> in-flight state
+        self._free = list(range(engine.slots))
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="ko-serve-continuous")
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               timeout: float | None = 300.0) -> list[int]:
+        if not prompt_ids:
+            raise ValueError("prompt_ids must be non-empty")
+        if len(prompt_ids) + max_tokens > self.engine.max_total:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_tokens ({max_tokens}) "
+                f"exceed max_seq_len ({self.engine.max_total})")
+        req = _Pending(list(prompt_ids), int(max_tokens), float(temperature),
+                       int(seed))
+        self.stats.enqueued()
+        if req.max_tokens == 0:
+            # nothing to decode: the reply IS the prompt (generate()'s
+            # max_new_tokens==0 fast path) — don't burn a slot on it
+            req.result = list(req.prompt_ids)
+            self.stats.finished(req, ok=True)
+            return req.result
+        with self._cond:
+            self._queue.append(req)
+            self._cond.notify()
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- worker side -------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._track:
+                    self._cond.wait()           # pool drained: idle
+                admit_now = []
+                while self._queue and self._free:
+                    admit_now.append((self._free.pop(), self._queue.popleft()))
+            try:
+                self._step(admit_now)
+            except Exception as e:  # noqa: BLE001 — engine boundary
+                self._fail_all(admit_now, e)
+
+    def _step(self, admit_now: list[tuple[int, _Pending]]) -> None:
+        now = time.monotonic
+        if admit_now:
+            pos_map = self.engine.admit(
+                [(slot, r.prompt_ids, r.max_tokens, r.temperature, r.seed)
+                 for slot, r in admit_now])
+            for slot, r in admit_now:
+                plen = len(r.prompt_ids)
+                t = {"req": r, "plen": plen, "pos": pos_map[slot],
+                     "last": plen + r.max_tokens - 1, "ttft": False}
+                if t["pos"] >= plen:
+                    # pow2-length prompt: its first token was born in the
+                    # admission prefill itself
+                    self.stats.ttft(now() - r.submitted_at)
+                    t["ttft"] = True
+                self._track[slot] = t
+            self.stats.occupancy(len(self._track))
+
+        active = [s for s, t in self._track.items() if t["pos"] < t["last"]]
+        if active:
+            t0 = now()
+            self.engine.run_segment()
+            self.stats.segment(now() - t0)
+            self.stats.executed(len(active))
+            k = self.engine.segment
+            for s in active:
+                t = self._track[s]
+                t["pos"] = min(t["pos"] + k, t["last"])
+                if not t["ttft"] and t["pos"] >= t["plen"]:
+                    self.stats.ttft(now() - t["req"].submitted_at)
+                    t["ttft"] = True
+
+        done = [s for s, t in self._track.items() if t["pos"] >= t["last"]]
+        if done:
+            buf, _ = self.engine.poll()         # ONE batched fetch
+            for s in done:
+                t = self._track.pop(s)
+                r = t["req"]
+                r.result = [int(x)
+                            for x in buf[s][:t["plen"] + r.max_tokens]]
+                self.stats.finished(r, ok=True)
+                r.done.set()
+            with self._cond:
+                self._free.extend(done)
+            self.stats.occupancy(len(self._track))
+
+    def _fail_all(self, admit_now: list[tuple[int, _Pending]],
+                  err: Exception) -> None:
+        """Engine-level failure: fail every in-flight request and reset
+        the pool (per-request validation happened in submit, so an admit/
+        segment error is systemic, not one bad row's)."""
+        with self._cond:
+            victims = [t["req"] for t in self._track.values()]
+            victims += [r for _, r in admit_now if not r.done.is_set()]
+            self._track.clear()
+            self._free = list(range(self.engine.slots))
+        for r in victims:
+            if not r.done.is_set():
+                r.error = err
+                self.stats.finished(r, ok=False)
+                r.done.set()
+        self.stats.occupancy(0)

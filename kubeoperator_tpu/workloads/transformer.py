@@ -182,8 +182,10 @@ class Attention(nn.Module):
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k.value,
                                 preferred_element_type=jnp.float32) * scale
             # query at global position `positions[i]` sees cache slots <=
-            # that position — causal within the chunk, full history before
-            mask = (jnp.arange(cfg.max_seq_len)[None, None, None, :]
+            # that position — causal within the chunk, full history before.
+            # Sized from the cache itself (not cfg.max_seq_len) so a caller
+            # may pass a compact [B, C, H, D] scratch cache for prefill.
+            mask = (jnp.arange(cache_k.value.shape[1])[None, None, None, :]
                     <= positions[None, None, :, None])
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)

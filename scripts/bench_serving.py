@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Serving microbench: dynamic run-to-completion vs continuous batching.
+
+Replays the SAME staggered request trace (mixed prompt lengths, mixed
+max_tokens) against both batchers on one injected-latency cost model — no
+model, no device, pure batch-formation semantics:
+
+* every device dispatch costs ``--dispatch`` (the relay round trip);
+* every decoded token *position* costs ``--step`` regardless of how many
+  rows advance at it (the decode step is launch/bandwidth-bound, not
+  row-bound — the whole reason batching pays);
+* a prefill pass costs ``--prefill``.
+
+``DynamicBatcher`` therefore pays ``dispatch + prefill + new_bucket *
+step`` per fused batch, where ``new_bucket`` is the pow2 of the LONGEST
+request it fused (decode-length padding), and requests arriving mid-run
+wait the whole run out (head-of-line). The continuous engine pays
+``dispatch + segment * step`` per segment with rows retiring at exactly
+their own length and admissions landing between segments. The tier-1 test
+(tests/test_continuous.py) enforces >=1.5x aggregate tok/s on this same
+shape; this script is for poking at the trade-offs interactively.
+
+Usage:
+    python scripts/bench_serving.py [--requests 48] [--slots 16]
+        [--segment 8] [--max-batch 16] [--step 0.001] [--dispatch 0.003]
+        [--prefill 0.002] [--stagger 0.005]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                              # noqa: E402
+
+from kubeoperator_tpu.workloads.serving import (                # noqa: E402
+    ContinuousBatcher, DynamicBatcher, _pow2_at_most,
+)
+
+# the replayed trace: (prompt_len, max_tokens) cycled over --requests.
+# One long-decode request per four keeps dynamic's new_bucket pinned at
+# 128 (any fused group containing it decodes 128 for EVERY row) and its
+# prefill pinned at 8 (fusion prefills at the SHORTEST prompt, so long
+# prompts re-decode their own tail token by token), while the continuous
+# engine prefills each row at its own length and retires the three short
+# rows at 8 — the two r5 defects, in miniature.
+TRACE = ((8, 8), (16, 8), (32, 8), (64, 128))
+VOCAB = 1000
+
+
+def make_trace(n: int) -> list[tuple[list[int], int]]:
+    out = []
+    for i in range(n):
+        plen, mt = TRACE[i % len(TRACE)]
+        out.append(([(i + j) % VOCAB + 1 for j in range(plen)], mt))
+    return out
+
+
+def fake_row(prompt: list[int], total: int) -> np.ndarray:
+    """Deterministic pseudo-tokens: position-keyed so both engines agree
+    and replies are checkable without a model."""
+    row = np.zeros((total,), np.int32)
+    row[:len(prompt)] = prompt
+    base = sum(prompt) % VOCAB
+    for p in range(len(prompt), total):
+        row[p] = (base + p) % VOCAB
+    return row
+
+
+class FakeSlotEngine:
+    """SlotPoolEngine's host protocol over numpy + injected latency —
+    the continuous side of the cost model (one ``dispatch + K * step``
+    sleep per segment, one ``dispatch + prefill`` sleep per admission
+    prefill bucket)."""
+
+    def __init__(self, *, slots: int = 16, segment: int = 8,
+                 max_total: int = 2048, step_s: float = 0.001,
+                 dispatch_s: float = 0.003, prefill_s: float = 0.002):
+        self.slots, self.segment, self.max_total = slots, segment, max_total
+        self.step_s, self.dispatch_s, self.prefill_s = (
+            step_s, dispatch_s, prefill_s)
+        self.buf = np.zeros((slots, max_total), np.int32)
+        self.pos = np.zeros((slots,), np.int32)
+        self.last = np.zeros((slots,), np.int32)
+        self.dispatches = 0
+
+    def admit(self, entries):
+        by_c: dict[int, list] = {}
+        for slot, prompt_ids, max_tokens, _temp, _seed in entries:
+            prompt = list(map(int, prompt_ids))
+            by_c.setdefault(_pow2_at_most(len(prompt)), []).append(
+                (slot, prompt, int(max_tokens)))
+        out = {}
+        for c, group in by_c.items():
+            time.sleep(self.dispatch_s + self.prefill_s)
+            self.dispatches += 1
+            for slot, prompt, max_tokens in group:
+                total = len(prompt) + max_tokens
+                self.buf[slot] = 0
+                self.buf[slot, :total] = fake_row(prompt, total)
+                self.pos[slot] = c
+                self.last[slot] = total - 1
+                out[slot] = c
+        return out
+
+    def run_segment(self):
+        time.sleep(self.dispatch_s + self.segment * self.step_s)
+        self.dispatches += 1
+        active = self.pos < self.last
+        self.pos = np.where(active,
+                            np.minimum(self.pos + self.segment, self.last),
+                            self.pos)
+
+    def poll(self):
+        return self.buf.copy(), self.pos.copy()
+
+
+class FakeRunFn:
+    """generate()-shaped callable for DynamicBatcher — the dynamic side
+    of the cost model. One fused batch costs ``dispatch + prefill +
+    (p_bucket - prefill_len + new_bucket) * step``: generate() scans
+    token-by-token from the prefill chunk (pow2 of the SHORTEST fused
+    prompt) through the pow2-padded decode length — run-to-completion at
+    the worst row's shape, which is exactly what the slot pool removes."""
+
+    def __init__(self, *, step_s: float = 0.001, dispatch_s: float = 0.003,
+                 prefill_s: float = 0.002):
+        self.step_s, self.dispatch_s, self.prefill_s = (
+            step_s, dispatch_s, prefill_s)
+        self.dispatches = 0
+
+    def __call__(self, prompts, lens, max_new, temp, prefill, seed):
+        steps = len(prompts[0]) - prefill + max_new
+        time.sleep(self.dispatch_s + self.prefill_s + steps * self.step_s)
+        self.dispatches += 1
+        width = len(prompts[0]) + max_new
+        out = np.zeros((len(prompts), width), np.int32)
+        for i, (row, n) in enumerate(zip(prompts, lens)):
+            out[i] = fake_row(list(row[:n]), width)
+        return out
+
+
+def run_load(batcher, trace, stagger_s: float) -> dict:
+    """Replay the trace with staggered client threads; aggregate tok/s
+    counts only the NEW tokens each request asked for."""
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def client(i, prompt, max_tokens):
+        time.sleep(i * stagger_s)
+        try:
+            results[i] = batcher.submit(prompt, max_tokens, timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i, p, mt))
+               for i, (p, mt) in enumerate(trace)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    tokens = sum(mt for _, mt in trace)
+    for i, (prompt, mt) in enumerate(trace):
+        got = results[i]
+        assert got[:len(prompt)] == list(prompt), f"request {i} lost prompt"
+        assert len(got) == len(prompt) + mt, f"request {i} wrong length"
+    return {"wall_s": wall, "tokens": tokens, "tok_s": tokens / wall}
+
+
+def bench(requests: int, slots: int, segment: int, max_batch: int,
+          step_s: float, dispatch_s: float, prefill_s: float,
+          stagger_s: float, max_total: int = 2048) -> dict:
+    trace = make_trace(requests)
+    dyn = DynamicBatcher(
+        FakeRunFn(step_s=step_s, dispatch_s=dispatch_s,
+                  prefill_s=prefill_s),
+        max_batch=max_batch, window_ms=5.0, max_seq_len=max_total)
+    d = run_load(dyn, trace, stagger_s)
+    cont = ContinuousBatcher(FakeSlotEngine(
+        slots=slots, segment=segment, max_total=max_total, step_s=step_s,
+        dispatch_s=dispatch_s, prefill_s=prefill_s))
+    c = run_load(cont, trace, stagger_s)
+    return {
+        "requests": requests,
+        "tokens": d["tokens"],
+        "dynamic_s": round(d["wall_s"], 3),
+        "continuous_s": round(c["wall_s"], 3),
+        "dynamic_tok_s": round(d["tok_s"], 1),
+        "continuous_tok_s": round(c["tok_s"], 1),
+        "speedup": round(d["wall_s"] / c["wall_s"], 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--segment", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=16,
+                    help="dynamic batcher fusion cap")
+    ap.add_argument("--step", type=float, default=0.001,
+                    help="injected cost per decoded token position")
+    ap.add_argument("--dispatch", type=float, default=0.003,
+                    help="injected cost per device dispatch")
+    ap.add_argument("--prefill", type=float, default=0.002,
+                    help="injected cost per prefill pass")
+    ap.add_argument("--stagger", type=float, default=0.002,
+                    help="client arrival spacing in seconds")
+    args = ap.parse_args()
+    print(json.dumps(bench(args.requests, args.slots, args.segment,
+                           args.max_batch, args.step, args.dispatch,
+                           args.prefill, args.stagger)))
+
+
+if __name__ == "__main__":
+    main()

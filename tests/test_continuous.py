@@ -1,0 +1,224 @@
+"""Continuous batching (ISSUE 5): slot-pool decode bit-equality with solo
+generate(), segment-boundary admission, neighbor invariance, the
+ContinuousBatcher end-to-end, and the tier-1 cost-model microbench
+proving continuous >= 1.5x dynamic aggregate tok/s on the same injected
+per-dispatch latency (mirroring test_scheduler's stance)."""
+
+import dataclasses
+import importlib.util
+import os
+import threading
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+from kubeoperator_tpu.workloads.generate import generate
+from kubeoperator_tpu.workloads.serving import ContinuousBatcher
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig,
+)
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq_len=24, dtype=jnp.float32,
+                        remat=False, attention="dense")
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Transformer(CFG)
+    return nn.unbox(model.init(jax.random.key(7),
+                               jnp.zeros((2, 8), jnp.int32))["params"])
+
+
+def solo(params, prompt, max_tokens, temperature=0.0, **kw):
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), max_tokens,
+                   temperature=temperature, **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def drain(eng, track):
+    """Run segments until every tracked slot is finished; return rows."""
+    for _ in range(200):
+        if all(p >= last for p, last in track.values()):
+            break
+        eng.run_segment()
+        for s, (p, last) in track.items():
+            track[s] = (min(p + eng.segment, last), last)
+    buf, _ = eng.poll()
+    return buf
+
+
+def admit_tracked(eng, track, entries):
+    pos = eng.admit(entries)
+    for slot, prompt, mt, _t, _s in entries:
+        track[slot] = (pos[slot], len(prompt) + mt - 1)
+
+
+# ---------------------------------------------------------------------------
+# greedy bit-equality with solo generate()
+# ---------------------------------------------------------------------------
+
+def test_greedy_matches_solo_mixed_shapes(params):
+    """Mixed prompt lengths (pow2 and not) and per-row max_tokens in one
+    pool: every row's greedy tokens are bit-identical to running that
+    request alone through generate() — the acceptance-pinning test."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3)
+    reqs = {0: ([1, 2, 3, 4, 5], 6),          # non-pow2 prompt
+            1: ([7, 8, 9, 10, 11, 12, 13, 14], 5),   # pow2 prompt
+            2: ([42], 9),                     # single-token prompt
+            3: ([3, 1, 4, 1, 5, 9, 2], 12)}
+    track = {}
+    admit_tracked(eng, track, [(s, p, mt, 0.0, 0)
+                               for s, (p, mt) in reqs.items()])
+    buf = drain(eng, track)
+    for s, (prompt, mt) in reqs.items():
+        got = buf[s][:len(prompt) + mt].tolist()
+        assert got == solo(params, prompt, mt), f"slot {s} diverged"
+
+
+def test_mid_flight_admission_matches_solo(params):
+    """A request admitted while another is mid-decode gets the same
+    tokens as running alone — segment-boundary admission must not
+    perturb either the newcomer or the row already in flight."""
+    eng = SlotPoolEngine(CFG, params, slots=3, segment=2)
+    track = {}
+    admit_tracked(eng, track, [(0, [5, 6, 7, 8, 9, 10], 10, 0.0, 0)])
+    eng.run_segment()   # slot 0 is now mid-decode
+    track[0] = (min(track[0][0] + 2, track[0][1]), track[0][1])
+    admit_tracked(eng, track, [(2, [11, 12, 13], 8, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[0][:16].tolist() == solo(params, [5, 6, 7, 8, 9, 10], 10)
+    assert buf[2][:11].tolist() == solo(params, [11, 12, 13], 8)
+
+
+def test_row_invariant_to_neighbor_slots(params):
+    """The same request produces the same tokens regardless of which slot
+    holds it and what its neighbors are decoding."""
+    prompt, mt = [9, 8, 7, 6, 5], 7
+    runs = []
+    for slot, neighbors in ((0, []), (2, [(0, [1, 2], 10, 0.0, 0),
+                                          (3, [4, 4, 4, 4], 6, 0.7, 5)])):
+        eng = SlotPoolEngine(CFG, params, slots=4, segment=4)
+        track = {}
+        admit_tracked(eng, track, neighbors + [(slot, prompt, mt, 0.0, 0)])
+        buf = drain(eng, track)
+        runs.append(buf[slot][:len(prompt) + mt].tolist())
+    assert runs[0] == runs[1]
+    assert runs[0] == solo(params, prompt, mt)
+
+
+def test_mixed_temperature_cobatch_deterministic(params):
+    """Sampled rows co-batch with greedy ones (no trace-time split); a
+    sampled row is keyed by (seed, position) only, so it reproduces
+    across pools and is invariant to its neighbors."""
+    prompt, mt = [2, 4, 6, 8], 8
+    outs = []
+    for neighbors in ([], [(1, [1, 1, 1, 1, 1], 10, 0.0, 0)]):
+        eng = SlotPoolEngine(CFG, params, slots=2, segment=3)
+        track = {}
+        admit_tracked(eng, track,
+                      neighbors + [(0, prompt, mt, 0.9, 123)])
+        buf = drain(eng, track)
+        outs.append(buf[0][:len(prompt) + mt].tolist())
+    assert outs[0] == outs[1]
+    assert outs[0][:len(prompt)] == prompt
+    assert all(0 <= t < CFG.vocab_size for t in outs[0])
+
+
+def test_engine_validates(params):
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    with pytest.raises(ValueError):
+        eng.admit([(0, [], 4, 0.0, 0)])
+    with pytest.raises(ValueError):
+        eng.admit([(0, [1] * 20, 10, 0.0, 0)])   # 30 > max_seq_len 24
+    with pytest.raises(ValueError):
+        eng.admit([(5, [1, 2], 4, 0.0, 0)])      # slot outside pool
+    with pytest.raises(ValueError):
+        SlotPoolEngine(dataclasses.replace(CFG, scan_layers=False), params)
+
+
+# ---------------------------------------------------------------------------
+# ContinuousBatcher end-to-end over the real engine
+# ---------------------------------------------------------------------------
+
+def test_continuous_batcher_end_to_end(params):
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2)
+    cb = ContinuousBatcher(eng)
+    reqs = [([1, 2, 3, 4, 5], 6, 0.0), ([7, 8, 9], 4, 0.0),
+            ([3, 1, 4, 1, 5, 9, 2, 6], 8, 0.7), ([2, 2, 2], 12, 0.0),
+            ([40, 41], 0, 0.0)]
+    results = {}
+
+    def client(i, prompt, mt, temp):
+        time.sleep(0.01 * i)     # staggered -> mid-flight admission
+        results[i] = cb.submit(prompt, mt, temperature=temp, seed=i)
+
+    threads = [threading.Thread(target=client, args=(i, *r))
+               for i, r in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (prompt, mt, temp) in enumerate(reqs):
+        if temp == 0.0:
+            assert results[i] == solo(params, prompt, mt), f"request {i}"
+        else:
+            assert len(results[i]) == len(prompt) + mt
+    s = cb.stats.snapshot()
+    assert s["requests_total"] == 5 and s["errors_total"] == 0
+    assert s["tokens_generated_total"] == 6 + 4 + 8 + 12
+    assert s["queue_depth"] == 0 and s["slot_occupancy"] == 0
+    assert s["batches_total"] >= 1
+    text = cb.stats.prometheus()
+    assert "ko_serve_slot_occupancy 0" in text
+    assert "ko_serve_ttft_seconds_bucket" in text
+    assert "ko_serve_segment_duration_seconds_count" in text
+    # request validation still client-side
+    with pytest.raises(ValueError):
+        cb.submit([1] * 20, 10)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 cost-model microbench: continuous >= 1.5x dynamic tok/s
+# ---------------------------------------------------------------------------
+
+def _bench_mod():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_continuous_beats_dynamic_cost_model():
+    """Same staggered trace, same injected per-dispatch/per-token costs:
+    the slot pool must deliver >= 1.5x the aggregate tok/s of
+    run-to-completion fusion (acceptance criterion; ~1.9x typical on this
+    shape, margin for CI scheduling noise)."""
+    bs = _bench_mod()
+    out = bs.bench(requests=48, slots=16, segment=8, max_batch=16,
+                   step_s=0.001, dispatch_s=0.002, prefill_s=0.002,
+                   stagger_s=0.002)
+    assert out["speedup"] >= 1.5, out
+
+
+def test_fake_and_real_engine_share_protocol(params):
+    """The bench's fake engine must keep mirroring SlotPoolEngine's host
+    protocol, or the microbench silently stops modeling production."""
+    bs = _bench_mod()
+    fake = bs.FakeSlotEngine(slots=2, segment=2, max_total=24,
+                             step_s=0.0, dispatch_s=0.0, prefill_s=0.0)
+    real = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    for eng in (fake, real):
+        pos = eng.admit([(0, [1, 2, 3, 4, 5], 4, 0.0, 0)])
+        assert pos[0] == 4            # pow2_at_most(5)
+        eng.run_segment()
+        buf, p = eng.poll()
+        assert buf.shape == (2, 24) and p.shape == (2,)
+        assert int(p[0]) == 6         # 4 + segment, clamped by last=8

@@ -106,3 +106,19 @@ def test_mixed_prompt_lengths_match_separate_runs(params):
                    prompt_lens=lens, prefill_len=3)
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out_a[0]))
     np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(out_b[0]))
+
+
+def test_prefill_past_shortest_prompt_rejected(params):
+    """prefill_len > min(prompt_lens) would feed row padding through the
+    model and poison that row's cache — generate() must reject it eagerly
+    (regression: it used to silently emit garbage for the short row)."""
+    batch = jnp.array([[3, 11, 5, 22, 7], [9, 2, 40, 0, 0]], jnp.int32)
+    lens = jnp.array([5, 3], jnp.int32)
+    with pytest.raises(ValueError, match="exceeds shortest prompt"):
+        generate(CFG, params, batch, max_new_tokens=4,
+                 prompt_lens=lens, prefill_len=4)
+    # a legal prefill (<= shortest) still works and matches solo runs
+    out = generate(CFG, params, batch, max_new_tokens=4,
+                   prompt_lens=lens, prefill_len=2)
+    solo_a = generate(CFG, params, batch[:1, :5], max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(solo_a[0]))

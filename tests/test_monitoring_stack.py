@@ -135,9 +135,11 @@ def test_readme_metric_table_matches_registry():
     readme = os.path.join(os.path.dirname(__file__), "..", "README.md")
     with open(readme, encoding="utf-8") as f:
         text = f.read()
-    assert "## Observability" in text, "README lost its Observability section"
-    section = text.split("## Observability", 1)[1].split("\n## ", 1)[0]
-    documented = set(re.findall(r"^\| `(ko_[a-z0-9_]+)`", section, re.M))
+    documented = set()
+    for heading in ("## Observability", "## Serving"):
+        assert heading in text, f"README lost its {heading} section"
+        section = text.split(heading, 1)[1].split("\n## ", 1)[0]
+        documented |= set(re.findall(r"^\| `(ko_[a-z0-9_]+)`", section, re.M))
     registered = set(REGISTRY.names())
     assert documented == registered, (
         f"README table vs registry drift — undocumented: "

@@ -76,6 +76,71 @@ def test_histogram_buckets_cumulative():
     assert "t_seconds_count 4" in lines
 
 
+def test_summary_quantiles_window_and_render():
+    """The Summary type (round 6): sliding-window p50/p95 with _sum/_count
+    series — BatcherStats' latency semantics, now registry-native."""
+    reg = tm.Registry()
+    s = reg.summary("t_latency_seconds", "help", window=4)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        s.observe(v)
+    assert s.quantile(0.5) == pytest.approx(0.3)
+    s.observe(9.0)                      # 0.1 slides out of the window
+    assert s.count() == 5               # _count is lifetime, not window
+    assert s.quantile(0.95) == pytest.approx(9.0)
+    lines = s.render()
+    assert any(l.startswith('t_latency_seconds{quantile="0.5"}')
+               for l in lines)
+    assert "t_latency_seconds_count 5" in lines
+    assert reg.summary("t_latency_seconds", "help", window=4) is s
+
+
+def test_serve_exposition_golden():
+    """Golden Prometheus text for the ko_serve_* families after a fixed
+    interaction sequence — pins the exposition defects fixed in round 6
+    (batch-size histogram now has +Inf / _count / _sum; every family
+    emits HELP/TYPE from boot) and the name vocabulary monitor.py's
+    PROMQL queries against."""
+    from kubeoperator_tpu.workloads.serving import BatcherStats, _Pending
+
+    stats = BatcherStats(window=8)
+    r = _Pending([1, 2, 3], 5, 0.0, 0)
+    stats.enqueued()
+    stats.executed(3)
+    stats.occupancy(2)
+    stats.ttft(0.004)
+    stats.segment(0.0009)
+    stats.finished(r, ok=True)
+    text = stats.prometheus()
+    for family, typ in (("ko_serve_requests_total", "counter"),
+                        ("ko_serve_errors_total", "counter"),
+                        ("ko_serve_batches_total", "counter"),
+                        ("ko_serve_tokens_generated_total", "counter"),
+                        ("ko_serve_queue_depth", "gauge"),
+                        ("ko_serve_request_latency_seconds", "summary"),
+                        ("ko_serve_batch_size", "histogram"),
+                        ("ko_serve_slot_occupancy", "gauge"),
+                        ("ko_serve_ttft_seconds", "histogram"),
+                        ("ko_serve_segment_duration_seconds", "histogram")):
+        assert f"# TYPE {family} {typ}" in text, family
+    assert "ko_serve_requests_total 1" in text
+    assert "ko_serve_tokens_generated_total 5" in text
+    assert "ko_serve_queue_depth 0" in text
+    assert "ko_serve_slot_occupancy 2" in text
+    # the hand-rolled exposition's defects, pinned fixed: +Inf bucket and
+    # _count/_sum on the batch-size histogram
+    assert 'ko_serve_batch_size_bucket{le="4"} 1' in text
+    assert 'ko_serve_batch_size_bucket{le="+Inf"} 1' in text
+    assert "ko_serve_batch_size_count 1" in text
+    assert "ko_serve_batch_size_sum 3" in text
+    assert 'ko_serve_ttft_seconds_bucket{le="0.005"} 1' in text
+    assert 'ko_serve_segment_duration_seconds_bucket{le="0.001"} 1' in text
+    assert 'ko_serve_request_latency_seconds{quantile="0.95"}' in text
+    # snapshot mirrors: hist values sum to batches_total incl. overflow
+    snap = stats.snapshot()
+    assert sum(snap["batch_size_hist"].values()) == snap["batches_total"]
+    assert snap["slot_occupancy"] == 2
+
+
 def test_concurrent_increments_are_exact():
     """8 writers × 1000 increments under the same thread-pool pressure the
     step fan-out produces — no lost updates."""
