@@ -278,8 +278,9 @@ data:
       {{"title": "Serve latency p95 / tokens rate", "type": "timeseries", "gridPos": {{"x":18,"y":8,"w":6,"h":8}},
         "targets": [{{"expr": "avg(ko_serve_request_latency_seconds{{quantile=\\"0.95\\"}})"}},
                     {{"expr": "sum(rate(ko_serve_tokens_generated_total[5m]))"}}]}},
-      {{"title": "Serve slot occupancy", "type": "timeseries", "gridPos": {{"x":0,"y":16,"w":12,"h":8}},
-        "targets": [{{"expr": "avg(ko_serve_slot_occupancy)"}}]}},
+      {{"title": "Serve slot occupancy (by mesh shard)", "type": "timeseries", "gridPos": {{"x":0,"y":16,"w":12,"h":8}},
+        "targets": [{{"expr": "sum(ko_serve_slot_occupancy)"}},
+                    {{"expr": "sum(ko_serve_slot_occupancy) by (shard)", "legendFormat": "shard {{{{shard}}}}"}}]}},
       {{"title": "Serve TTFT p95", "type": "timeseries", "gridPos": {{"x":12,"y":16,"w":12,"h":8}},
         "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}}]}}
     ]}}

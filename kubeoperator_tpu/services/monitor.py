@@ -64,8 +64,12 @@ PROMQL = {
     "serve_latency_p95":
         'avg(ko_serve_request_latency_seconds{quantile="0.95"})',
     "serve_tokens_rate": "sum(rate(ko_serve_tokens_generated_total[5m]))",
-    # continuous engine (round 6)
-    "serve_slot_occupancy": "avg(ko_serve_slot_occupancy)",
+    # continuous engine (round 6; shard-labeled round 7 — the gauge is one
+    # series per dp mesh shard, so pool-wide occupancy is a sum, and the
+    # per-shard breakdown shows admission imbalance across the mesh)
+    "serve_slot_occupancy": "sum(ko_serve_slot_occupancy)",
+    "serve_slot_occupancy_by_shard":
+        "sum(ko_serve_slot_occupancy) by (shard)",
     "serve_ttft_p95":
         "histogram_quantile(0.95, "
         "sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))",
@@ -268,6 +272,12 @@ class ClusterMonitor:
         serve_rate = prom.scalar(PROMQL["serve_tokens_rate"], default=-1.0)
         serve_slots = prom.scalar(PROMQL["serve_slot_occupancy"],
                                   default=-1.0)
+        try:
+            serve_shards = {
+                r.get("metric", {}).get("shard", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["serve_slot_occupancy_by_shard"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            serve_shards = {}
         serve_ttft = prom.scalar(PROMQL["serve_ttft_p95"], default=-1.0)
         data = {
             "cluster": self.cluster.name,
@@ -286,6 +296,7 @@ class ClusterMonitor:
             "serve_latency_p95": serve_p95,
             "serve_tokens_rate": serve_rate,
             "serve_slot_occupancy": serve_slots,
+            "serve_slot_shards": serve_shards,
             "serve_ttft_p95": serve_ttft,
             "time": iso_now(),
         }

@@ -420,7 +420,9 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             buckets=SERVE_BATCH_BUCKETS),
         "slot_occupancy": registry.gauge(
             "ko_serve_slot_occupancy",
-            "Occupied decode slots in the continuous engine's pool."),
+            "Occupied decode slots in the continuous engine's pool, per "
+            "dp mesh shard (shard=\"0\" when serving single-chip).",
+            labels=("shard",)),
         "ttft": registry.histogram(
             "ko_serve_ttft_seconds",
             "Time from submit to a request's first generated token "

@@ -351,17 +351,32 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.engine == "continuous":
         from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
 
-        engine = SlotPoolEngine(cfg, model_params, slots=args.slots,
-                                segment=args.segment)
+        # --mesh dp:2,tp:4 shards the slot pool over dp and attention
+        # heads over tp (decode_loop); unset or 1 device keeps the solo
+        # path — the engine itself degrades, no branch here
+        mesh_spec = (parse_mesh(args.mesh, jax.device_count())
+                     if args.mesh else None)
+        try:
+            engine = SlotPoolEngine(cfg, model_params, slots=args.slots,
+                                    segment=args.segment,
+                                    mesh_spec=mesh_spec)
+        except ValueError as e:
+            raise SystemExit(f"serve: {e}") from e
         batcher = ContinuousBatcher(engine, stats=stats)
         # ONE compile to warm: every request shape shares the same segment
         # dispatch (per-slot vectors, not bucketed dims), and prefill runs
         # eager — so a single empty-pool segment is full warm-up. --warm
         # triples are accepted for CLI compatibility but moot here.
         emit({"job": "serve", "engine": "continuous",
-              "slots": args.slots, "segment": args.segment})
+              "slots": args.slots, "segment": args.segment,
+              "mesh": (dict(engine.spec.sizes())
+                       if engine.spec is not None else None)})
         engine.run_segment()
     else:
+        if args.mesh:
+            raise SystemExit(
+                "--mesh requires --engine continuous (the dynamic engine "
+                "is single-chip)")
         def run_batch(prompts, lens, max_new, temp, prefill, seed):
             b = _pow2_at_least(len(prompts))
             # pad the batch dim to its bucket with duplicate rows (cheap;
@@ -666,6 +681,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="continuous engine: persistent decode slots")
     sv.add_argument("--segment", type=int, default=8,
                     help="continuous engine: tokens per device dispatch")
+    sv.add_argument("--mesh", type=str, default=None,
+                    help="continuous engine: shard the pool, e.g. "
+                         "'dp:2,tp:4' — slots over dp, attention heads "
+                         "over tp (default: solo single-device path)")
 
     pp = sub.add_parser("pipeline",
                         help="device-pipelined training over a pp mesh axis")

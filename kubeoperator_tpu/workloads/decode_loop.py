@@ -37,6 +37,19 @@ buffer): masked softmax positions contribute exactly 0.0, a frozen row
 rewrites the same cache entry with the same value, and a stale cache
 entry from a slot's previous occupant is always overwritten (at ``pos``)
 before the mask first exposes it — so garbage never reaches live rows.
+
+Multi-chip (round 7): pass a dp×tp ``MeshSpec`` and the same pool runs
+sharded over a device mesh — the slot axis S splits over ``dp`` (each
+device group owns S/dp independent rows: pure data parallel, no
+cross-slot math exists), attention heads split over ``tp`` (megatron
+column/row splits via ``sharding.shard_params_decode_tp``; GSPMD inserts
+one all-reduce per attention block and one per MLP). The host protocol is
+layout-agnostic: admission's chunked-prefill scratch, the slot-region
+writes, and ``poll()``'s batched fetch all route through the same
+``NamedSharding``s (``_pin``), so ``ContinuousBatcher`` drives a 1-device
+and an 8-device pool identically and greedy tokens stay bit-identical to
+the solo engine per shard layout (pinned on a 2×4 host mesh in
+tests/test_continuous.py). A 1-device spec degrades to the solo path.
 """
 
 from __future__ import annotations
@@ -48,9 +61,13 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeoperator_tpu.workloads.generate import (
     attn_out_mlp, final_logits, rms_norm, token_qkv,
+)
+from kubeoperator_tpu.workloads.sharding import (
+    MeshSpec, build_mesh, shard_params_decode_tp,
 )
 from kubeoperator_tpu.workloads.transformer import (
     Transformer, TransformerConfig,
@@ -62,6 +79,39 @@ def _pow2_at_most(n: int) -> int:
     while v * 2 <= n:
         v *= 2
     return v
+
+
+def donation_argnums(platform: str) -> tuple[int, ...]:
+    """Segment-dispatch donation (buf, pos, caches — argnums 0, 1, 6) for
+    the platform the engine's buffers actually LIVE on. Decided from
+    placement, not ``jax.default_backend()``: an engine built on a CPU
+    mesh while a TPU backend is default (or vice versa) must follow its
+    own devices — CPU's partial donation support warns and falls back,
+    and a wrongly-undonated TPU pool doubles its HBM footprint."""
+    return () if platform == "cpu" else (0, 1, 6)
+
+
+def validate_serve_mesh(spec: MeshSpec, *, slots: int, n_heads: int) -> None:
+    """Reject un-shardable serving layouts up front with actionable
+    errors instead of letting GSPMD fail mid-compile with an opaque
+    partition error. The serving pool shards exactly two ways: the slot
+    axis S over dp, attention heads over tp."""
+    extra = {n: s for n, s in spec.sizes()
+             if n not in ("dp", "tp") and s > 1}
+    if extra:
+        raise ValueError(
+            f"serving mesh shards slots over dp and heads over tp only; "
+            f"got {', '.join(f'{n}={s}' for n, s in extra.items())} "
+            f"(use --mesh dp:N,tp:M)")
+    if slots % spec.dp:
+        raise ValueError(
+            f"slots ({slots}) must be divisible by dp ({spec.dp}): the "
+            f"slot axis shards over dp, so each shard owns slots/dp rows")
+    if n_heads % spec.tp:
+        raise ValueError(
+            f"n_heads ({n_heads}) must be divisible by tp ({spec.tp}): "
+            f"attention heads shard over tp, so each shard owns "
+            f"n_heads/tp heads")
 
 
 def _rope_rows(x: jnp.ndarray, pos: jnp.ndarray,
@@ -101,7 +151,9 @@ class SlotPoolEngine:
     """
 
     def __init__(self, cfg: TransformerConfig, params: Any, *,
-                 slots: int = 16, segment: int = 8, mesh: Any = None):
+                 slots: int = 16, segment: int = 8, mesh: Any = None,
+                 mesh_spec: MeshSpec | None = None,
+                 devices: Sequence[Any] | None = None):
         if cfg.moe_experts != 0 or not cfg.scan_layers:
             raise ValueError(
                 "SlotPoolEngine requires scan_layers=True and no MoE "
@@ -115,27 +167,79 @@ class SlotPoolEngine:
         self._decode_cfg = replace(cfg, decode=True, remat=False)
         self._model = Transformer(self._decode_cfg, mesh=mesh)
         self._params = nn.unbox(params)
+
+        # -- mesh placement (dp shards slots, tp shards heads) --------------
+        # A 1-device spec degrades to the solo path: no mesh, no shardings,
+        # no collectives — the same engine object at any scale.
+        self.spec = mesh_spec if (mesh_spec is not None
+                                  and mesh_spec.n_devices > 1) else None
+        if self.spec is not None:
+            validate_serve_mesh(self.spec, slots=self.slots,
+                                n_heads=cfg.n_heads)
+            self.mesh = build_mesh(self.spec, devices)
+            dp_ax = "dp" if "dp" in self.mesh.axis_names else None
+            tp_ax = "tp" if "tp" in self.mesh.axis_names else None
+            self._buf_sh = NamedSharding(self.mesh, P(dp_ax, None))
+            self._vec_sh = NamedSharding(self.mesh, P(dp_ax))
+            self._cache_sh = NamedSharding(self.mesh,
+                                           P(dp_ax, None, tp_ax, None))
+            # scratch prefill cache [L, k, C, H, D]: the admission group k
+            # is not slot-aligned, so only heads shard
+            self._scratch_sh = NamedSharding(
+                self.mesh, P(None, None, None, tp_ax, None))
+            self._params = jax.device_put(
+                self._params, shard_params_decode_tp(self._params, self.mesh))
+        else:
+            self.mesh = None
+            self._buf_sh = self._vec_sh = None
+            self._cache_sh = self._scratch_sh = None
+        self.dp = self.spec.dp if self.spec is not None else 1
+
         self._emb = self._params["embedding"]
         self._layers = [jax.tree.map(lambda x: x[l], self._params["layers"])
                         for l in range(cfg.n_layers)]
 
         s, t = self.slots, self.max_total
         h, d, dt = cfg.n_heads, cfg.head_dim, cfg.dtype
-        self._buf = jnp.zeros((s, t), jnp.int32)
-        self._pos = jnp.zeros((s,), jnp.int32)
-        self._last = jnp.zeros((s,), jnp.int32)    # final token index; empty=0
-        self._plen = jnp.ones((s,), jnp.int32)
-        self._temp = jnp.zeros((s,), jnp.float32)
-        self._seeds = jnp.zeros((s,), jnp.int32)
-        self._caches = [(jnp.zeros((s, t, h, d), dt),
-                         jnp.zeros((s, t, h, d), dt))
+        self._buf = self._pin(jnp.zeros((s, t), jnp.int32), self._buf_sh)
+        self._pos = self._pin(jnp.zeros((s,), jnp.int32), self._vec_sh)
+        # final token index; empty=0
+        self._last = self._pin(jnp.zeros((s,), jnp.int32), self._vec_sh)
+        self._plen = self._pin(jnp.ones((s,), jnp.int32), self._vec_sh)
+        self._temp = self._pin(jnp.zeros((s,), jnp.float32), self._vec_sh)
+        self._seeds = self._pin(jnp.zeros((s,), jnp.int32), self._vec_sh)
+        self._caches = [(self._pin(jnp.zeros((s, t, h, d), dt),
+                                   self._cache_sh),
+                         self._pin(jnp.zeros((s, t, h, d), dt),
+                                   self._cache_sh))
                         for _ in range(cfg.n_layers)]
         # buf/pos/caches are dead after each segment — donate them so XLA
         # updates in place (CPU's donation support is partial and warns;
         # skip there). last/plen/temp/seeds stay live host-side (admit
         # rewrites them between segments), so they must NOT be donated.
-        donate = (0, 1, 6) if jax.default_backend() != "cpu" else ()
-        self._seg_fn = jax.jit(self._segment_body, donate_argnums=donate)
+        # Decided from the devices the pool is PLACED on, not the default
+        # backend (donation_argnums).
+        place = (self.mesh.devices.flat[0] if self.mesh is not None
+                 else jax.devices()[0])
+        self._donate = donation_argnums(
+            getattr(place, "platform", jax.default_backend()))
+        out_sh = None
+        if self.mesh is not None:
+            # pin the dispatch's output layouts to the canonical shardings
+            # so the pool's layout is stable across segments (donation
+            # needs matching in/out placements; GSPMD must not re-layout)
+            out_sh = (self._buf_sh, self._vec_sh,
+                      [(self._cache_sh, self._cache_sh)
+                       for _ in range(cfg.n_layers)])
+        self._seg_fn = jax.jit(
+            self._segment_body, donate_argnums=self._donate,
+            **({"out_shardings": out_sh} if out_sh is not None else {}))
+
+    def _pin(self, x: jnp.ndarray, sh: NamedSharding | None) -> jnp.ndarray:
+        """Place one pool buffer on its canonical sharding (identity on
+        the solo path). Admission routes every host-side rewrite back
+        through this, so the segment jit always sees one layout."""
+        return x if sh is None else jax.device_put(x, sh)
 
     # -- device math --------------------------------------------------------
     def _micro_step(self, buf, pos, last, plen, temp, seeds, caches):
@@ -159,6 +263,12 @@ class SlotPoolEngine:
             # effect, and cheaper than masking the write.
             ck = ck.at[rows, pos].set(k[:, 0].astype(dt))
             cv = cv.at[rows, pos].set(v[:, 0].astype(dt))
+            if self._cache_sh is not None:
+                # keep the pool layout pinned through the scan: slots over
+                # dp, heads over tp — GSPMD then partitions the scatter and
+                # the attention einsums in place instead of re-laying-out
+                ck = jax.lax.with_sharding_constraint(ck, self._cache_sh)
+                cv = jax.lax.with_sharding_constraint(cv, self._cache_sh)
             new_caches.append((ck, cv))
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                 preferred_element_type=jnp.float32) * scale
@@ -238,10 +348,12 @@ class SlotPoolEngine:
         # decode branch masks to the cache width) — the full prompt prefix
         # in one MXU-shaped pass instead of C token dispatches
         scratch = {"layers": {"attn": {
-            "cached_k": jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
-                                   cfg.head_dim), cfg.dtype),
-            "cached_v": jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
-                                   cfg.head_dim), cfg.dtype)}}}
+            "cached_k": self._pin(
+                jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
+                           cfg.head_dim), cfg.dtype), self._scratch_sh),
+            "cached_v": self._pin(
+                jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
+                           cfg.head_dim), cfg.dtype), self._scratch_sh)}}}
         logits, mutated = self._model.apply(
             {"params": self._params, "cache": scratch}, jnp.asarray(chunk),
             jnp.arange(c, dtype=jnp.int32), mutable=["cache"])
@@ -250,8 +362,12 @@ class SlotPoolEngine:
         idx = jnp.asarray(slots_np)
         new_caches = []
         for l, (ck, cv) in enumerate(self._caches):
-            new_caches.append((ck.at[idx, :c].set(chunk_k[l]),
-                               cv.at[idx, :c].set(chunk_v[l])))
+            # re-pin after the host-side scatter: admission writes arrive
+            # from the (tp-only) scratch layout, and the segment jit's
+            # donated inputs must keep the canonical dp×tp placement
+            new_caches.append(
+                (self._pin(ck.at[idx, :c].set(chunk_k[l]), self._cache_sh),
+                 self._pin(cv.at[idx, :c].set(chunk_v[l]), self._cache_sh)))
         self._caches = new_caches
 
         out: dict[int, int] = {}
@@ -281,8 +397,12 @@ class SlotPoolEngine:
             temp_v = temp_v.at[slot].set(temperature)
             seeds_v = seeds_v.at[slot].set(seed)
             out[slot] = c
-        self._buf, self._pos, self._last = buf, pos, last
-        self._plen, self._temp, self._seeds = plen_v, temp_v, seeds_v
+        self._buf = self._pin(buf, self._buf_sh)
+        self._pos = self._pin(pos, self._vec_sh)
+        self._last = self._pin(last, self._vec_sh)
+        self._plen = self._pin(plen_v, self._vec_sh)
+        self._temp = self._pin(temp_v, self._vec_sh)
+        self._seeds = self._pin(seeds_v, self._vec_sh)
         return out
 
     def run_segment(self) -> None:

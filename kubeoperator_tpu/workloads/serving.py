@@ -133,8 +133,10 @@ class BatcherStats:
         self._m["latency"].observe(time.monotonic() - req.submitted_at)
 
     # -- continuous-engine hooks -------------------------------------------
-    def occupancy(self, slots_busy: int) -> None:
-        self._m["slot_occupancy"].set(slots_busy)
+    def occupancy(self, slots_busy: int, shard: int | str = 0) -> None:
+        """Occupied slots on one dp mesh shard (shard 0 is the whole pool
+        when serving single-chip)."""
+        self._m["slot_occupancy"].set(slots_busy, shard=str(shard))
 
     def ttft(self, seconds: float) -> None:
         self._m["ttft"].observe(seconds)
@@ -155,7 +157,9 @@ class BatcherStats:
             "batches_total": int(self._m["batches"].value()),
             "tokens_generated_total": int(self._m["tokens"].value()),
             "queue_depth": int(self._m["queue_depth"].value()),
-            "slot_occupancy": int(self._m["slot_occupancy"].value()),
+            # summed over dp shards: the pool-wide busy count
+            "slot_occupancy": int(sum(
+                self._m["slot_occupancy"].samples().values())),
             "batch_size_hist": batch_hist,
             "latency_p50_s": round(self._m["latency"].quantile(0.50), 4),
             "latency_p95_s": round(self._m["latency"].quantile(0.95), 4),
@@ -315,6 +319,11 @@ class ContinuousBatcher:
         self._queue: deque[_Pending] = deque()
         self._track: dict[int, dict] = {}       # slot -> in-flight state
         self._free = list(range(engine.slots))
+        # slot s lives on dp shard s // (slots/dp): the engine shards the
+        # slot axis over dp in contiguous blocks (decode_loop), so
+        # occupancy can be reported per shard without device reads
+        self._dp = max(1, int(getattr(engine, "dp", 1)))
+        self._shard_slots = engine.slots // self._dp
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ko-serve-continuous")
         self._worker.start()
@@ -348,6 +357,13 @@ class ContinuousBatcher:
         return req.result
 
     # -- worker side -------------------------------------------------------
+    def _report_occupancy(self) -> None:
+        busy = [0] * self._dp
+        for s in self._track:
+            busy[s // self._shard_slots] += 1
+        for shard, n in enumerate(busy):
+            self.stats.occupancy(n, shard=shard)
+
     def _loop(self) -> None:
         while True:
             with self._cond:
@@ -377,7 +393,7 @@ class ContinuousBatcher:
                     self.stats.ttft(now() - r.submitted_at)
                     t["ttft"] = True
                 self._track[slot] = t
-            self.stats.occupancy(len(self._track))
+            self._report_occupancy()
 
         active = [s for s, t in self._track.items() if t["pos"] < t["last"]]
         if active:
@@ -405,7 +421,7 @@ class ContinuousBatcher:
                 r.done.set()
             with self._cond:
                 self._free.extend(done)
-            self.stats.occupancy(len(self._track))
+            self._report_occupancy()
 
     def _fail_all(self, admit_now: list[tuple[int, _Pending]],
                   err: Exception) -> None:
@@ -422,4 +438,4 @@ class ContinuousBatcher:
                 r.error = err
                 self.stats.finished(r, ok=False)
                 r.done.set()
-        self.stats.occupancy(0)
+        self._report_occupancy()

@@ -225,3 +225,47 @@ def shard_params_fsdp(params: Any, mesh: Mesh, spec: MeshSpec, min_size: int = 2
     annotations; XLA inserts all-gathers next to use and reduce-scatters next
     to the gradient — exactly the ZeRO-3 schedule."""
     return jax.tree.map(lambda x: place_by_shape(x, mesh, spec, min_size), params)
+
+
+def shard_params_decode_tp(params: Any, mesh: Mesh) -> Any:
+    """Megatron tensor-parallel placement for the decode engines' stacked
+    param tree (``generate._decode_scan`` / ``decode_loop.SlotPoolEngine``
+    layout: ``layers/*`` carries a leading scan axis L).
+
+    Column-split the head axis of q/k/v and the fan-out of gate/up, row-
+    split o and down — the contractions over heads (``bqhd,hde->bqe``) and
+    over d_ff (``bqf,fd->bqd``) then carry GSPMD-inserted all-reduces,
+    one per attention block and one per MLP, exactly the megatron
+    schedule. Everything else (norm scales, embedding, tied logits)
+    replicates, keeping the vocab matmul — and therefore sampling —
+    layout-independent. Returns a ``NamedSharding`` pytree for
+    ``jax.device_put``; with no ``tp`` axis in the mesh it degrades to
+    full replication (same code at any scale, like ``logical_axis_rules``).
+    """
+    if "tp" not in mesh.axis_names:
+        return jax.tree.map(lambda _: replicated(mesh), params)
+
+    # (path suffix) -> partition spec; paths are the decode param layout,
+    # shapes stacked over layers: qkv [L,d,3,H,K], split q/k/v [L,d,H,K],
+    # o [L,H,K,d], gate/up [L,d,f], down [L,f,d]
+    rules: tuple[tuple[tuple[str, ...], P], ...] = (
+        (("attn", "qkv", "kernel"), P(None, None, None, "tp", None)),
+        (("attn", "q", "kernel"), P(None, None, "tp", None)),
+        (("attn", "k", "kernel"), P(None, None, "tp", None)),
+        (("attn", "v", "kernel"), P(None, None, "tp", None)),
+        (("attn", "o", "kernel"), P(None, "tp", None, None)),
+        (("mlp", "gate", "kernel"), P(None, None, "tp")),
+        (("mlp", "up", "kernel"), P(None, None, "tp")),
+        (("mlp", "down", "kernel"), P(None, "tp", None)),
+    )
+
+    def place(path, x) -> NamedSharding:
+        keys = tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                     for p in path)
+        for suffix, pspec in rules:
+            if keys[-len(suffix):] == suffix and len(
+                    getattr(x, "shape", ())) == len(pspec):
+                return NamedSharding(mesh, pspec)
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -20,10 +20,20 @@ their own length and admissions landing between segments. The tier-1 test
 (tests/test_continuous.py) enforces >=1.5x aggregate tok/s on this same
 shape; this script is for poking at the trade-offs interactively.
 
+``--scaling`` (round 7) swaps the A/B for a 1→2→4→8-device dp×tp mesh
+curve on the same trace and cost model: the pool is slots×dp rows, tp
+divides per-token work (heads shard), and each dispatch pays an injected
+``--collective`` per all-reduce hop. ``--real`` additionally runs the
+real sharded engine on available JAX devices (gated); ``--out`` writes a
+MULTICHIP-style JSON artifact. tests/test_continuous.py pins ≥1.5x
+aggregate new-tok/s at 8 devices vs 1 on this model.
+
 Usage:
     python scripts/bench_serving.py [--requests 48] [--slots 16]
         [--segment 8] [--max-batch 16] [--step 0.001] [--dispatch 0.003]
         [--prefill 0.002] [--stagger 0.005]
+    python scripts/bench_serving.py --scaling [--collective 0.0002]
+        [--real] [--out MULTICHIP_serving_r01.json]
 """
 
 from __future__ import annotations
@@ -77,14 +87,28 @@ class FakeSlotEngine:
     """SlotPoolEngine's host protocol over numpy + injected latency —
     the continuous side of the cost model (one ``dispatch + K * step``
     sleep per segment, one ``dispatch + prefill`` sleep per admission
-    prefill bucket)."""
+    prefill bucket).
+
+    Mesh shapes (round 7): ``dp``/``tp`` mirror the sharded engine's cost
+    structure — the slot pool is ``slots`` TOTAL rows (the caller scales
+    it by dp, as `--mesh` users scale `--slots`), per-token work divides
+    by tp (heads shard), and every dispatch pays ``collective × log2(n)``
+    for the all-reduces GSPMD inserts (one hop per doubling). dp=tp=1
+    with collective 0 is exactly the r5/r6 single-chip model.
+    """
 
     def __init__(self, *, slots: int = 16, segment: int = 8,
                  max_total: int = 2048, step_s: float = 0.001,
-                 dispatch_s: float = 0.003, prefill_s: float = 0.002):
+                 dispatch_s: float = 0.003, prefill_s: float = 0.002,
+                 dp: int = 1, tp: int = 1, collective_s: float = 0.0):
+        if slots % dp:
+            raise ValueError(f"slots ({slots}) must be divisible by dp ({dp})")
         self.slots, self.segment, self.max_total = slots, segment, max_total
         self.step_s, self.dispatch_s, self.prefill_s = (
             step_s, dispatch_s, prefill_s)
+        self.dp, self.tp = dp, tp
+        # log2(n) all-reduce hops per dispatch; 0 when n_devices == 1
+        self._link_s = collective_s * (dp * tp - 1).bit_length()
         self.buf = np.zeros((slots, max_total), np.int32)
         self.pos = np.zeros((slots,), np.int32)
         self.last = np.zeros((slots,), np.int32)
@@ -98,7 +122,8 @@ class FakeSlotEngine:
                 (slot, prompt, int(max_tokens)))
         out = {}
         for c, group in by_c.items():
-            time.sleep(self.dispatch_s + self.prefill_s)
+            time.sleep(self.dispatch_s + self._link_s
+                       + self.prefill_s / self.tp)
             self.dispatches += 1
             for slot, prompt, max_tokens in group:
                 total = len(prompt) + max_tokens
@@ -110,7 +135,8 @@ class FakeSlotEngine:
         return out
 
     def run_segment(self):
-        time.sleep(self.dispatch_s + self.segment * self.step_s)
+        time.sleep(self.dispatch_s + self._link_s
+                   + self.segment * self.step_s / self.tp)
         self.dispatches += 1
         active = self.pos < self.last
         self.pos = np.where(active,
@@ -201,6 +227,84 @@ def bench(requests: int, slots: int, segment: int, max_batch: int,
     }
 
 
+# 1 → 2 → 4 → 8 devices: dp first (slot capacity is what the r5 trace is
+# starved of at 16 slots), then fold in tp once the pool covers the trace
+SCALING_SHAPES = ((1, 1), (2, 1), (2, 2), (4, 2))
+
+
+def bench_scaling(requests: int, slots: int, segment: int, step_s: float,
+                  dispatch_s: float, prefill_s: float, stagger_s: float,
+                  collective_s: float, max_total: int = 2048,
+                  shapes=SCALING_SHAPES) -> dict:
+    """Aggregate new-tok/s for the continuous engine per dp×tp mesh shape
+    on the injected-latency cost model, same r5-shaped trace throughout.
+    ``--slots`` is per-shard: the pool is slots×dp rows, as on real
+    meshes where every dp shard brings its own HBM."""
+    trace = make_trace(requests)
+    curve = []
+    for dp, tp in shapes:
+        cont = ContinuousBatcher(FakeSlotEngine(
+            slots=slots * dp, segment=segment, max_total=max_total,
+            step_s=step_s, dispatch_s=dispatch_s, prefill_s=prefill_s,
+            dp=dp, tp=tp, collective_s=collective_s))
+        r = run_load(cont, trace, stagger_s)
+        curve.append({"n_devices": dp * tp, "dp": dp, "tp": tp,
+                      "slots": slots * dp, "wall_s": round(r["wall_s"], 3),
+                      "tok_s": round(r["tok_s"], 1)})
+    base = curve[0]["tok_s"]
+    return {
+        "requests": requests,
+        "tokens": sum(mt for _, mt in trace),
+        "curve": curve,
+        "speedup_max_devices": round(curve[-1]["tok_s"] / base, 2),
+    }
+
+
+def bench_scaling_real(shapes=SCALING_SHAPES) -> dict:
+    """Gated real-device path: the sharded SlotPoolEngine itself per mesh
+    shape, on whatever devices JAX has (8 virtual CPU devices under the
+    test harness, a real slice on TPU). Wall times here measure the host
+    + compiler, not ICI — the cost model above is the tracked curve."""
+    import jax
+
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+    from kubeoperator_tpu.workloads.sharding import MeshSpec
+    from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=64)
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.workloads.transformer import Transformer
+
+    params = nn.unbox(Transformer(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    curve = []
+    for dp, tp in shapes:
+        n = dp * tp
+        if n > jax.device_count():
+            curve.append({"n_devices": n, "dp": dp, "tp": tp,
+                          "skipped": f"only {jax.device_count()} devices"})
+            continue
+        spec = MeshSpec(dp=dp, tp=tp) if n > 1 else None
+        eng = SlotPoolEngine(cfg, params, slots=4 * dp, segment=8,
+                             mesh_spec=spec,
+                             devices=jax.devices()[:n] if n > 1 else None)
+        eng.admit([(s, [1 + s, 2, 3, 4], 24, 0.0, 0)
+                   for s in range(4 * dp)])
+        eng.run_segment()          # compile outside the timed window
+        t0 = time.perf_counter()
+        for _ in range(3):
+            eng.run_segment()
+        wall = time.perf_counter() - t0
+        new_tok = 3 * 8 * 4 * dp
+        curve.append({"n_devices": n, "dp": dp, "tp": tp,
+                      "wall_s": round(wall, 3),
+                      "tok_s": round(new_tok / wall, 1)})
+    return {"device_kind": jax.devices()[0].platform, "curve": curve}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=48)
@@ -216,10 +320,46 @@ def main() -> None:
                     help="injected cost per prefill pass")
     ap.add_argument("--stagger", type=float, default=0.002,
                     help="client arrival spacing in seconds")
+    ap.add_argument("--scaling", action="store_true",
+                    help="1→2→4→8-device mesh scaling curve (cost model) "
+                         "instead of the dynamic-vs-continuous A/B")
+    ap.add_argument("--collective", type=float, default=0.0002,
+                    help="scaling mode: injected cost per all-reduce hop")
+    ap.add_argument("--real", action="store_true",
+                    help="scaling mode: also run the real sharded engine "
+                         "on available JAX devices (gated: shapes that "
+                         "don't fit are marked skipped)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write a MULTICHIP-style JSON artifact here")
     args = ap.parse_args()
-    print(json.dumps(bench(args.requests, args.slots, args.segment,
-                           args.max_batch, args.step, args.dispatch,
-                           args.prefill, args.stagger)))
+    if args.scaling:
+        result = bench_scaling(args.requests, args.slots, args.segment,
+                               args.step, args.dispatch, args.prefill,
+                               args.stagger, args.collective)
+        if args.real:
+            result["real"] = bench_scaling_real()
+        print(json.dumps(result))
+        if args.out:
+            tail = "\n".join(
+                f"dp={p['dp']} tp={p['tp']} n={p['n_devices']} "
+                f"slots={p['slots']} tok_s={p['tok_s']}"
+                for p in result["curve"])
+            artifact = {
+                "n_devices": result["curve"][-1]["n_devices"],
+                "rc": 0,
+                "ok": result["speedup_max_devices"] >= 1.5,
+                "skipped": False,
+                "speedup_max_devices": result["speedup_max_devices"],
+                "curve": result["curve"],
+                "tail": tail,
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+    else:
+        print(json.dumps(bench(args.requests, args.slots, args.segment,
+                               args.max_batch, args.step, args.dispatch,
+                               args.prefill, args.stagger)))
 
 
 if __name__ == "__main__":

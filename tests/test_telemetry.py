@@ -106,7 +106,8 @@ def test_serve_exposition_golden():
     r = _Pending([1, 2, 3], 5, 0.0, 0)
     stats.enqueued()
     stats.executed(3)
-    stats.occupancy(2)
+    stats.occupancy(2)             # defaults to shard 0 (single-chip)
+    stats.occupancy(1, shard=1)    # a second dp shard labels its own series
     stats.ttft(0.004)
     stats.segment(0.0009)
     stats.finished(r, ok=True)
@@ -125,7 +126,8 @@ def test_serve_exposition_golden():
     assert "ko_serve_requests_total 1" in text
     assert "ko_serve_tokens_generated_total 5" in text
     assert "ko_serve_queue_depth 0" in text
-    assert "ko_serve_slot_occupancy 2" in text
+    assert 'ko_serve_slot_occupancy{shard="0"} 2' in text
+    assert 'ko_serve_slot_occupancy{shard="1"} 1' in text
     # the hand-rolled exposition's defects, pinned fixed: +Inf bucket and
     # _count/_sum on the batch-size histogram
     assert 'ko_serve_batch_size_bucket{le="4"} 1' in text
@@ -138,7 +140,7 @@ def test_serve_exposition_golden():
     # snapshot mirrors: hist values sum to batches_total incl. overflow
     snap = stats.snapshot()
     assert sum(snap["batch_size_hist"].values()) == snap["batches_total"]
-    assert snap["slot_occupancy"] == 2
+    assert snap["slot_occupancy"] == 3     # summed over dp shards
 
 
 def test_concurrent_increments_are_exact():
