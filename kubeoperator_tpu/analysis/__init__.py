@@ -1,0 +1,27 @@
+"""Static analysis for the hot path and the control plane.
+
+``ko lint`` (see :mod:`kubeoperator_tpu.analysis.cli`) runs the AST rule
+families in :mod:`rules_jax` (KO1xx — host sync in loops, donation
+misuse, retrace hazards, closure capture, unpinned sharded writes) and
+:mod:`rules_control` (KO2xx — unguarded shared-state writes, undeclared
+metric names) plus the project-scoped drift checks in :mod:`project`
+(README↔registry, README↔rule-table, catalog schema).
+:mod:`compile_guard` is the runtime counterpart used by tier-1 to pin
+compiles per shape signature.
+"""
+
+from kubeoperator_tpu.analysis.compile_guard import (
+    CompileCountGuard, compile_count_guard,
+)
+from kubeoperator_tpu.analysis.core import (
+    Finding, LintResult, RULES, SEVERITIES, lint_file, lint_paths,
+    severity_at_least,
+)
+from kubeoperator_tpu.analysis import (  # noqa: F401  (rule registration)
+    project, rules_control, rules_jax,
+)
+
+__all__ = [
+    "CompileCountGuard", "compile_count_guard", "Finding", "LintResult",
+    "RULES", "SEVERITIES", "lint_file", "lint_paths", "severity_at_least",
+]

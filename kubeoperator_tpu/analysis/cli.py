@@ -1,0 +1,86 @@
+"""``ko lint`` / ``ko-lint`` / ``python -m kubeoperator_tpu.analysis.cli``.
+
+Exit status: 0 when no finding reaches ``--fail-level`` (default
+``warning``), 1 otherwise, 2 on usage errors. ``--json`` emits the
+machine-readable report (schema version 1) consumed by scripts/
+lint_gate.sh and CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kubeoperator_tpu.analysis.core import (
+    RULES, SEVERITIES, _ensure_rules, lint_paths, severity_at_least,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ko lint",
+        description="static hot-path and control-plane analyzer")
+    p.add_argument("paths", nargs="*", default=["kubeoperator_tpu"],
+                   help="files or directories to lint "
+                        "(default: kubeoperator_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the JSON report instead of text")
+    p.add_argument("--fail-level", choices=SEVERITIES, default="warning",
+                   help="exit non-zero when a finding reaches this "
+                        "severity (default: warning)")
+    p.add_argument("--select", action="append", default=None,
+                   metavar="RULES",
+                   help="comma-separated rule ids to run (repeatable); "
+                        "default: all")
+    p.add_argument("--no-project", action="store_true",
+                   help="skip project-scoped rules (README drift, "
+                        "catalog schema)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def list_rules(out=sys.stdout) -> None:
+    _ensure_rules()
+    for rid in sorted(RULES):
+        rule = RULES[rid]
+        scope = "project" if getattr(rule, "project_scope", False) \
+            else "module"
+        out.write(f"{rid}  {rule.severity:<7}  {scope:<7}  {rule.title}\n")
+
+
+def run_lint(argv: list[str] | None = None, out=sys.stdout) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        list_rules(out)
+        return 0
+    select = None
+    if args.select:
+        select = {r.strip() for chunk in args.select
+                  for r in chunk.split(",") if r.strip()}
+    result = lint_paths(args.paths, select=select,
+                        project=not args.no_project)
+    if args.as_json:
+        out.write(result.to_json() + "\n")
+    else:
+        for f in result.findings:
+            out.write(f.format() + "\n")
+        counts = result.counts()
+        summary = ", ".join(f"{counts[s]} {s}" for s in reversed(SEVERITIES))
+        out.write(f"{len(result.findings)} finding(s) ({summary}); "
+                  f"{result.suppressed} suppressed; "
+                  f"{result.files} file(s) checked\n")
+    gate = [f for f in result.findings
+            if severity_at_least(f.severity, args.fail_level)]
+    return 1 if gate else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        return run_lint(argv)
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,131 @@
+"""Runtime complement to the static pass: a compile-count guard.
+
+The static rules can only point at *likely* retrace hazards; this guard
+measures the real thing. Inside ``with compile_count_guard() as guard:``
+every function handed to ``jax.jit`` is wrapped so the guard observes
+each trace event (JAX calls the wrapped Python function exactly once per
+trace) together with the shape signature of the triggering call. Tier-1
+pins the serving segment fn and the train step to **one** compile per
+shape signature with :meth:`CompileCountGuard.assert_single_compile` —
+a second trace for a signature already seen is precisely the silent
+retrace that erodes MFU without failing a test.
+
+Trace events are counted rather than executable-cache sizes so the guard
+stays meaningful under the persistent compilation cache (tests pin
+``jax_compilation_cache_dir``): a cache hit still traces, and a retrace
+bug still retraces.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+Signature = tuple[str, str, tuple]
+
+
+def _describe(leaf: Any) -> Any:
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return (tuple(leaf.shape), str(leaf.dtype))
+    return type(leaf).__name__
+
+
+class CompileCountGuard:
+    """Context manager monkeypatching ``jax.jit``; jits created while the
+    guard is active report one count per (function name, shape signature)
+    trace event into :attr:`counts`."""
+
+    def __init__(self) -> None:
+        self.counts: dict[Signature, int] = {}
+        self._orig_jit = None
+        self._tracing = False
+
+    # -- context protocol ---------------------------------------------------
+    def __enter__(self) -> "CompileCountGuard":
+        import jax
+
+        self._orig_jit = jax.jit
+        jax.jit = self._counting_jit
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        import jax
+
+        jax.jit = self._orig_jit
+        self._orig_jit = None
+
+    # -- the patched jit ----------------------------------------------------
+    def _counting_jit(self, fun=None, *jit_args: Any, **jit_kwargs: Any):
+        if fun is None:        # @jax.jit(static_argnums=...) decorator form
+            def deco(f):
+                return self._counting_jit(f, *jit_args, **jit_kwargs)
+            return deco
+        name = getattr(fun, "__name__", repr(fun))
+
+        def traced(*args: Any, **kwargs: Any):
+            self._tracing = True
+            return fun(*args, **kwargs)
+
+        traced.__name__ = name
+        traced.__qualname__ = getattr(fun, "__qualname__", name)
+        jitted = self._orig_jit(traced, *jit_args, **jit_kwargs)
+
+        @functools.wraps(fun)
+        def call(*args: Any, **kwargs: Any):
+            was = self._tracing
+            self._tracing = False
+            try:
+                out = jitted(*args, **kwargs)
+                if self._tracing:
+                    sig = self._signature(name, args, kwargs)
+                    self.counts[sig] = self.counts.get(sig, 0) + 1
+                return out
+            finally:
+                self._tracing = was
+
+        call._ko_compile_guard = self
+        call._ko_jitted = jitted        # escape hatch: .lower() etc.
+        return call
+
+    @staticmethod
+    def _signature(name: str, args: tuple, kwargs: dict) -> Signature:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (name, str(treedef), tuple(_describe(x) for x in leaves))
+
+    # -- reporting ----------------------------------------------------------
+    def traces_for(self, name: str) -> list[int]:
+        """Per-signature trace counts for one function name."""
+        return [c for (n, _, _), c in sorted(self.counts.items())
+                if n == name]
+
+    def total(self, name: str | None = None) -> int:
+        return sum(c for (n, _, _), c in self.counts.items()
+                   if name is None or n == name)
+
+    def by_function(self) -> dict[str, dict[str, int]]:
+        """name -> {'signatures': distinct shape sigs, 'traces': total} —
+        the shape recorded into bench artifacts."""
+        out: dict[str, dict[str, int]] = {}
+        for (n, _, _), c in self.counts.items():
+            slot = out.setdefault(n, {"signatures": 0, "traces": 0})
+            slot["signatures"] += 1
+            slot["traces"] += c
+        return out
+
+    def assert_single_compile(self, name: str | None = None) -> None:
+        """Raise if any (function, shape signature) traced more than once
+        — i.e. a retrace happened for a shape that was already compiled."""
+        bad = [(n, c) for (n, _, _), c in sorted(self.counts.items())
+               if c > 1 and (name is None or n == name)]
+        if bad:
+            detail = ", ".join(f"{n}×{c}" for n, c in bad)
+            raise AssertionError(
+                f"retrace detected — >1 trace per shape signature: {detail}")
+
+
+def compile_count_guard() -> CompileCountGuard:
+    """``with compile_count_guard() as guard: ...`` — see the module
+    docstring."""
+    return CompileCountGuard()

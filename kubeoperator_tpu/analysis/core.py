@@ -1,0 +1,469 @@
+"""Rule engine for ``ko lint`` — AST-walking static analysis.
+
+Six PRs of hot-path and control-plane work accumulated invariants that
+lived as folklore and ad-hoc per-feature tests: "no host sync inside the
+decode loop", "every pool write goes through ``_pin``", "shared batcher
+state is written under its lock", "metric names match the registry".
+This package makes them executable. A :class:`Rule` inspects one parsed
+module (or the project as a whole) and yields :class:`Finding`\\ s — each
+carries a rule id, severity, ``file:line:col`` span, message, and a fix
+hint — rendered as text or JSON by the CLI (``ko lint`` /
+``python -m kubeoperator_tpu.analysis.cli``).
+
+Suppression is explicit and audited: ``# ko: lint-ok[KO101] reason`` on
+the offending line (or alone on the line above) silences that rule there,
+and the reason is mandatory — a bare pragma is itself a finding (KO000),
+as is one naming an unknown rule (KO001). Suppressions therefore document
+the invariant they waive (e.g. serving.py's single-writer slot tracker).
+
+Severities: ``error`` > ``warning`` > ``info``. The default gate fails on
+``warning`` and above; the repo ships clean at that level (pinned by
+tests/test_lint.py's self-clean assertion).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+SEVERITIES = ("info", "warning", "error")
+_SEV_ORDER = {s: i for i, s in enumerate(SEVERITIES)}
+
+#: directories never descended into when walking a lint target
+SKIP_DIRS = {".git", "__pycache__", ".jax_cache", ".pytest_cache",
+             "node_modules", ".venv", ".eggs", "build", "dist"}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*ko:\s*lint-ok\[([A-Za-z0-9_*,\s]+)\]\s*(.*)$")
+
+
+def severity_at_least(severity: str, floor: str) -> bool:
+    return _SEV_ORDER[severity] >= _SEV_ORDER[floor]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source span."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        text = f"{self.path}:{self.line}:{self.col}: " \
+               f"{self.severity} {self.rule} {self.message}"
+        if self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "hint": self.hint}
+
+
+class Rule:
+    """One static check. Subclasses set the metadata class attributes and
+    implement :meth:`check` over a :class:`ModuleContext`. Project-scoped
+    rules (README drift, catalog schema) live in ``project.py`` and are
+    invoked once per lint run instead of per module."""
+
+    id: str = ""
+    severity: str = "warning"
+    title: str = ""
+    hint: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node: ast.AST, message: str,
+                hint: str | None = None) -> Finding:
+        return Finding(rule=self.id, severity=self.severity, path=ctx.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message,
+                       hint=self.hint if hint is None else hint)
+
+
+#: rule id -> Rule instance (AST rules only; project rules register too so
+#: --list-rules and the README rule-table drift check see the full set)
+RULES: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+class _TreeInfo(ast.NodeVisitor):
+    """One pass computing parents, loop-body membership, and enclosing
+    functions for every node. ``for``/``while`` bodies (and comprehension
+    element/condition expressions) count as loop bodies; a loop's ``iter``
+    expression and anything inside a nested function def do not — a def's
+    body runs when called, not once per enclosing iteration."""
+
+    def __init__(self, tree: ast.AST):
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.in_loop: set[ast.AST] = set()
+        self.func_of: dict[ast.AST, ast.AST | None] = {}
+        self._walk(tree, loop=False, func=None)
+
+    def _walk(self, node: ast.AST, loop: bool, func: ast.AST | None) -> None:
+        self.func_of[node] = func
+        if loop:
+            self.in_loop.add(node)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._walk_all((node.target, node.iter), loop, func, node)
+            self._walk_all(node.body + node.orelse, True, func, node)
+            return
+        if isinstance(node, ast.While):
+            self._walk_all((node.test,), loop, func, node)
+            self._walk_all(node.body + node.orelse, True, func, node)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # the first generator's iterable is evaluated once; everything
+            # else runs per element
+            first_iter = node.generators[0].iter
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                self._walk(child, loop or child is not first_iter, func)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            deco = getattr(node, "decorator_list", [])
+            defaults = (list(node.args.defaults)
+                        + [d for d in node.args.kw_defaults if d is not None])
+            self._walk_all(deco + defaults, loop, func, node)
+            body = node.body if isinstance(node.body, list) else [node.body]
+            self._walk_all([node.args] + body, False, node, node)
+            return
+        if isinstance(node, ast.ClassDef):
+            self._walk_all(node.decorator_list + node.bases, loop, func, node)
+            self._walk_all(node.body, False, func, node)
+            return
+        self._walk_all(list(ast.iter_child_nodes(node)), loop, func, node)
+
+    def _walk_all(self, children: Iterable[ast.AST], loop: bool,
+                  func: ast.AST | None, parent: ast.AST) -> None:
+        for child in children:
+            self.parents[child] = parent
+            self._walk(child, loop, func)
+
+
+@dataclass
+class ModuleContext:
+    """Everything a per-module rule needs: source, tree, import aliases,
+    parent/loop/function maps, and dotted-name resolution."""
+
+    path: str
+    text: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    import_map: dict[str, str] = field(default_factory=dict)
+    has_jax: bool = False
+    info: _TreeInfo | None = None
+
+    @classmethod
+    def parse(cls, path: str, text: str) -> "ModuleContext":
+        tree = ast.parse(text, filename=path)
+        ctx = cls(path=path, text=text, tree=tree,
+                  lines=text.splitlines())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    ctx.import_map[alias.asname or alias.name.split(".")[0]] \
+                        = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    ctx.import_map[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+        ctx.has_jax = any(m == "jax" or m.startswith("jax.")
+                          for m in ctx.import_map.values())
+        ctx.info = _TreeInfo(tree)
+        return ctx
+
+    # -- resolution helpers -------------------------------------------------
+    def dotted(self, node: ast.AST) -> str | None:
+        """Resolve ``jnp.asarray`` -> ``jax.numpy.asarray`` through the
+        module's import aliases. Returns None for non-name expressions."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.import_map.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def in_loop(self, node: ast.AST) -> bool:
+        return node in self.info.in_loop
+
+    def enclosing_function(self, node: ast.AST) -> ast.AST | None:
+        return self.info.func_of.get(node)
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.info.parents.get(node)
+
+    def statement_of(self, node: ast.AST) -> ast.stmt | None:
+        while node is not None and not isinstance(node, ast.stmt):
+            node = self.info.parents.get(node)
+        return node
+
+
+# -- pragmas ----------------------------------------------------------------
+
+@dataclass
+class Pragma:
+    line: int          # line the pragma comment sits on
+    rules: tuple[str, ...]
+    reason: str
+    standalone: bool   # comment-only line: applies to the NEXT line too
+    col: int
+
+
+def scan_pragmas(lines: list[str]) -> list[Pragma]:
+    out = []
+    for i, raw in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(raw)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        out.append(Pragma(line=i, rules=rules, reason=m.group(2).strip(),
+                          standalone=raw.lstrip().startswith("#"),
+                          col=m.start() + 1))
+    return out
+
+
+def pragma_findings(path: str, pragmas: list[Pragma],
+                    known_rules: Iterable[str]) -> list[Finding]:
+    known = set(known_rules)
+    out = []
+    for p in pragmas:
+        if not p.reason:
+            out.append(Finding(
+                rule="KO000", severity="error", path=path, line=p.line,
+                col=p.col,
+                message="lint-ok pragma without a reason — suppressions "
+                        "must document the invariant they waive",
+                hint="write `# ko: lint-ok[<RULE>] <why this is safe>`"))
+        for r in p.rules:
+            if r != "*" and r not in known:
+                out.append(Finding(
+                    rule="KO001", severity="warning", path=path,
+                    line=p.line, col=p.col,
+                    message=f"lint-ok pragma names unknown rule {r!r}",
+                    hint="run `ko lint --list-rules` for the rule ids"))
+    return out
+
+
+def apply_pragmas(findings: list[Finding],
+                  pragmas: list[Pragma]) -> tuple[list[Finding], int]:
+    """Drop findings suppressed by a pragma on the same line (or on a
+    standalone comment line immediately above). KO000/KO001 — the pragma
+    hygiene rules — are never suppressible."""
+    cover: dict[int, set[str]] = {}
+    for p in pragmas:
+        ids = set(p.rules)
+        cover.setdefault(p.line, set()).update(ids)
+        if p.standalone:
+            cover.setdefault(p.line + 1, set()).update(ids)
+    kept, suppressed = [], 0
+    for f in findings:
+        ids = cover.get(f.line, ())
+        if f.rule not in ("KO000", "KO001") and (f.rule in ids or "*" in ids):
+            suppressed += 1
+            continue
+        kept.append(f)
+    return kept, suppressed
+
+
+# -- engine -----------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: list[Finding]
+    suppressed: int
+    files: int
+
+    def counts(self) -> dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def worst(self) -> str | None:
+        worst = None
+        for f in self.findings:
+            if worst is None or _SEV_ORDER[f.severity] > _SEV_ORDER[worst]:
+                worst = f.severity
+        return worst
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 1,
+            "files": self.files,
+            "counts": self.counts(),
+            "suppressed": self.suppressed,
+            "findings": [f.to_dict() for f in sorted(
+                self.findings,
+                key=lambda f: (f.path, f.line, f.col, f.rule))],
+        }, indent=2)
+
+
+def _iter_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for base, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for name in sorted(files):
+                if name.endswith(".py") or name == "catalog.yml":
+                    yield os.path.join(base, name)
+
+
+def _ensure_rules() -> None:
+    """Import the rule modules for their @register side effects, so the
+    engine works no matter which entry point was imported first."""
+    from kubeoperator_tpu.analysis import (  # noqa: F401
+        project, rules_control, rules_jax,
+    )
+
+
+def lint_file(path: str, text: str | None = None,
+              select: set[str] | None = None) -> tuple[list[Finding], int]:
+    """Lint one python module: run every registered AST rule, then apply
+    pragma suppression. Returns (findings, n_suppressed). Syntax errors
+    come back as a single KO002 finding rather than crashing the run."""
+    _ensure_rules()
+    if text is None:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            text = fh.read()
+    try:
+        ctx = ModuleContext.parse(path, text)
+    except SyntaxError as e:
+        return [Finding(rule="KO002", severity="error", path=path,
+                        line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        message=f"syntax error: {e.msg}",
+                        hint="file does not parse; fix before linting")], 0
+    findings: list[Finding] = []
+    for rule in RULES.values():
+        if getattr(rule, "project_scope", False):
+            continue
+        if select and rule.id not in select:
+            continue
+        findings.extend(rule.check(ctx))
+    pragmas = scan_pragmas(ctx.lines)
+    findings.extend(f for f in pragma_findings(path, pragmas, RULES)
+                    if not select or f.rule in select)
+    return apply_pragmas(findings, pragmas)
+
+
+def lint_paths(paths: Iterable[str], *, select: Iterable[str] | None = None,
+               project: bool = True) -> LintResult:
+    """Lint every ``.py`` file (and ``catalog.yml``) under ``paths``; when
+    ``project`` is true, additionally run the project-scoped drift rules
+    (README metric/rule tables) anchored at the enclosing repo root."""
+    from kubeoperator_tpu.analysis import project as project_rules
+
+    _ensure_rules()
+
+    sel = set(select) if select else None
+    findings: list[Finding] = []
+    suppressed = 0
+    files = 0
+    seen_catalog = False
+    for path in _iter_files(paths):
+        files += 1
+        if path.endswith(".yml"):
+            seen_catalog = True
+            found = project_rules.check_catalog(path)
+            findings.extend(f for f in found if not sel or f.rule in sel)
+            continue
+        found, supp = lint_file(path, select=sel)
+        findings.extend(found)
+        suppressed += supp
+    if project:
+        root = find_project_root(next(iter(paths), "."))
+        if root is not None:
+            found = project_rules.check_readme_metrics(root)
+            found += project_rules.check_readme_rules(root)
+            if not seen_catalog:
+                cat = os.path.join(root, "kubeoperator_tpu", "config",
+                                   "catalog.yml")
+                if os.path.exists(cat):
+                    found += project_rules.check_catalog(cat)
+            findings.extend(f for f in found if not sel or f.rule in sel)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed, files=files)
+
+
+def find_project_root(start: str) -> str | None:
+    """Walk up from ``start`` to the directory holding pyproject.toml."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+# -- shared AST helpers used by the rule modules ----------------------------
+
+def call_name(ctx: ModuleContext, call: ast.Call) -> str | None:
+    return ctx.dotted(call.func)
+
+
+def const_int_tuple(node: ast.AST | None) -> tuple[int, ...] | None:
+    """donate_argnums / static_argnums literal -> tuple of ints (None when
+    the expression is not a literal we can evaluate)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def assigned_names(target: ast.AST) -> set[str]:
+    """Flatten an assignment target into the plain names it binds."""
+    out: set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out

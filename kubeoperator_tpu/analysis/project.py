@@ -1,0 +1,352 @@
+"""Project-scoped rules: checks that look at the repo as a whole rather
+than one module — README↔registry drift (KO211), README↔rule-table drift
+(KO212), and the catalog schema lifted from loader-time to lint-time
+(KO220).
+
+KO211 is the one source of truth for the metric documentation contract
+that tests/test_monitoring_stack.py used to hand-roll: the set of
+``ko_*`` names in the README's "Observability" and "Serving" tables must
+equal the telemetry registry exactly, and every inline ``ko_*`` mention
+in the Observability / Serving / Scheduling sections must name a
+registered family (or one of its exposition series).
+
+KO220 re-implements ``config/catalog.py``'s load-time validation
+statically — plus the type checks the loader never did (``retry`` /
+``timeout_s`` / ``needs`` shapes) — so a catalog typo is a lint finding
+with a file:line span instead of a runtime ValueError three steps into a
+provision.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Iterator
+
+from kubeoperator_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, register,
+)
+
+_TABLE_ROW = re.compile(r"^\| `(ko_[a-z0-9_]+)`")
+_INLINE = re.compile(r"`(ko_[a-z][a-z0-9_]*)`")
+_RULE_ROW = re.compile(r"^\| (KO\d{3}) ")
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: README sections whose metric tables must equal the registry
+_TABLE_SECTIONS = ("## Observability", "## Serving")
+#: README sections whose inline ko_* mentions must be registered
+_MENTION_SECTIONS = ("## Observability", "## Serving", "## Scheduling")
+
+
+class ProjectRule(Rule):
+    """Marker base: registered for --list-rules and the README rule
+    table, but invoked once per lint run by ``lint_paths`` (via the
+    ``check_*`` functions below), never per module."""
+
+    project_scope = True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+
+@register
+class ReadmeMetricDrift(ProjectRule):
+    id = "KO211"
+    severity = "error"
+    title = "README metric tables drift from the telemetry registry"
+    hint = ("the Observability + Serving metric tables must list exactly "
+            "the registry's families; update README.md or metrics.py")
+
+
+@register
+class ReadmeRuleDrift(ProjectRule):
+    id = "KO212"
+    severity = "error"
+    title = "README rule table drifts from the registered lint rules"
+    hint = ("the 'Static analysis' rule table must list exactly the "
+            "engine's rule ids; update README.md or the rule modules")
+
+
+@register
+class CatalogSchema(ProjectRule):
+    id = "KO220"
+    severity = "error"
+    title = "catalog.yml schema violation"
+    hint = ("see config/catalog.py StepDef: module/targets are required, "
+            "retry is an int >= 0, timeout_s a positive number, needs a "
+            "list of step names valid within each operation using the "
+            "step")
+
+
+def _finding(rule_id: str, path: str, line: int, message: str,
+             hint: str | None = None) -> Finding:
+    from kubeoperator_tpu.analysis.core import RULES
+    rule = RULES[rule_id]
+    return Finding(rule=rule_id, severity=rule.severity, path=path,
+                   line=line, col=1, message=message,
+                   hint=rule.hint if hint is None else hint)
+
+
+def _sections(lines: list[str]) -> dict[str, tuple[int, list[str]]]:
+    """heading -> (1-based heading line, section lines)."""
+    out: dict[str, tuple[int, list[str]]] = {}
+    current, start = None, 0
+    for i, line in enumerate(lines):
+        if line.startswith("## "):
+            if current is not None:
+                out[current] = (start, lines[start:i])
+            current, start = line.strip(), i
+    if current is not None:
+        out[current] = (start, lines[start:])
+    return {h: (ln + 1, body) for h, (ln, body) in out.items()}
+
+
+def check_readme_metrics(root: str,
+                         readme: str | None = None) -> list[Finding]:
+    """KO211: README metric tables == registry; inline mentions known."""
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+
+    path = readme or os.path.join(root, "README.md")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    sections = _sections(lines)
+    registered = set(REGISTRY.names())
+    findings: list[Finding] = []
+
+    documented: dict[str, int] = {}
+    first_table_line = 1
+    for heading in _TABLE_SECTIONS:
+        if heading not in sections:
+            findings.append(_finding(
+                "KO211", path, 1,
+                f"README section {heading!r} is missing — its metric "
+                f"table documents the registry"))
+            continue
+        start, body = sections[heading]
+        first_table_line = first_table_line if documented else start
+        for off, line in enumerate(body):
+            m = _TABLE_ROW.match(line)
+            if m:
+                documented.setdefault(m.group(1), start + off)
+    for name, line in sorted(documented.items()):
+        if name not in registered:
+            findings.append(_finding(
+                "KO211", path, line,
+                f"README documents metric '{name}' which the registry "
+                f"does not declare (stale row?)"))
+    for name in sorted(registered - set(documented)):
+        findings.append(_finding(
+            "KO211", path, first_table_line,
+            f"registered metric '{name}' is missing from the README "
+            f"metric tables"))
+
+    for heading in _MENTION_SECTIONS:
+        if heading not in sections:
+            continue
+        start, body = sections[heading]
+        for off, line in enumerate(body):
+            for m in _INLINE.finditer(line):
+                token = m.group(1)
+                if token in registered:
+                    continue
+                if any(token.endswith(s) and token[: -len(s)] in registered
+                       for s in _SERIES_SUFFIXES):
+                    continue
+                findings.append(_finding(
+                    "KO211", path, start + off,
+                    f"README mentions metric '{token}' which the "
+                    f"registry does not declare"))
+    return findings
+
+
+def check_readme_rules(root: str,
+                       readme: str | None = None) -> list[Finding]:
+    """KO212: the Static-analysis rule table == registered rule ids."""
+    from kubeoperator_tpu.analysis.core import RULES
+
+    path = readme or os.path.join(root, "README.md")
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    sections = _sections(lines)
+    heading = "## Static analysis"
+    if heading not in sections:
+        return [_finding("KO212", path, 1,
+                         f"README section {heading!r} is missing — it "
+                         f"documents the lint rule set")]
+    start, body = sections[heading]
+    documented: dict[str, int] = {}
+    for off, line in enumerate(body):
+        m = _RULE_ROW.match(line)
+        if m:
+            documented.setdefault(m.group(1), start + off)
+    # KO002 (syntax error) is an engine affordance, not a listed rule
+    registered = set(RULES)
+    findings: list[Finding] = []
+    for rid, line in sorted(documented.items()):
+        if rid not in registered:
+            findings.append(_finding(
+                "KO212", path, line,
+                f"README documents lint rule '{rid}' which the engine "
+                f"does not register"))
+    for rid in sorted(registered - set(documented)):
+        findings.append(_finding(
+            "KO212", path, start,
+            f"lint rule '{rid}' is registered but missing from the "
+            f"README rule table"))
+    return findings
+
+
+# -- catalog schema (KO220) -------------------------------------------------
+
+def _line_of(lines: list[str], key: str, after: int = 0) -> int:
+    pat = key + ":"
+    for i in range(after, len(lines)):
+        if lines[i].strip().startswith(pat):
+            return i + 1
+    return 1
+
+
+def check_catalog(path: str) -> list[Finding]:
+    """Static validation of a catalog.yml: StepDef field shapes plus the
+    per-operation DAG rules ``config.catalog._resolve_dag`` enforces at
+    load (undefined/duplicate steps, unknown/self/cross-op ``needs``
+    refs, cycles) — surfaced as findings instead of ValueErrors."""
+    import yaml
+
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    lines = text.splitlines()
+    try:
+        raw = yaml.safe_load(text)
+    except yaml.YAMLError as e:
+        line = getattr(getattr(e, "problem_mark", None), "line", 0) + 1
+        return [_finding("KO220", path, line,
+                         f"catalog does not parse as YAML: {e}")]
+    if not isinstance(raw, dict):
+        return [_finding("KO220", path, 1,
+                         "catalog root must be a mapping")]
+    findings: list[Finding] = []
+    steps = raw.get("steps", {})
+    if not isinstance(steps, dict):
+        return [_finding("KO220", path, _line_of(lines, "steps"),
+                         "'steps' must be a mapping of step name -> spec")]
+
+    for name, spec in steps.items():
+        line = _line_of(lines, str(name))
+        if not isinstance(spec, dict):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: spec must be a mapping"))
+            continue
+        if not isinstance(spec.get("module"), str) or not spec.get("module"):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: 'module' is required and must be a "
+                f"string"))
+        targets = spec.get("targets")
+        if not isinstance(targets, list) or not targets \
+                or not all(isinstance(t, str) for t in targets):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: 'targets' must be a non-empty list of "
+                f"role names"))
+        retry = spec.get("retry")
+        if retry is not None and (isinstance(retry, bool)
+                                  or not isinstance(retry, int)
+                                  or retry < 0):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: 'retry' must be an integer >= 0, got "
+                f"{retry!r}"))
+        timeout = spec.get("timeout_s")
+        if timeout is not None and (isinstance(timeout, bool)
+                                    or not isinstance(timeout, (int, float))
+                                    or timeout <= 0):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: 'timeout_s' must be a positive number, "
+                f"got {timeout!r}"))
+        needs = spec.get("needs")
+        if needs is not None and (not isinstance(needs, list) or not all(
+                isinstance(n, str) for n in needs)):
+            findings.append(_finding(
+                "KO220", path, line,
+                f"step {name!r}: 'needs' must be a list of step names"))
+
+    operations = raw.get("operations", {})
+    if not isinstance(operations, dict):
+        findings.append(_finding(
+            "KO220", path, _line_of(lines, "operations"),
+            "'operations' must be a mapping of operation -> step list"))
+        return findings
+    for op, listed in operations.items():
+        op_line = _line_of(lines, str(op))
+        if not isinstance(listed, list):
+            findings.append(_finding(
+                "KO220", path, op_line,
+                f"operation {op!r} must be a list of step names"))
+            continue
+        findings.extend(_check_dag(path, op, op_line, listed, steps))
+    return findings
+
+
+def _check_dag(path: str, op: str, op_line: int, names: list[Any],
+               steps: dict) -> list[Finding]:
+    findings: list[Finding] = []
+    for s in names:
+        if s not in steps:
+            findings.append(_finding(
+                "KO220", path, op_line,
+                f"operation {op!r} references undefined step {s!r}"))
+    if len(set(names)) != len(names):
+        dupes = sorted({s for s in names if names.count(s) > 1})
+        findings.append(_finding(
+            "KO220", path, op_line,
+            f"operation {op!r} lists steps more than once: {dupes}"))
+    in_op = {s for s in names if s in steps}
+    deps: dict[str, set[str]] = {}
+    for i, name in enumerate(names):
+        if name not in steps:
+            continue
+        spec = steps.get(name) if isinstance(steps.get(name), dict) else {}
+        needs = spec.get("needs")
+        if needs is None:
+            prev = names[i - 1] if i and names[i - 1] in steps else None
+            deps[name] = {prev} if prev else set()
+            continue
+        if not isinstance(needs, list):
+            deps[name] = set()
+            continue
+        for n in needs:
+            if n == name:
+                findings.append(_finding(
+                    "KO220", path, op_line,
+                    f"operation {op!r}: step {name!r} depends on itself"))
+            elif n not in steps:
+                findings.append(_finding(
+                    "KO220", path, op_line,
+                    f"operation {op!r}: step {name!r} needs unknown step "
+                    f"{n!r}"))
+            elif n not in in_op:
+                findings.append(_finding(
+                    "KO220", path, op_line,
+                    f"operation {op!r}: step {name!r} needs {n!r}, which "
+                    f"is not part of this operation"))
+        deps[name] = {n for n in needs if n in in_op and n != name}
+    placed: set[str] = set()
+    pending = [n for n in names if n in deps]
+    while pending:
+        ready = [n for n in pending if deps[n] <= placed]
+        if not ready:
+            findings.append(_finding(
+                "KO220", path, op_line,
+                f"operation {op!r} has a dependency cycle among "
+                f"{sorted(set(pending))}"))
+            break
+        placed.update(ready)
+        pending = [n for n in pending if n not in placed]
+    return findings

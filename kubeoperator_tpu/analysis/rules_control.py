@@ -1,0 +1,196 @@
+"""Control-plane rules (KO2xx): threading and telemetry discipline.
+
+KO201 polices the lock contract the engine/scheduler/batcher classes
+declare for themselves: a class that owns a ``threading.Lock`` /
+``RLock`` / ``Condition`` attribute promises its shared attributes are
+written under it. Writes outside a ``with self._lock:`` block are
+flagged; single-writer designs (e.g. the continuous batcher's
+worker-thread-only slot tracker) suppress with a pragma that documents
+the invariant.
+
+KO210 generalizes the telemetry drift lints: any ``ko_*`` metric name
+appearing in a string literal must exist in the telemetry registry
+(directly or as an exposition series suffix ``_bucket``/``_sum``/
+``_count``). Docstrings count — a stale metric name in a docstring is
+exactly the drift this catches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from kubeoperator_tpu.analysis.core import ModuleContext, Rule, register
+
+_LOCK_TYPES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+_METRIC_TOKEN = re.compile(r"\bko_[a-z][a-z0-9_]*\b")
+_SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
+#: a ko_* token only *looks like a metric* when it ends with one of the
+#: prometheus-style type suffixes the registry uses — this keeps KO210
+#: off ContextVar/logger names like ``ko_current_span``
+_METRIC_SUFFIXES = ("_total", "_seconds", "_depth", "_size", "_occupancy",
+                    "_bytes", "_ratio") + _SERIES_SUFFIXES
+
+
+def _lock_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Call) and ctx.dotted(node.func) in _LOCK_TYPES:
+        return True
+    # dataclass field(default_factory=threading.Lock)
+    if isinstance(node, ast.Call) and node.func is not None:
+        for kw in node.keywords:
+            if kw.arg == "default_factory" \
+                    and ctx.dotted(kw.value) in _LOCK_TYPES:
+                return True
+    return False
+
+
+def _class_lock_attrs(ctx: ModuleContext, cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _lock_call(ctx, node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    locks.add(t.attr)
+                elif isinstance(t, ast.Name):        # class-level attribute
+                    locks.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _lock_call(ctx, node.value) \
+                and isinstance(node.target, ast.Name):
+            locks.add(node.target.id)
+    return locks
+
+
+@register
+class UnguardedSharedWrite(Rule):
+    """KO201 — attribute write on a lock-owning class outside any
+    ``with self.<lock>:`` scope."""
+
+    id = "KO201"
+    severity = "warning"
+    title = "shared-state write outside the declared lock"
+    hint = ("wrap the write in `with self._lock:` — or, if a single "
+            "writer owns this attribute by design, suppress with a "
+            "pragma stating that invariant")
+
+    _EXEMPT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _class_lock_attrs(ctx, cls)
+            if not locks:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in self._EXEMPT_METHODS:
+                    continue
+                yield from self._check_method(ctx, cls, meth, locks)
+
+    def _check_method(self, ctx: ModuleContext, cls: ast.ClassDef,
+                      meth: ast.AST, locks: set[str]) -> Iterator[Finding]:
+        for node in ast.walk(meth):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            attr = None
+            for t in targets:
+                attr = self._self_attr(t)
+                if attr is not None:
+                    break
+            if attr is None or attr in locks:
+                continue
+            if self._under_lock(ctx, node, locks):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{cls.name}.{meth.name} writes self.{attr} outside "
+                f"the class's declared lock scope "
+                f"({', '.join('self.' + x for x in sorted(locks))})")
+
+    @staticmethod
+    def _self_attr(target: ast.AST) -> str | None:
+        """self.x / self.x[...] / (a, self.x) -> 'x'. Only the *store
+        root* counts: ``self.host(ip).down = v`` stores on a call result
+        and ``busy[self._n] += 1`` stores on a local — neither is a write
+        to a self attribute."""
+        nodes = target.elts \
+            if isinstance(target, (ast.Tuple, ast.List)) else [target]
+        for node in nodes:
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                return node.attr
+        return None
+
+    @staticmethod
+    def _under_lock(ctx: ModuleContext, node: ast.AST,
+                    locks: set[str]) -> bool:
+        cur = ctx.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    for n in ast.walk(item.context_expr):
+                        if isinstance(n, ast.Attribute) \
+                                and isinstance(n.value, ast.Name) \
+                                and n.value.id == "self" \
+                                and n.attr in locks:
+                            return True
+            cur = ctx.parent(cur)
+        return False
+
+
+@register
+class UnknownMetricName(Rule):
+    """KO210 — a ``ko_*`` metric name in a string literal that the
+    telemetry registry does not declare."""
+
+    id = "KO210"
+    severity = "error"
+    title = "undeclared ko_* metric name"
+    hint = ("declare the family in telemetry/metrics.py (or fix the "
+            "stale name) — the registry is the single source of truth")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if "ko_" not in ctx.text:
+            return
+        allowed = _registry_names()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for m in _METRIC_TOKEN.finditer(node.value):
+                token = m.group(0)
+                if token.endswith("_"):        # prose glob like `ko_serve_*`
+                    continue
+                if not token.endswith(_METRIC_SUFFIXES):
+                    continue                   # ContextVar / logger names
+                if _known_metric(token, allowed):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"metric name '{token}' is not declared in the "
+                    f"telemetry registry")
+
+
+def _registry_names() -> frozenset[str]:
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+    return frozenset(REGISTRY.names())
+
+
+def _known_metric(token: str, allowed: frozenset[str]) -> bool:
+    if token in allowed:
+        return True
+    for suffix in _SERIES_SUFFIXES:
+        if token.endswith(suffix) and token[: -len(suffix)] in allowed:
+            return True
+    return False

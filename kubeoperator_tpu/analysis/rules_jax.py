@@ -1,0 +1,545 @@
+"""JAX hot-path rules (KO1xx): the compile/transfer discipline that the
+MFU and serving numbers depend on. All of these gate on the module
+importing jax — a pure control-plane module never trips them.
+
+The taxonomy follows the failure modes measured in PERF.md: a hidden
+host↔device round trip per loop iteration (KO101/KO102 — the r5 load
+test paid 17 s for 192 scalar fetches), a retrace per request (KO112 —
+why serve's ``decode_fn`` is lru_cached per shape bucket), a dropped
+donation doubling HBM (KO110/KO111), a large array baked into a jaxpr as
+a constant (KO113), and a pool buffer rewritten off its canonical
+sharding so the next donated dispatch re-lays-out (KO120)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeoperator_tpu.analysis.core import (
+    ModuleContext, Rule, assigned_names, const_int_tuple, keyword_arg,
+    names_in, register,
+)
+
+#: host -> device transfer entry points (one dispatch per call)
+_TRANSFER_FNS = {"jax.numpy.asarray", "jax.numpy.array", "jax.device_put"}
+#: device -> host sync entry points
+_FETCH_FNS = {"jax.device_get"}
+#: calls whose result lives on device — used for the light taint pass
+_DEVICE_PREFIXES = ("jax.numpy.", "jax.random.", "jax.lax.", "jax.nn.")
+_DEVICE_FNS = {"jax.device_put", "jax.jit", "jax.vmap", "jax.pmap",
+               "jax.grad", "jax.value_and_grad"}
+#: array-creating calls whose results are dangerous to close over in a jit
+_ARRAY_FNS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.empty", "jax.numpy.arange", "jax.numpy.linspace",
+    "jax.numpy.asarray", "jax.numpy.array", "jax.numpy.eye",
+    "jax.random.normal", "jax.random.uniform", "jax.random.randint",
+    "jax.device_put", "numpy.zeros", "numpy.ones", "numpy.full",
+    "numpy.arange", "numpy.asarray", "numpy.array",
+}
+
+
+def _device_call(name: str | None) -> bool:
+    return bool(name) and (name in _DEVICE_FNS
+                           or name.startswith(_DEVICE_PREFIXES))
+
+
+def _function_taint(ctx: ModuleContext, func: ast.AST) -> set[str]:
+    """Names in ``func`` assigned directly from a jax/jnp call (or from a
+    ``.at[...]`` update chain) — a cheap, local notion of "device value"."""
+    tainted: set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        is_device = (isinstance(value, ast.Call)
+                     and _device_call(ctx.dotted(value.func)))
+        if not is_device and isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Attribute):
+            # x = buf.at[i].set(v) keeps x on device
+            root = value.func
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                if isinstance(root, ast.Attribute) and root.attr == "at":
+                    is_device = True
+                    break
+                root = root.value
+        if is_device:
+            for target in node.targets:
+                tainted |= assigned_names(target)
+    return tainted
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class HostTransferInLoop(Rule):
+    """KO101 — ``jnp.asarray``/``jnp.array``/``jax.device_put`` inside a
+    ``for``/``while`` body is one host->device transfer (and dispatch) per
+    iteration; the flagship case was SlotPoolEngine._admit's per-request
+    ``jnp.asarray(row)``."""
+
+    id = "KO101"
+    severity = "warning"
+    title = "host->device transfer inside a loop"
+    hint = ("stack the rows on host with numpy and transfer once after "
+            "the loop (one jnp.asarray + one batched scatter)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and ctx.in_loop(node)):
+                continue
+            name = ctx.dotted(node.func)
+            if name in _TRANSFER_FNS:
+                short = name.replace("jax.numpy.", "jnp.")
+                yield self.finding(
+                    ctx, node,
+                    f"{short} inside a loop body dispatches one "
+                    f"host->device transfer per iteration")
+
+
+@register
+class HostSyncInLoop(Rule):
+    """KO102 — device->host syncs inside loops: ``.item()``,
+    ``jax.device_get``, ``int()``/``float()``/``bool()`` or
+    ``np.asarray`` applied to a device value. Each one blocks on the
+    device and costs a full transport round trip per iteration."""
+
+    id = "KO102"
+    severity = "warning"
+    title = "device->host sync inside a loop"
+    hint = ("batch the reads: fetch the whole array once outside the loop "
+            "(single device_get / np.asarray) and index on host")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        taint_cache: dict[ast.AST, set[str]] = {}
+
+        def tainted(node: ast.AST) -> bool:
+            if isinstance(node, ast.Call) \
+                    and _device_call(ctx.dotted(node.func)):
+                return True
+            func = ctx.enclosing_function(node)
+            if func is None:
+                return False
+            if func not in taint_cache:
+                taint_cache[func] = _function_taint(ctx, func)
+            root = _root_name(node)
+            return root is not None and root in taint_cache[func]
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and ctx.in_loop(node)):
+                continue
+            name = ctx.dotted(node.func)
+            if name in _FETCH_FNS:
+                yield self.finding(
+                    ctx, node, "jax.device_get inside a loop body blocks "
+                               "on the device every iteration")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args \
+                    and tainted(node.func.value):
+                yield self.finding(
+                    ctx, node, ".item() on a device value inside a loop "
+                               "is one scalar fetch per iteration")
+            elif name in ("int", "float", "bool") and len(node.args) == 1 \
+                    and tainted(node.args[0]):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() on a device value inside a loop forces a "
+                    f"blocking scalar transfer per iteration")
+            elif name in ("numpy.asarray", "numpy.array") and node.args \
+                    and tainted(node.args[0]):
+                yield self.finding(
+                    ctx, node, "np.asarray on a device value inside a "
+                               "loop syncs per iteration")
+
+
+def _local_jits(ctx: ModuleContext,
+                func: ast.AST) -> dict[str, dict]:
+    """Names bound in ``func`` (or at module level when func is the
+    module) directly to a ``jax.jit(...)`` call, with the jit call node
+    and its donate/static literals."""
+    out: dict[str, dict] = {}
+    for node in ast.walk(func):
+        if ctx.enclosing_function(node) is not (
+                func if isinstance(func, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else None):
+            continue
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and ctx.dotted(node.value.func) == "jax.jit":
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = {
+                        "call": node.value,
+                        "line": node.lineno,
+                        "donate": const_int_tuple(
+                            keyword_arg(node.value, "donate_argnums")),
+                        "static": const_int_tuple(
+                            keyword_arg(node.value, "static_argnums")),
+                    }
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if ctx.dotted(deco) == "jax.jit":
+                    out[node.name] = {"call": deco, "line": node.lineno,
+                                      "donate": None, "static": None}
+                elif isinstance(deco, ast.Call) \
+                        and ctx.dotted(deco.func) == "jax.jit":
+                    out[node.name] = {
+                        "call": deco, "line": node.lineno,
+                        "donate": const_int_tuple(
+                            keyword_arg(deco, "donate_argnums")),
+                        "static": const_int_tuple(
+                            keyword_arg(deco, "static_argnums")),
+                    }
+    return out
+
+
+def _scopes(ctx: ModuleContext) -> list[ast.AST]:
+    scopes: list[ast.AST] = [ctx.tree]
+    scopes += [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return scopes
+
+
+@register
+class DonatedArgReused(Rule):
+    """KO110 — an argument passed at a donated position is dead the moment
+    the jitted call dispatches: its buffer is aliased into the output.
+    Reading it afterwards returns garbage (or errors) on donation-capable
+    backends."""
+
+    id = "KO110"
+    severity = "error"
+    title = "donated argument used after the call"
+    hint = ("rebind the name from the call result (x = f(x)) or drop it "
+            "from donate_argnums")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for scope in _scopes(ctx):
+            jits = _local_jits(ctx, scope)
+            donating = {n: j for n, j in jits.items() if j["donate"]}
+            if not donating:
+                continue
+            scope_key = scope if isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            calls = [n for n in ast.walk(scope)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)
+                     and n.func.id in donating
+                     and ctx.enclosing_function(n) is scope_key]
+            for call in calls:
+                spec = donating[call.func.id]
+                stmt = ctx.statement_of(call)
+                if stmt is None:
+                    continue
+                rebound = assigned_names(stmt) if isinstance(
+                    stmt, (ast.Assign, ast.AugAssign)) else set()
+                for idx in spec["donate"]:
+                    if idx >= len(call.args):
+                        continue
+                    arg = call.args[idx]
+                    if not isinstance(arg, ast.Name) or arg.id in rebound:
+                        continue
+                    use = self._first_use_after(ctx, scope, scope_key,
+                                                arg.id, stmt)
+                    if use is not None:
+                        yield self.finding(
+                            ctx, use,
+                            f"'{arg.id}' was donated to "
+                            f"{call.func.id}() on line {stmt.lineno} — "
+                            f"its buffer is aliased into the output and "
+                            f"must not be read afterwards")
+
+    @staticmethod
+    def _first_use_after(ctx, scope, scope_key, name, stmt):
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        first_load = None
+        first_store = None
+        for n in ast.walk(scope):
+            if ctx.enclosing_function(n) is not scope_key:
+                continue
+            if isinstance(n, ast.Name) and n.id == name and n.lineno > end:
+                if isinstance(n.ctx, ast.Load):
+                    if first_load is None or n.lineno < first_load.lineno:
+                        first_load = n
+                else:
+                    if first_store is None or n.lineno < first_store.lineno:
+                        first_store = n
+        if first_load is None:
+            return None
+        if first_store is not None and first_store.lineno <= first_load.lineno:
+            return None
+        return first_load
+
+
+@register
+class MissingDonation(Rule):
+    """KO111 — a jitted call whose result rebinds one of its own
+    arguments (``state = step(state, ...)``) makes that argument dead at
+    the call; without ``donate_argnums`` XLA keeps both buffers live and
+    the state's HBM footprint doubles."""
+
+    id = "KO111"
+    severity = "info"
+    title = "dead argument not donated"
+    hint = ("the argument is rebound by the result — pass "
+            "donate_argnums=(i,) so XLA updates the buffer in place")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for scope in _scopes(ctx):
+            jits = _local_jits(ctx, scope)
+            plain = {n: j for n, j in jits.items() if not j["donate"]}
+            if not plain:
+                continue
+            scope_key = scope if isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Name)
+                        and node.value.func.id in plain
+                        and ctx.enclosing_function(node) is scope_key):
+                    continue
+                targets = set()
+                for t in node.targets:
+                    targets |= assigned_names(t)
+                for i, arg in enumerate(node.value.args):
+                    if isinstance(arg, ast.Name) and arg.id in targets:
+                        yield self.finding(
+                            ctx, node.value,
+                            f"argument '{arg.id}' (position {i}) is "
+                            f"rebound by the result of "
+                            f"{node.value.func.id}() but not donated")
+
+
+@register
+class RetraceHazard(Rule):
+    """KO112 — retraces: constructing ``jax.jit`` inside a loop makes a
+    fresh compilation cache every iteration, and a loop-varying value at
+    a ``static_argnums`` position retraces once per distinct value."""
+
+    id = "KO112"
+    severity = "warning"
+    title = "retrace per iteration"
+    hint = ("hoist the jax.jit(...) out of the loop (or cache the wrapper "
+            "per static shape bucket, like serve's lru_cached decode_fn)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and ctx.dotted(node.func) == "jax.jit" \
+                    and ctx.in_loop(node):
+                yield self.finding(
+                    ctx, node,
+                    "jax.jit constructed inside a loop body starts from an "
+                    "empty compile cache every iteration (retrace per call)")
+        # loop-varying values at static positions
+        for scope in _scopes(ctx):
+            jits = _local_jits(ctx, scope)
+            static = {n: j for n, j in jits.items() if j["static"]}
+            if not static:
+                continue
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in static
+                        and ctx.in_loop(node)):
+                    continue
+                loop_vars = self._loop_targets(ctx, node)
+                for idx in static[node.func.id]["static"]:
+                    if idx >= len(node.args):
+                        continue
+                    varying = names_in(node.args[idx]) & loop_vars
+                    if varying:
+                        yield self.finding(
+                            ctx, node,
+                            f"static_argnums position {idx} of "
+                            f"{node.func.id}() varies with loop variable "
+                            f"{sorted(varying)[0]!r} — one retrace per "
+                            f"value",
+                            hint="make the argument a traced array, or "
+                                 "bucket it so the static set stays small")
+
+    @staticmethod
+    def _loop_targets(ctx: ModuleContext, node: ast.AST) -> set[str]:
+        out: set[str] = set()
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor)):
+                out |= assigned_names(cur.target)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            cur = ctx.parent(cur)
+        return out
+
+
+@register
+class JitClosureCapture(Rule):
+    """KO113 — a locally-defined function that closes over an array and is
+    then jitted bakes that array into the jaxpr as a compile-time
+    constant: it is re-hashed on every trace check and re-embedded on
+    every retrace, and XLA may constant-fold multi-MB buffers into the
+    executable."""
+
+    id = "KO113"
+    severity = "warning"
+    title = "array captured into a jitted closure"
+    hint = ("pass the array as an explicit argument to the jitted "
+            "function instead of closing over it")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for node in ast.walk(ctx.tree):
+            target = None
+            jit_node = None
+            if isinstance(node, ast.Call) \
+                    and ctx.dotted(node.func) == "jax.jit" and node.args:
+                jit_node, wrapped = node, node.args[0]
+                if isinstance(wrapped, ast.Lambda):
+                    target = wrapped
+                elif isinstance(wrapped, ast.Name):
+                    target = self._sibling_def(ctx, node, wrapped.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    d = deco.func if isinstance(deco, ast.Call) else deco
+                    if ctx.dotted(d) == "jax.jit":
+                        jit_node, target = deco, node
+            if target is None or jit_node is None:
+                continue
+            enclosing = ctx.enclosing_function(jit_node)
+            if enclosing is None:
+                continue
+            captured = self._free_names(target) & _array_locals(ctx,
+                                                                enclosing)
+            if captured:
+                names = ", ".join(f"'{n}'" for n in sorted(captured))
+                yield self.finding(
+                    ctx, jit_node,
+                    f"jitted function captures array {names} from the "
+                    f"enclosing scope as a compile-time constant")
+
+    @staticmethod
+    def _sibling_def(ctx: ModuleContext, node: ast.AST,
+                     name: str) -> ast.AST | None:
+        enclosing = ctx.enclosing_function(node)
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == name \
+                    and ctx.enclosing_function(n) is enclosing:
+                return n
+        return None
+
+    @staticmethod
+    def _free_names(func: ast.AST) -> set[str]:
+        params = {a.arg for a in ast.walk(func)
+                  if isinstance(a, ast.arg)}
+        bound, loads = set(params), set()
+        for n in ast.walk(func):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                else:
+                    loads.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+        return loads - bound
+
+
+def _array_locals(ctx: ModuleContext, func: ast.AST) -> set[str]:
+    """Names assigned in ``func`` (not in nested defs) from an
+    array-creating call."""
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) \
+                and ctx.enclosing_function(node) is func \
+                and isinstance(node.value, ast.Call) \
+                and ctx.dotted(node.value.func) in _ARRAY_FNS:
+            for target in node.targets:
+                out |= assigned_names(target)
+    return out
+
+
+@register
+class UnpinnedShardedWrite(Rule):
+    """KO120 — in an engine that routes pool buffers through a canonical
+    placement helper (``_pin`` / ``with_sharding_constraint``), writing a
+    ``.at[...]`` scatter result straight onto ``self`` skips the re-pin:
+    the next donated dispatch sees a different layout and GSPMD re-lays
+    the buffer out (or the donation fails)."""
+
+    id = "KO120"
+    severity = "warning"
+    title = "sharded-buffer write without a placement pin"
+    hint = ("wrap the scatter result in self._pin(..., sharding) (or "
+            "jax.lax.with_sharding_constraint) before storing it")
+
+    _UPDATES = {"set", "add", "multiply", "divide", "min", "max", "apply"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                       and m.name == "_pin" for m in cls.body):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._targets_self(node):
+                    continue
+                scatter = self._scatter_in(node.value)
+                if scatter is not None \
+                        and not self._pinned(ctx, node.value):
+                    yield self.finding(
+                        ctx, node,
+                        "a .at[...] update lands on self without passing "
+                        "through _pin/with_sharding_constraint — the "
+                        "pool's canonical layout is lost")
+
+    @staticmethod
+    def _targets_self(node: ast.Assign) -> bool:
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self" \
+                        and isinstance(n.ctx, ast.Store):
+                    return True
+        return False
+
+    def _scatter_in(self, expr: ast.AST) -> ast.AST | None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self._UPDATES:
+                root = n.func.value
+                while isinstance(root, (ast.Subscript, ast.Attribute)):
+                    if isinstance(root, ast.Attribute) and root.attr == "at":
+                        return n
+                    root = root.value
+        return None
+
+    @staticmethod
+    def _pinned(ctx: ModuleContext, expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        name = ctx.dotted(expr.func)
+        if name and name.endswith("with_sharding_constraint"):
+            return True
+        return isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "_pin"

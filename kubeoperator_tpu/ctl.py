@@ -264,6 +264,22 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    # local static analysis — no controller, no login
+    from kubeoperator_tpu.analysis.cli import run_lint
+    argv = list(args.paths)
+    if args.as_json:
+        argv.append("--json")
+    if args.no_project:
+        argv.append("--no-project")
+    if args.list_rules:
+        argv.append("--list-rules")
+    argv += ["--fail-level", args.fail_level]
+    for sel in args.select or ():
+        argv += ["--select", sel]
+    return run_lint(argv)
+
+
 def build_parser(sub) -> None:
     """Register the ``ctl`` subcommands on the main argument parser."""
     login = sub.add_parser("login", help="authenticate against a controller")
@@ -314,6 +330,19 @@ def build_parser(sub) -> None:
     tk.set_defaults(fn=cmd_tasks)
     sub.add_parser("packages", help="list offline packages").set_defaults(fn=cmd_packages)
     sub.add_parser("dashboard", help="fleet summary").set_defaults(fn=cmd_dashboard)
+
+    lint = sub.add_parser(
+        "lint", help="static hot-path / control-plane analyzer")
+    lint.add_argument("paths", nargs="*", default=["kubeoperator_tpu"])
+    lint.add_argument("--json", action="store_true", dest="as_json")
+    lint.add_argument("--fail-level", default="warning",
+                      choices=("info", "warning", "error"))
+    lint.add_argument("--select", action="append", default=None,
+                      metavar="RULES", help="comma-separated rule ids")
+    lint.add_argument("--no-project", action="store_true",
+                      help="skip README/catalog project checks")
+    lint.add_argument("--list-rules", action="store_true")
+    lint.set_defaults(fn=cmd_lint)
 
     logs = sub.add_parser("logs", help="search system logs")
     logs.add_argument("--query", default="")

@@ -618,6 +618,7 @@ class ChaosExecutor(Executor):
         span (when an operation is tracing) plus a chaos counter sample —
         the soak's output stops being a black box. Caller holds _lock;
         telemetry uses its own locks, so no ordering hazard."""
+        # ko: lint-ok[KO201] every caller holds _lock (see _chaos) — taking it here would deadlock
         self.injected += 1
         metrics.CHAOS_INJECTIONS.inc(kind=kind)
         tracing.add_event("chaos", kind=kind, ip=ip)

@@ -177,7 +177,9 @@ class TaskEngine:
         schedule()
 
     def shutdown(self, wait: bool = True) -> None:
-        self._closed = True
-        for t in self._periodic:
+        with self._lock:
+            self._closed = True
+            periodic = list(self._periodic)
+        for t in periodic:
             t.cancel()
         self.pool.shutdown(wait=wait)

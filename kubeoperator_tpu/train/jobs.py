@@ -592,10 +592,13 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
             jnp.arange(y.shape[0]), y],
         n_micro=args.microbatches)
 
-    @jax.jit
-    def step(params, x, y):
+    def step_fn(params, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         return jax.tree.map(lambda p, g: p - args.lr * g, params, grads), loss
+
+    # params is rebound by the result every step — donate so XLA reuses
+    # the buffers instead of keeping two copies of the model live
+    step = jax.jit(step_fn, donate_argnums=(0,))
 
     batch = args.batch or args.microbatches * max(1, spec.dp * spec.fsdp)
     x = jax.random.randint(jax.random.key(1), (batch,), 0, vocab)

@@ -370,33 +370,44 @@ class SlotPoolEngine:
                  self._pin(cv.at[idx, :c].set(chunk_v[l]), self._cache_sh)))
         self._caches = new_caches
 
-        out: dict[int, int] = {}
-        buf, pos, last = self._buf, self._pos, self._last
-        plen_v, temp_v, seeds_v = self._plen, self._temp, self._seeds
-        for i, (slot, prompt, max_tokens, temperature, seed) in \
-                enumerate(group):
-            plen = len(prompt)
-            row = np.zeros((self.max_total,), np.int32)
-            row[:plen] = prompt
-            row_j = jnp.asarray(row)
-            if c == plen:
-                # pow2-length prompt: position C holds the FIRST generated
-                # token, chosen from the prefill's last-position logits —
-                # the same boundary choose as generate()'s prefill
-                lg = logits[i, -1]
-                if temperature > 0:
-                    key = jax.random.fold_in(jax.random.key(seed), c - 1)
-                    tok = jax.random.categorical(key, lg / temperature)
-                else:
-                    tok = jnp.argmax(lg)
-                row_j = row_j.at[c].set(tok.astype(jnp.int32))
-            buf = buf.at[slot].set(row_j)
-            pos = pos.at[slot].set(c)
-            last = last.at[slot].set(plen + max_tokens - 1)
-            plen_v = plen_v.at[slot].set(plen)
-            temp_v = temp_v.at[slot].set(temperature)
-            seeds_v = seeds_v.at[slot].set(seed)
-            out[slot] = c
+        # stack the group's rows on host, transfer ONCE, then one batched
+        # scatter per pool buffer — the per-request jnp.asarray +
+        # .at[slot].set loop this replaces cost k host->device dispatches
+        # per buffer per admission wave (the linter's KO101 flagship)
+        plens_np = np.array([len(g[1]) for g in group], np.int32)
+        maxtok_np = np.array([g[2] for g in group], np.int32)
+        temps_np = np.array([g[3] for g in group], np.float32)
+        seeds_np = np.array([g[4] for g in group], np.int32)
+        rows_np = np.zeros((k, self.max_total), np.int32)
+        for i, (_, prompt, _, _, _) in enumerate(group):
+            rows_np[i, : len(prompt)] = prompt
+        rows_j = jnp.asarray(rows_np)
+
+        boundary = np.nonzero(plens_np == c)[0]
+        if boundary.size:
+            # pow2-length prompts: position C holds the FIRST generated
+            # token, chosen from the prefill's last-position logits — the
+            # same boundary choose as generate()'s prefill, batched the
+            # way _micro_step batches its per-row choose
+            bidx = jnp.asarray(boundary.astype(np.int32))
+            lg = logits[bidx, -1]                       # [b, vocab]
+            b_temp = jnp.asarray(temps_np[boundary])
+            keys = jax.vmap(lambda sd: jax.random.fold_in(
+                jax.random.key(sd), c - 1))(jnp.asarray(seeds_np[boundary]))
+            safe_t = jnp.where(b_temp > 0, b_temp, 1.0)
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, lg / safe_t[:, None]).astype(jnp.int32)
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            rows_j = rows_j.at[bidx, c].set(
+                jnp.where(b_temp > 0, sampled, greedy))
+
+        buf = self._buf.at[idx].set(rows_j)
+        pos = self._pos.at[idx].set(c)
+        last = self._last.at[idx].set(jnp.asarray(plens_np + maxtok_np - 1))
+        plen_v = self._plen.at[idx].set(jnp.asarray(plens_np))
+        temp_v = self._temp.at[idx].set(jnp.asarray(temps_np))
+        seeds_v = self._seeds.at[idx].set(jnp.asarray(seeds_np))
+        out = {int(slot): c for slot in slots_np}
         self._buf = self._pin(buf, self._buf_sh)
         self._pos = self._pin(pos, self._vec_sh)
         self._last = self._pin(last, self._vec_sh)

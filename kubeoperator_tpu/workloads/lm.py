@@ -65,6 +65,7 @@ class LMTrainer:
             nn.get_partition_spec(boxed), self.mesh, self.rules)
         out_shardings = {"step": replicated(self.mesh), "params": param_shardings,
                          "opt_state": None}
+        # ko: lint-ok[KO113] one-shot init: tokens is a tiny tracer input, jit runs exactly once
         state = jax.jit(init, out_shardings=out_shardings)(rng)
         self.state_shardings = jax.tree.map(lambda x: x.sharding, state)
         return state

@@ -392,6 +392,7 @@ class ContinuousBatcher:
                     # admission prefill itself
                     self.stats.ttft(now() - r.submitted_at)
                     t["ttft"] = True
+                # ko: lint-ok[KO201] single-writer: only the worker thread mutates _track
                 self._track[slot] = t
             self._report_occupancy()
 

@@ -288,20 +288,28 @@ def bench_scaling_real(shapes=SCALING_SHAPES) -> dict:
                           "skipped": f"only {jax.device_count()} devices"})
             continue
         spec = MeshSpec(dp=dp, tp=tp) if n > 1 else None
-        eng = SlotPoolEngine(cfg, params, slots=4 * dp, segment=8,
-                             mesh_spec=spec,
-                             devices=jax.devices()[:n] if n > 1 else None)
-        eng.admit([(s, [1 + s, 2, 3, 4], 24, 0.0, 0)
-                   for s in range(4 * dp)])
-        eng.run_segment()          # compile outside the timed window
-        t0 = time.perf_counter()
-        for _ in range(3):
-            eng.run_segment()
-        wall = time.perf_counter() - t0
+        # count compiles per (function, shape signature) while the
+        # engine runs — a hot-path retrace shows up as traces>signatures
+        # in the artifact long before it shows up as a latency regression
+        from kubeoperator_tpu.analysis.compile_guard import (
+            compile_count_guard,
+        )
+        with compile_count_guard() as guard:
+            eng = SlotPoolEngine(cfg, params, slots=4 * dp, segment=8,
+                                 mesh_spec=spec,
+                                 devices=jax.devices()[:n] if n > 1 else None)
+            eng.admit([(s, [1 + s, 2, 3, 4], 24, 0.0, 0)
+                       for s in range(4 * dp)])
+            eng.run_segment()      # compile outside the timed window
+            t0 = time.perf_counter()
+            for _ in range(3):
+                eng.run_segment()
+            wall = time.perf_counter() - t0
         new_tok = 3 * 8 * 4 * dp
         curve.append({"n_devices": n, "dp": dp, "tp": tp,
                       "wall_s": round(wall, 3),
-                      "tok_s": round(new_tok / wall, 1)})
+                      "tok_s": round(new_tok / wall, 1),
+                      "compile_counts": guard.by_function()})
     return {"device_kind": jax.devices()[0].platform, "curve": curve}
 
 
@@ -344,6 +352,12 @@ def main() -> None:
                 f"dp={p['dp']} tp={p['tp']} n={p['n_devices']} "
                 f"slots={p['slots']} tok_s={p['tok_s']}"
                 for p in result["curve"])
+            real_counts = None
+            if args.real:
+                real_counts = {
+                    f"dp{p['dp']}xtp{p['tp']}": p["compile_counts"]
+                    for p in result["real"]["curve"]
+                    if "compile_counts" in p}
             artifact = {
                 "n_devices": result["curve"][-1]["n_devices"],
                 "rc": 0,
@@ -351,6 +365,7 @@ def main() -> None:
                 "skipped": False,
                 "speedup_max_devices": result["speedup_max_devices"],
                 "curve": result["curve"],
+                "compile_counts": real_counts,
                 "tail": tail,
             }
             with open(args.out, "w") as f:

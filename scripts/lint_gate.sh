@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# CI lint gate: exit non-zero on any finding at or above --fail-level
+# (default: warning). Tier-1's self-clean assertion (tests/test_lint.py)
+# and this script invoke the same engine — one gate, two entry points.
+#
+#   scripts/lint_gate.sh                 # lint kubeoperator_tpu/
+#   scripts/lint_gate.sh path --json     # any ko-lint arguments pass through
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m kubeoperator_tpu.analysis.cli "${@:-kubeoperator_tpu}"

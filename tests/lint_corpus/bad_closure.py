@@ -1,0 +1,12 @@
+"""Corpus: large array baked into a jit as a closure constant (KO113)."""
+import jax
+import jax.numpy as jnp
+
+
+def build():
+    table = jnp.zeros((1024, 1024))
+
+    def apply(x):
+        return x @ table
+
+    return jax.jit(apply)     # KO113: table becomes a compile-time constant

@@ -1,0 +1,17 @@
+"""Corpus: donation misuse (KO110) and missed donation (KO111)."""
+import jax
+import jax.numpy as jnp
+
+
+def reuse_after_donation():
+    step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    x = jnp.zeros((8,))
+    y = step(x)
+    return y + x        # KO110: x was donated, its buffer is gone
+
+
+def rebound_but_not_donated():
+    step = jax.jit(lambda p: p * 2)
+    p = jnp.zeros((8,))
+    p = step(p)         # KO111: p is dead across the call — donate it
+    return p

@@ -1,0 +1,17 @@
+"""Corpus: per-iteration host<->device traffic (KO101 + KO102)."""
+import jax
+import jax.numpy as jnp
+
+
+def admit(rows, buf):
+    for i, row in enumerate(rows):
+        buf = buf.at[i].set(jnp.asarray(row))      # KO101: transfer per row
+    return buf
+
+
+def drain(n):
+    ys = jnp.ones((4,))
+    total = 0.0
+    while total < n:
+        total += jax.device_get(ys)[0]             # KO102: sync per check
+    return total

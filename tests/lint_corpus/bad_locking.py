@@ -1,0 +1,15 @@
+"""Corpus: shared-state write outside the declared lock (KO201)."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def update(self, key, value):
+        self.state = {key: value}     # KO201: not under self._lock
+
+    def update_locked(self, key, value):
+        with self._lock:
+            self.state = {key: value}

@@ -1,0 +1,3 @@
+"""Corpus: undeclared ko_* metric name (KO210)."""
+
+REQUESTS = "ko_serve_requestz_total"     # KO210: typo, not in the registry

@@ -1,0 +1,16 @@
+"""Corpus: pragma hygiene — reasonless (KO000) and unknown rule (KO001),
+plus one unsuppressed KO201 to show a mismatched pragma does nothing."""
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bare(self):
+        self.count = 1  # ko: lint-ok[KO201]
+
+    def unknown(self):
+        # ko: lint-ok[KO999] suppressing a rule that does not exist
+        self.count = 3

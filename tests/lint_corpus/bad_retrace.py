@@ -1,0 +1,9 @@
+"""Corpus: retrace hazard (KO112) — jit built once per iteration."""
+import jax
+
+
+def hot(fn, xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(fn)(x))     # KO112: fresh jit every iteration
+    return out

@@ -1,0 +1,5 @@
+"""Corpus: file that does not parse (KO002)."""
+
+
+def broken(:
+    pass

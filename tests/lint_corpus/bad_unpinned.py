@@ -1,0 +1,17 @@
+"""Corpus: sharded-pool write without a placement pin (KO120)."""
+import jax.numpy as jnp
+
+
+class Pool:
+    def __init__(self, buf, sh):
+        self._buf = buf
+        self._buf_sh = sh
+
+    def _pin(self, x, sh):
+        return x
+
+    def admit(self, idx, rows):
+        self._buf = self._buf.at[idx].set(rows)   # KO120: layout not pinned
+
+    def admit_pinned(self, idx, rows):
+        self._buf = self._pin(self._buf.at[idx].set(rows), self._buf_sh)

@@ -1,0 +1,291 @@
+"""`ko lint` (ISSUE 7): golden corpus findings per rule id, pragma
+semantics, JSON report schema, the self-clean gate over the package, the
+project-scoped drift rules (KO211/KO212/KO220), and the runtime
+compile-count guard pinning the serving segment fn and a train step to
+one compile per shape signature."""
+
+import io
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.analysis import (
+    RULES, compile_count_guard, lint_file, lint_paths,
+)
+from kubeoperator_tpu.analysis.cli import run_lint
+from kubeoperator_tpu.analysis.project import (
+    check_catalog, check_readme_metrics, check_readme_rules,
+)
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.abspath(os.path.join(HERE, ".."))
+CORPUS = os.path.join(HERE, "lint_corpus")
+PKG = os.path.join(REPO, "kubeoperator_tpu")
+
+# one golden rule-id set per known-bad fixture — exact, no extras
+GOLDEN = {
+    "bad_host_loop.py": {"KO101", "KO102"},
+    "bad_donation.py": {"KO110", "KO111"},
+    "bad_retrace.py": {"KO112"},
+    "bad_closure.py": {"KO113"},
+    "bad_unpinned.py": {"KO120"},
+    "bad_locking.py": {"KO201"},
+    "bad_metric.py": {"KO210"},
+    "bad_pragma.py": {"KO000", "KO001", "KO201"},
+    "bad_syntax.py": {"KO002"},
+}
+
+
+# ---------------------------------------------------------------------------
+# golden corpus
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fname,expected", sorted(GOLDEN.items()))
+def test_corpus_golden_findings(fname, expected):
+    findings, _ = lint_file(os.path.join(CORPUS, fname))
+    assert {f.rule for f in findings} == expected, \
+        "\n".join(f.format() for f in findings)
+    for f in findings:
+        assert f.path.endswith(fname) and f.line >= 1 and f.col >= 1
+        assert f.severity in ("info", "warning", "error")
+        assert f.message
+
+
+def test_corpus_covers_ten_distinct_rules():
+    ids = set().union(*GOLDEN.values())
+    assert len(ids) >= 10, sorted(ids)
+
+
+def test_every_registered_module_rule_has_a_golden_fixture():
+    module_rules = {rid for rid, r in RULES.items()
+                    if not getattr(r, "project_scope", False)}
+    covered = set().union(*GOLDEN.values())
+    assert module_rules <= covered, sorted(module_rules - covered)
+
+
+# ---------------------------------------------------------------------------
+# pragma semantics
+# ---------------------------------------------------------------------------
+
+def test_pragma_with_reason_suppresses():
+    text = (
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n = 1  # ko: lint-ok[KO201] single-writer by design\n"
+    )
+    findings, suppressed = lint_file("x.py", text=text)
+    assert findings == [] and suppressed == 1
+
+
+def test_standalone_pragma_covers_next_line():
+    text = (
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        # ko: lint-ok[KO201] single-writer by design\n"
+        "        self.n = 1\n"
+    )
+    findings, suppressed = lint_file("x.py", text=text)
+    assert findings == [] and suppressed == 1
+
+
+def test_pragma_hygiene_rules_are_not_suppressible():
+    # a reasonless wildcard suppresses every rule EXCEPT the pragma
+    # hygiene pair — its own KO000 survives
+    text = "x = 1  # ko: lint-ok[*]\n"
+    findings, _ = lint_file("x.py", text=text)
+    assert {f.rule for f in findings} == {"KO000"}
+
+
+# ---------------------------------------------------------------------------
+# engine output: JSON schema, severity gate, CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_json_report_schema():
+    result = lint_paths([CORPUS], project=False)
+    doc = json.loads(result.to_json())
+    assert doc["version"] == 1
+    assert doc["files"] >= len(GOLDEN)
+    assert set(doc["counts"]) == {"info", "warning", "error"}
+    assert isinstance(doc["suppressed"], int)
+    assert doc["findings"], "corpus must produce findings"
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "hint"}
+    # sorted by (path, line, col, rule)
+    keys = [(f["path"], f["line"], f["col"], f["rule"])
+            for f in doc["findings"]]
+    assert keys == sorted(keys)
+
+
+def test_select_runs_a_subset():
+    findings, _ = lint_file(
+        os.path.join(CORPUS, "bad_host_loop.py"), select={"KO101"})
+    assert {f.rule for f in findings} == {"KO101"}
+
+
+def test_cli_exit_codes():
+    assert run_lint([CORPUS, "--no-project"], out=io.StringIO()) == 1
+    # info findings alone do not trip the default warning gate
+    assert run_lint([os.path.join(CORPUS, "bad_donation.py"),
+                     "--no-project", "--select", "KO111"],
+                    out=io.StringIO()) == 0
+    assert run_lint([os.path.join(CORPUS, "bad_donation.py"),
+                     "--no-project", "--select", "KO111",
+                     "--fail-level", "info"], out=io.StringIO()) == 1
+
+
+def test_ko_ctl_routes_lint():
+    from kubeoperator_tpu.ctl import main
+    assert main(["lint", "--list-rules"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the repo ships lint-clean at the default gate (warning), project rules
+# included — `ko lint kubeoperator_tpu/` exits 0
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    assert run_lint([PKG], out=io.StringIO()) == 0
+
+
+# ---------------------------------------------------------------------------
+# project-scoped rules
+# ---------------------------------------------------------------------------
+
+def test_catalog_schema_golden():
+    findings = check_catalog(os.path.join(CORPUS, "bad_catalog.yml"))
+    assert {f.rule for f in findings} == {"KO220"}
+    msgs = "\n".join(f.message for f in findings)
+    assert "'module' is required" in msgs
+    assert "'retry' must be an integer >= 0" in msgs
+    assert "'targets' must be a non-empty list" in msgs
+    assert "'timeout_s' must be a positive number" in msgs
+    assert "references undefined step 'ghost-step'" in msgs
+    assert "dependency cycle" in msgs
+    assert all(f.line > 1 for f in findings), "findings carry line anchors"
+
+
+def test_real_catalog_is_clean():
+    assert check_catalog(
+        os.path.join(PKG, "config", "catalog.yml")) == []
+
+
+def test_readme_metric_drift_detected(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "## Observability\n"
+        "| metric | type |\n"
+        "|---|---|\n"
+        "| `ko_step_duration_seconds` | histogram |\n"
+        "| `ko_made_up_total` | counter |\n"
+        "## Serving\n"
+        "see `ko_serve_ghost_total` for details\n")
+    findings = check_readme_metrics(str(tmp_path), readme=str(readme))
+    msgs = "\n".join(f.message for f in findings)
+    assert "ko_made_up_total" in msgs                  # stale table row
+    assert "ko_serve_ghost_total" in msgs              # stale inline mention
+    assert "ko_serve_requests_total" in msgs           # registered, missing
+    assert all(f.rule == "KO211" for f in findings)
+
+
+def test_readme_rule_table_drift_detected(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "## Static analysis\n"
+        "| rule | severity |\n"
+        "|---|---|\n"
+        "| KO101 | warning |\n"
+        "| KO998 | error |\n")
+    findings = check_readme_rules(str(tmp_path), readme=str(readme))
+    msgs = "\n".join(f.message for f in findings)
+    assert "KO998" in msgs                             # documented, unknown
+    assert "KO201" in msgs                             # registered, missing
+    assert all(f.rule == "KO212" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# compile-count guard: 1 compile per shape signature on the hot paths
+# ---------------------------------------------------------------------------
+
+def _tiny_engine_cfg():
+    from kubeoperator_tpu.workloads.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_seq_len=24,
+                             dtype=jnp.float32)
+
+
+def test_guard_pins_serving_segment_fn():
+    import flax.linen as nn
+    import jax
+
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+    from kubeoperator_tpu.workloads.transformer import Transformer
+
+    cfg = _tiny_engine_cfg()
+    params = nn.unbox(Transformer(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    with compile_count_guard() as guard:
+        eng = SlotPoolEngine(cfg, params, slots=4, segment=4)
+        eng.admit([(0, [5, 6, 7], 8, 0.0, 0), (1, [9, 10, 11, 12], 8, 0.0, 1)])
+        for _ in range(3):
+            eng.run_segment()
+        before = dict(guard.counts)
+        # a second same-bucket admission wave: eager prefill, no new jit
+        # traces anywhere — total trace count stays flat
+        eng.admit([(2, [3, 4, 5], 8, 0.0, 2)])
+        eng.run_segment()
+        assert guard.counts == before
+    guard.assert_single_compile()
+    assert guard.traces_for("_segment_body") == [1]
+
+
+def test_guard_pins_train_step():
+    from kubeoperator_tpu.workloads.sharding import MeshSpec
+    from kubeoperator_tpu.workloads.train import TrainConfig, Trainer
+
+    cfg = TrainConfig(batch_size=16, image_size=32, num_classes=10,
+                      depth=18, warmup_steps=2, total_steps=10)
+    with compile_count_guard() as guard:
+        tr = Trainer(cfg, MeshSpec(dp=8))
+        state = tr.init_state()
+        images, labels = tr.synthetic_batch()
+        for _ in range(3):
+            state, _metrics = tr.train_step(state, images, labels)
+    guard.assert_single_compile("_py_step")
+    assert guard.traces_for("_py_step") == [1]
+    assert int(state.step) == 3
+
+
+def test_guard_detects_a_retrace():
+    import jax
+
+    with compile_count_guard() as guard:
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.zeros((4,)))
+        f(jnp.zeros((4,)))       # cache hit: no second trace
+        f(jnp.zeros((8,)))       # new shape: second signature, fine
+    guard.assert_single_compile()
+    assert guard.total("<lambda>") == 2
+    assert sorted(guard.traces_for("<lambda>")) == [1, 1]
+
+    with compile_count_guard() as guard:
+        def fresh(x):
+            return x + 1
+        for _ in range(2):
+            jax.jit(fresh)(jnp.zeros((4,)))   # the KO112 shape, at runtime
+    with pytest.raises(AssertionError, match="retrace"):
+        guard.assert_single_compile()
+    report = guard.by_function()
+    assert report["fresh"]["traces"] == 2
+    assert report["fresh"]["signatures"] == 1

@@ -122,26 +122,18 @@ def test_history_accumulates_for_charts(platform, installed):  # noqa: F811
 
 
 # ---------------------------------------------------------------------------
-# first-party telemetry (ISSUE 3 satellite): the README "Observability"
-# metric table and the registry's vocabulary must not drift — same
-# cross-check stance as the PROMQL/exporter pairing above
+# first-party telemetry: the README metric tables and the registry's
+# vocabulary must not drift. The check itself now lives in the lint
+# engine (rule KO211, covering the Observability + Serving tables and
+# inline mentions through the Scheduling section) — this test just runs
+# it, so `ko lint` and tier-1 share one source of truth.
 # ---------------------------------------------------------------------------
 
 def test_readme_metric_table_matches_registry():
     import os
 
-    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+    from kubeoperator_tpu.analysis.project import check_readme_metrics
 
-    readme = os.path.join(os.path.dirname(__file__), "..", "README.md")
-    with open(readme, encoding="utf-8") as f:
-        text = f.read()
-    documented = set()
-    for heading in ("## Observability", "## Serving"):
-        assert heading in text, f"README lost its {heading} section"
-        section = text.split(heading, 1)[1].split("\n## ", 1)[0]
-        documented |= set(re.findall(r"^\| `(ko_[a-z0-9_]+)`", section, re.M))
-    registered = set(REGISTRY.names())
-    assert documented == registered, (
-        f"README table vs registry drift — undocumented: "
-        f"{sorted(registered - documented)}, stale rows: "
-        f"{sorted(documented - registered)}")
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    findings = check_readme_metrics(root)
+    assert not findings, "\n".join(f.format() for f in findings)
