@@ -543,3 +543,72 @@ class UnpinnedShardedWrite(Rule):
             return True
         return isinstance(expr.func, ast.Attribute) \
             and expr.func.attr == "_pin"
+
+
+@register
+class PagedPoolWriteBypass(Rule):
+    """KO121 — in an engine that serves from a paged KV pool (it defines
+    the block-table indirection helper ``_page_write``), a direct
+    ``.at[...]`` update on a pool buffer anywhere else bypasses the
+    (slot, pos) -> (page, offset) translation. A raw slot- or
+    position-indexed write lands in whichever request currently owns that
+    page index — data corruption that no shape check can catch, because
+    every page has the same shape."""
+
+    id = "KO121"
+    severity = "error"
+    title = "page-table write discipline"
+    hint = ("route the write through the engine's _page_write(pool, pages, "
+            "offsets, vals) / _page_copy(pool, dst, src) helpers so the "
+            "block table translates (slot, pos) to (page, offset)")
+
+    _UPDATES = {"set", "add", "multiply", "divide", "min", "max", "apply"}
+    _ALLOWED = {"_page_write", "_page_copy"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                       and m.name == "_page_write" for m in cls.body):
+                continue
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._UPDATES):
+                    continue
+                base = self._pool_base(node.func.value)
+                if base is None:
+                    continue
+                fn = ctx.enclosing_function(node)
+                if fn is not None and getattr(fn, "name", "") \
+                        in self._ALLOWED:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"direct .at[...].{node.func.attr} on paged pool "
+                    f"buffer '{base}' outside _page_write/_page_copy — "
+                    f"the write skips the block-table (page, offset) "
+                    f"translation and can corrupt another request's page")
+
+    @staticmethod
+    def _pool_base(expr: ast.AST) -> str | None:
+        """Name of the pool buffer a ``.at[...]`` chain updates ('pool'
+        in the identifier marks the paged buffers), else None."""
+        saw_at = False
+        node = expr
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if isinstance(node, ast.Attribute):
+                if node.attr == "at":
+                    saw_at = True
+                elif saw_at and "pool" in node.attr.lower():
+                    return node.attr
+                node = node.value
+                continue
+            node = node.value
+        if saw_at and isinstance(node, ast.Name) \
+                and "pool" in node.id.lower():
+            return node.id
+        return None

@@ -282,7 +282,11 @@ data:
         "targets": [{{"expr": "sum(ko_serve_slot_occupancy)"}},
                     {{"expr": "sum(ko_serve_slot_occupancy) by (shard)", "legendFormat": "shard {{{{shard}}}}"}}]}},
       {{"title": "Serve TTFT p95", "type": "timeseries", "gridPos": {{"x":12,"y":16,"w":12,"h":8}},
-        "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}}]}}
+        "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}}]}},
+      {{"title": "KV pages used (by mesh shard) / prefix hit rate", "type": "timeseries", "gridPos": {{"x":0,"y":24,"w":12,"h":8}},
+        "targets": [{{"expr": "sum(ko_serve_kv_pages_used)"}},
+                    {{"expr": "sum(ko_serve_kv_pages_used) by (shard)", "legendFormat": "shard {{{{shard}}}}"}},
+                    {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}}
     ]}}
 ---
 apiVersion: v1

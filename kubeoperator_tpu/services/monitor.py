@@ -49,6 +49,9 @@ QUERIED_METRICS = {
     # continuous engine (round 6): pool utilization + first-token latency
     "ko_serve_slot_occupancy": "jax-serve",
     "ko_serve_ttft_seconds_bucket": "jax-serve",
+    # paged KV cache (round 8): page-pool pressure + prefix-cache payoff
+    "ko_serve_kv_pages_used": "jax-serve",
+    "ko_serve_prefix_hits_total": "jax-serve",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -73,6 +76,11 @@ PROMQL = {
     "serve_ttft_p95":
         "histogram_quantile(0.95, "
         "sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))",
+    # paged KV (round 8): pool-wide page pressure (the admission limiter —
+    # nearing pages-per-shard means backpressure, scale dp or pages) and
+    # the prefix cache's hit rate (skipped prefills per second)
+    "serve_kv_pages_used": "sum(ko_serve_kv_pages_used)",
+    "serve_prefix_hit_rate": "sum(rate(ko_serve_prefix_hits_total[5m]))",
 }
 
 
@@ -279,6 +287,10 @@ class ClusterMonitor:
         except Exception:  # noqa: BLE001 — metric gaps are data, not errors
             serve_shards = {}
         serve_ttft = prom.scalar(PROMQL["serve_ttft_p95"], default=-1.0)
+        serve_pages = prom.scalar(PROMQL["serve_kv_pages_used"],
+                                  default=-1.0)
+        serve_hit_rate = prom.scalar(PROMQL["serve_prefix_hit_rate"],
+                                     default=-1.0)
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -298,6 +310,8 @@ class ClusterMonitor:
             "serve_slot_occupancy": serve_slots,
             "serve_slot_shards": serve_shards,
             "serve_ttft_p95": serve_ttft,
+            "serve_kv_pages_used": serve_pages,
+            "serve_prefix_hit_rate": serve_hit_rate,
             "time": iso_now(),
         }
         self._save_snapshot(data)
@@ -333,6 +347,8 @@ class ClusterMonitor:
                        "serve_tokens_rate": data["serve_tokens_rate"],
                        "serve_slot_occupancy": data["serve_slot_occupancy"],
                        "serve_ttft_p95": data["serve_ttft_p95"],
+                       "serve_kv_pages_used": data["serve_kv_pages_used"],
+                       "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
                        "pod_count": data["pod_count"]})
         hist.data = {"points": points[-self.HISTORY_POINTS:]}
         hist.created_at = iso_now()

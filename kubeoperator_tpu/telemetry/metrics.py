@@ -432,6 +432,16 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             "Wall time of one decode-segment dispatch (continuous "
             "engine).",
             buckets=SERVE_SEGMENT_BUCKETS),
+        "kv_pages_used": registry.gauge(
+            "ko_serve_kv_pages_used",
+            "KV-cache pages allocated to live slots or the prefix cache, "
+            "per dp mesh shard (paged continuous engine; excludes the "
+            "reserved trash page).",
+            labels=("shard",)),
+        "prefix_hits": registry.counter(
+            "ko_serve_prefix_hits_total",
+            "Admissions that reused cached prompt-prefix pages (their "
+            "prefill was skipped; paged continuous engine)."),
     }
 
 

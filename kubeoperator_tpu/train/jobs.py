@@ -359,6 +359,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         try:
             engine = SlotPoolEngine(cfg, model_params, slots=args.slots,
                                     segment=args.segment,
+                                    page=args.page, pages=args.pages,
                                     mesh_spec=mesh_spec)
         except ValueError as e:
             raise SystemExit(f"serve: {e}") from e
@@ -369,6 +370,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         # triples are accepted for CLI compatibility but moot here.
         emit({"job": "serve", "engine": "continuous",
               "slots": args.slots, "segment": args.segment,
+              "page": engine.page, "pages": engine.pages,
               "mesh": (dict(engine.spec.sizes())
                        if engine.spec is not None else None)})
         engine.run_segment()
@@ -688,6 +690,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="continuous engine: shard the pool, e.g. "
                          "'dp:2,tp:4' — slots over dp, attention heads "
                          "over tp (default: solo single-device path)")
+    sv.add_argument("--page", type=int, default=None,
+                    help="continuous engine: tokens per KV-cache page "
+                         "(power of two dividing max_seq_len; default "
+                         "min(16, max_seq_len) rounded down)")
+    sv.add_argument("--pages", type=int, default=None,
+                    help="continuous engine: total KV pages across dp "
+                         "shards — the admission limiter (default "
+                         "slots * max_seq_len/page + dp, dense-"
+                         "equivalent HBM)")
 
     pp = sub.add_parser("pipeline",
                         help="device-pipelined training over a pp mesh axis")

@@ -8,17 +8,39 @@ two semantics at ~2.4x (PERF.md: 1,533 aggregate tok/s through the
 endpoint vs 3,696 from the raw decode loop).
 
 This module keeps a fixed pool of S decode *slots* alive on the device
-instead. Each slot owns a row in every per-layer (k, v) cache buffer —
-the same explicit-buffer layout as ``generate._decode_scan``, so XLA
-aliases the cache updates in place — plus per-slot ``pos`` / ``last`` /
-``plen`` / ``temp`` / ``seed`` vectors. One jitted *segment* dispatch
-advances every active slot K tokens (a ``lax.scan`` over K micro-steps,
-amortizing dispatch latency exactly like the solo scan does); rows stop
-at exactly ``prompt_len + max_tokens`` — no decode-length padding — and a
-per-row temperature lets mixed-temperature traffic co-batch. Between
-segments the host retires finished slots with ONE batched fetch and
-admits queued requests into free slots via chunked prefill written into
-the slot's cache region in place.
+instead. One jitted *segment* dispatch advances every active slot K
+tokens (a ``lax.scan`` over K micro-steps, amortizing dispatch latency
+exactly like the solo scan does); rows stop at exactly
+``prompt_len + max_tokens`` — no decode-length padding — and a per-row
+temperature lets mixed-temperature traffic co-batch. Between segments the
+host retires finished slots with ONE batched fetch and admits queued
+requests at segment boundaries via chunked prefill.
+
+Paged KV (round 8): slots no longer own dense ``[T=max_seq_len]`` cache
+rows. Each layer keeps one global page *pool* ``[P, page, H, D]`` and
+each slot a tiny int32 *block table* ``[T/page]`` naming the pages that
+back its positions; the segment jit gathers ``pool[block_table]`` back
+into the dense ``[S, T, H, D]`` view (a pure permutation copy, so every
+einsum/mask/cast below sees bit-identical operands) and scatters the
+per-step K/V write through the ``(page, offset)`` indirection
+(``_page_write`` — the ONLY legal pool write path, enforced by lint rule
+KO121). Admission therefore reserves ``ceil((plen+max_tokens)/page)``
+pages instead of a worst-case row, which is what lets short requests
+stop paying max_seq memory (the batcher accounts free *pages*).
+
+Prefix reuse rides on top: admission hashes every page-aligned prompt
+prefix into a per-shard LRU cache mapping ``hash(tokens) -> pages``. A
+hit maps the cached pages into the new slot's block table read-only
+(refcounted — pages free only when no slot and no cache entry holds
+them), skips their prefill, and the first divergent write — the page
+containing the first position the new request itself must write —
+triggers copy-on-write into a fresh page (``_page_copy``), so sharing is
+invisible to token math. When the pool runs dry, admission evicts LRU
+prefix entries whose pages no live slot pins. Each dp shard reserves one
+*trash page* that is never allocated: empty and frozen rows keep
+scattering their masked no-op K/V writes somewhere, and the trash page
+absorbs them so a recycled page can never be corrupted by a retired
+slot's frozen write (``release`` resets retired block tables to trash).
 
 Bit-exactness: the micro-step reuses ``generate``'s shared helpers
 (``rms_norm`` / ``token_qkv`` / ``attn_out_mlp`` / ``final_logits``) and
@@ -26,34 +48,37 @@ the same einsum strings, cast points, masking constant (-1e30) and cache
 widths as ``_decode_scan``, with per-row rotary/mask forms that are
 elementwise identical to the scalar-position originals. Greedy tokens
 from a slot therefore match a solo ``generate()`` of the same request bit
-for bit (pinned by tests/test_continuous.py). Sampling is deterministic
-per (seed, position) — ``fold_in(key(seed), pos)`` — which makes a
-sampled row invariant to WHEN it was admitted and WHO shares the pool,
-but (documented trade) it is a different stream than solo ``generate``'s
+for bit — including under paging, on prefix hits (the seeded chunk pass
+attends over gathered shared pages holding exactly the K/V a fresh
+prefill would have computed) and after copy-on-write divergence (pinned
+by tests/test_continuous.py). Sampling is deterministic per
+(seed, position) — ``fold_in(key(seed), pos)`` — which makes a sampled
+row invariant to WHEN it was admitted and WHO shares the pool, but
+(documented trade) it is a different stream than solo ``generate``'s
 split-chain.
 
 Inactive rows keep computing (a ``where`` no-op freezes their ``pos`` and
 buffer): masked softmax positions contribute exactly 0.0, a frozen row
-rewrites the same cache entry with the same value, and a stale cache
-entry from a slot's previous occupant is always overwritten (at ``pos``)
+rewrites its own frozen position (or the trash page) with the same value,
+and a stale entry in a recycled page is always overwritten (at ``pos``)
 before the mask first exposes it — so garbage never reaches live rows.
 
 Multi-chip (round 7): pass a dp×tp ``MeshSpec`` and the same pool runs
-sharded over a device mesh — the slot axis S splits over ``dp`` (each
-device group owns S/dp independent rows: pure data parallel, no
-cross-slot math exists), attention heads split over ``tp`` (megatron
-column/row splits via ``sharding.shard_params_decode_tp``; GSPMD inserts
-one all-reduce per attention block and one per MLP). The host protocol is
-layout-agnostic: admission's chunked-prefill scratch, the slot-region
-writes, and ``poll()``'s batched fetch all route through the same
-``NamedSharding``s (``_pin``), so ``ContinuousBatcher`` drives a 1-device
-and an 8-device pool identically and greedy tokens stay bit-identical to
-the solo engine per shard layout (pinned on a 2×4 host mesh in
-tests/test_continuous.py). A 1-device spec degrades to the solo path.
+sharded over a device mesh — the page axis P splits over ``dp`` (the
+allocator hands each dp group a contiguous page range, so a slot's block
+table only names pages its own group owns), attention heads split over
+``tp`` (megatron column/row splits via ``sharding.shard_params_decode_tp``),
+and block tables replicate (``sharding.shard_page_pool``). The host
+protocol is layout-agnostic: admission's chunked-prefill scratch, the
+page-routed writes, and ``poll()``'s batched fetch all route through the
+same ``NamedSharding``s (``_pin``), so ``ContinuousBatcher`` drives a
+1-device and an 8-device pool identically. A 1-device spec degrades to
+the solo path.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import replace
 from typing import Any, Sequence
 
@@ -67,7 +92,7 @@ from kubeoperator_tpu.workloads.generate import (
     attn_out_mlp, final_logits, rms_norm, token_qkv,
 )
 from kubeoperator_tpu.workloads.sharding import (
-    MeshSpec, build_mesh, shard_params_decode_tp,
+    MeshSpec, build_mesh, shard_page_pool, shard_params_decode_tp,
 )
 from kubeoperator_tpu.workloads.transformer import (
     Transformer, TransformerConfig,
@@ -81,21 +106,67 @@ def _pow2_at_most(n: int) -> int:
     return v
 
 
+def _default_page(max_total: int) -> int:
+    """Largest power of two <= min(16, max_total) dividing max_total: 16
+    for the production-shaped 2k context, smaller when a tiny test
+    max_seq_len demands it. 16-token pages keep the block table small
+    while still splitting a 2k context into 128 allocatable units."""
+    p = _pow2_at_most(min(16, max_total))
+    while max_total % p:
+        p //= 2
+    return p
+
+
 def donation_argnums(platform: str) -> tuple[int, ...]:
-    """Segment-dispatch donation (buf, pos, caches — argnums 0, 1, 6) for
-    the platform the engine's buffers actually LIVE on. Decided from
+    """Segment-dispatch donation (buf, pos, page pools — argnums 0, 1, 6)
+    for the platform the engine's buffers actually LIVE on. Decided from
     placement, not ``jax.default_backend()``: an engine built on a CPU
     mesh while a TPU backend is default (or vice versa) must follow its
     own devices — CPU's partial donation support warns and falls back,
-    and a wrongly-undonated TPU pool doubles its HBM footprint."""
+    and a wrongly-undonated TPU pool doubles its HBM footprint. Block
+    tables (argnum 7) are host-authoritative and read-only in the
+    segment, so they are never donated."""
     return () if platform == "cpu" else (0, 1, 6)
 
 
-def validate_serve_mesh(spec: MeshSpec, *, slots: int, n_heads: int) -> None:
+def validate_page_pool(*, page: int, pages: int, max_seq_len: int,
+                       dp: int = 1) -> None:
+    """Reject un-serveable page-pool layouts up front with actionable
+    errors instead of an opaque gather/scatter shape failure mid-admit."""
+    if page < 1 or page & (page - 1):
+        raise ValueError(
+            f"page size ({page}) must be a power of two: admission "
+            f"prefills pow2 prompt chunks, so only pow2 pages keep the "
+            f"chunk writes page-aligned")
+    if page > max_seq_len:
+        raise ValueError(
+            f"page size ({page}) must be <= max_seq_len ({max_seq_len}): "
+            f"a page larger than the context can never fill")
+    if max_seq_len % page:
+        raise ValueError(
+            f"max_seq_len ({max_seq_len}) must be divisible by the page "
+            f"size ({page}): block tables hold max_seq_len/page entries")
+    if pages % dp:
+        raise ValueError(
+            f"pages ({pages}) must be divisible by dp ({dp}): the page "
+            f"axis shards over dp, so each dp shard owns pages/dp "
+            f"contiguous pages")
+    if pages // dp < 2:
+        raise ValueError(
+            f"pages ({pages}) gives {pages // dp} page(s) per dp shard "
+            f"({dp}); each shard needs its reserved trash page plus at "
+            f"least one allocatable page")
+
+
+def validate_serve_mesh(spec: MeshSpec, *, slots: int, n_heads: int,
+                        page: int | None = None, pages: int | None = None,
+                        max_seq_len: int | None = None) -> None:
     """Reject un-shardable serving layouts up front with actionable
     errors instead of letting GSPMD fail mid-compile with an opaque
-    partition error. The serving pool shards exactly two ways: the slot
-    axis S over dp, attention heads over tp."""
+    partition error. The serving pool shards exactly two ways: the page
+    pool (and with it the slot axis) over dp, attention heads over tp.
+    Pass ``page``/``pages``/``max_seq_len`` to validate the paged-KV
+    layout in the same breath."""
     extra = {n: s for n, s in spec.sizes()
              if n not in ("dp", "tp") and s > 1}
     if extra:
@@ -112,6 +183,9 @@ def validate_serve_mesh(spec: MeshSpec, *, slots: int, n_heads: int) -> None:
             f"n_heads ({n_heads}) must be divisible by tp ({spec.tp}): "
             f"attention heads shard over tp, so each shard owns "
             f"n_heads/tp heads")
+    if page is not None:
+        validate_page_pool(page=page, pages=int(pages or 0),
+                           max_seq_len=int(max_seq_len or 0), dp=spec.dp)
 
 
 def _rope_rows(x: jnp.ndarray, pos: jnp.ndarray,
@@ -131,28 +205,60 @@ def _rope_rows(x: jnp.ndarray, pos: jnp.ndarray,
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
+class _PageShard:
+    """Host-side page allocator for one dp shard: a free list over the
+    shard's contiguous page range, per-page refcounts (``ref`` counts
+    every holder, ``cache_ref`` the prefix-cache's share of it — a page
+    is evictable exactly when the two are equal), the LRU prefix cache
+    ``hash(tokens) -> (tokens, pages)``, and the reserved trash page."""
+
+    __slots__ = ("index", "base", "span", "trash", "free", "ref",
+                 "cache_ref", "prefix")
+
+    def __init__(self, index: int, base: int, span: int):
+        self.index = index
+        self.base = base
+        self.span = span
+        self.trash = base           # never allocated; absorbs no-op writes
+        self.free = list(range(base + 1, base + span))
+        self.ref: dict[int, int] = {}
+        self.cache_ref: dict[int, int] = {}
+        self.prefix: OrderedDict[int, tuple[tuple[int, ...],
+                                            tuple[int, ...]]] = OrderedDict()
+
+
 class SlotPoolEngine:
-    """Device side of continuous batching: S persistent decode slots.
+    """Device side of continuous batching: S persistent decode slots over
+    a paged KV pool.
 
     The host-facing protocol (``ContinuousBatcher`` drives it; the bench's
-    fake engine mirrors it):
+    fake engines mirror it):
 
-    * ``admit(entries)`` — write queued requests into free slots: one
-      chunked prefill per pow2 prompt bucket fills ``cache[:C]`` in place,
-      the prompt lands in the slot's token buffer, and the per-slot state
-      vectors are set. Returns ``{slot: pos}`` after admission.
+    * ``admit(entries)`` — write queued requests into free slots: pages
+      are reserved (prefix-cache hits map shared pages in and skip their
+      prefill), one chunked prefill per (bucket, hit-length) pair fills
+      the fresh pages in place, and the per-slot state vectors are set.
+      Returns ``{slot: pos}`` after admission.
     * ``run_segment()`` — ONE jitted dispatch advancing every active slot
       ``segment`` tokens.
     * ``poll()`` — one batched device->host fetch of (token buffers,
       positions) for retirement.
+    * ``release(slots)`` — free retired slots' pages back to the
+      allocator (prefix-cache entries keep theirs alive) and point the
+      retired block tables at the trash page.
+    * ``pages_for`` / ``free_pages`` / ``evictable_pages`` /
+      ``pages_in_use`` — the page accounting the batcher admits against.
 
-    Requires the explicit-buffer fast path's preconditions
+    The protocol is single-writer: one host thread calls admit/release/
+    run_segment/poll (the batcher's worker), so allocator state needs no
+    lock. Requires the explicit-buffer fast path's preconditions
     (``scan_layers`` and no MoE), like ``_decode_scan``.
     """
 
     def __init__(self, cfg: TransformerConfig, params: Any, *,
-                 slots: int = 16, segment: int = 8, mesh: Any = None,
-                 mesh_spec: MeshSpec | None = None,
+                 slots: int = 16, segment: int = 8,
+                 page: int | None = None, pages: int | None = None,
+                 mesh: Any = None, mesh_spec: MeshSpec | None = None,
                  devices: Sequence[Any] | None = None):
         if cfg.moe_experts != 0 or not cfg.scan_layers:
             raise ValueError(
@@ -168,7 +274,7 @@ class SlotPoolEngine:
         self._model = Transformer(self._decode_cfg, mesh=mesh)
         self._params = nn.unbox(params)
 
-        # -- mesh placement (dp shards slots, tp shards heads) --------------
+        # -- mesh placement (dp shards pages, tp shards heads) --------------
         # A 1-device spec degrades to the solo path: no mesh, no shardings,
         # no collectives — the same engine object at any scale.
         self.spec = mesh_spec if (mesh_spec is not None
@@ -181,8 +287,7 @@ class SlotPoolEngine:
             tp_ax = "tp" if "tp" in self.mesh.axis_names else None
             self._buf_sh = NamedSharding(self.mesh, P(dp_ax, None))
             self._vec_sh = NamedSharding(self.mesh, P(dp_ax))
-            self._cache_sh = NamedSharding(self.mesh,
-                                           P(dp_ax, None, tp_ax, None))
+            self._pool_sh, self._bt_sh = shard_page_pool(self.mesh)
             # scratch prefill cache [L, k, C, H, D]: the admission group k
             # is not slot-aligned, so only heads shard
             self._scratch_sh = NamedSharding(
@@ -192,8 +297,33 @@ class SlotPoolEngine:
         else:
             self.mesh = None
             self._buf_sh = self._vec_sh = None
-            self._cache_sh = self._scratch_sh = None
+            self._pool_sh = self._bt_sh = self._scratch_sh = None
         self.dp = self.spec.dp if self.spec is not None else 1
+
+        # -- paged-KV geometry ----------------------------------------------
+        self.page = int(page) if page is not None else _default_page(
+            self.max_total)
+        if pages is not None:
+            self.pages = int(pages)
+        else:
+            # default pool: dense-equivalent capacity (every slot can still
+            # go to max_seq_len) plus one trash page per dp shard — callers
+            # cap HBM by passing a smaller `pages` and letting admission
+            # backpressure do its job. max(...,1) only guards the division
+            # until validate_page_pool rejects a bad page size below.
+            self.pages = (self.slots * (self.max_total // max(self.page, 1))
+                          + self.dp)
+        validate_page_pool(page=self.page, pages=self.pages,
+                           max_seq_len=self.max_total, dp=self.dp)
+        self.blocks = self.max_total // self.page
+        self._shard_slots = self.slots // self.dp
+        self._span = self.pages // self.dp
+        self._shards = [_PageShard(i, i * self._span, self._span)
+                        for i in range(self.dp)]
+        self._slot_pages: dict[int, list[int]] = {}
+        self.prefix_hits = 0          # admissions that reused cached pages
+        self.prefix_pages_reused = 0  # pages whose prefill was skipped
+        self.cow_copies = 0           # copy-on-write page duplications
 
         self._emb = self._params["embedding"]
         self._layers = [jax.tree.map(lambda x: x[l], self._params["layers"])
@@ -208,17 +338,23 @@ class SlotPoolEngine:
         self._plen = self._pin(jnp.ones((s,), jnp.int32), self._vec_sh)
         self._temp = self._pin(jnp.zeros((s,), jnp.float32), self._vec_sh)
         self._seeds = self._pin(jnp.zeros((s,), jnp.int32), self._vec_sh)
-        self._caches = [(self._pin(jnp.zeros((s, t, h, d), dt),
-                                   self._cache_sh),
-                         self._pin(jnp.zeros((s, t, h, d), dt),
-                                   self._cache_sh))
-                        for _ in range(cfg.n_layers)]
-        # buf/pos/caches are dead after each segment — donate them so XLA
+        self._pools = [(self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                            dt), self._pool_sh),
+                        self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                            dt), self._pool_sh))
+                       for _ in range(cfg.n_layers)]
+        self._bt_np = np.zeros((s, self.blocks), np.int32)
+        for i in range(self.dp):
+            self._bt_np[i * self._shard_slots:(i + 1) * self._shard_slots] = \
+                self._shards[i].trash
+        self._bt = self._pin(jnp.asarray(self._bt_np), self._bt_sh)
+        # buf/pos/pools are dead after each segment — donate them so XLA
         # updates in place (CPU's donation support is partial and warns;
         # skip there). last/plen/temp/seeds stay live host-side (admit
-        # rewrites them between segments), so they must NOT be donated.
-        # Decided from the devices the pool is PLACED on, not the default
-        # backend (donation_argnums).
+        # rewrites them between segments) and the block tables are
+        # host-authoritative, so none of those are donated. Decided from
+        # the devices the pool is PLACED on, not the default backend
+        # (donation_argnums).
         place = (self.mesh.devices.flat[0] if self.mesh is not None
                  else jax.devices()[0])
         self._donate = donation_argnums(
@@ -229,7 +365,7 @@ class SlotPoolEngine:
             # so the pool's layout is stable across segments (donation
             # needs matching in/out placements; GSPMD must not re-layout)
             out_sh = (self._buf_sh, self._vec_sh,
-                      [(self._cache_sh, self._cache_sh)
+                      [(self._pool_sh, self._pool_sh)
                        for _ in range(cfg.n_layers)])
         self._seg_fn = jax.jit(
             self._segment_body, donate_argnums=self._donate,
@@ -241,35 +377,60 @@ class SlotPoolEngine:
         through this, so the segment jit always sees one layout."""
         return x if sh is None else jax.device_put(x, sh)
 
+    # -- page write discipline (KO121 anchors) ------------------------------
+    def _page_write(self, pool, pages, offsets, vals):
+        """THE pool write path: one scatter of already block-table-routed
+        ``(page, offset)`` pairs. Every write into a paged KV pool must go
+        through here or ``_page_copy`` — lint rule KO121 flags any other
+        ``.at[...]`` update on a pool buffer, because a raw slot- or
+        position-indexed write lands in whichever request currently owns
+        that page."""
+        return pool.at[pages, offsets].set(vals)
+
+    def _page_copy(self, pool, dst, src):
+        """Copy-on-write: duplicate whole pages (gather + scatter) when a
+        prefix-sharing slot is about to diverge from its cached pages."""
+        return pool.at[dst].set(pool[src])
+
     # -- device math --------------------------------------------------------
-    def _micro_step(self, buf, pos, last, plen, temp, seeds, caches):
+    def _micro_step(self, buf, pos, last, plen, temp, seeds, pools, bt):
         """Advance every active slot one token — ``_decode_scan.step`` with
-        the scalar position replaced by the per-slot ``pos`` vector."""
+        the scalar position replaced by the per-slot ``pos`` vector and the
+        dense cache row replaced by the gathered page view."""
         cfg, dt = self._decode_cfg, self._decode_cfg.dtype
         s = self.slots
+        nh, hd = cfg.n_heads, cfg.head_dim
         rows = jnp.arange(s)
         active = pos < last                                     # [S]
         scale = 1.0 / (cfg.head_dim ** 0.5)
         token = buf[rows, pos]                                  # [S]
         x = self._emb[token][:, None, :].astype(dt)             # [S, 1, d]
-        new_caches = []
-        for pl, (ck, cv) in zip(self._layers, caches):
-            h = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
-            q, k, v = token_qkv(pl["attn"], h, dt)
+        # block-table routing for this step's K/V write: a finished row
+        # rewrites its frozen page slot with the identical value; an empty
+        # row writes the shard's trash page — both no-ops in effect, and
+        # cheaper than masking the write.
+        blk = pos // self.page
+        off = pos - blk * self.page
+        pg = bt[rows, blk]                                      # [S]
+        new_pools = []
+        for pl, (kp, vp) in zip(self._layers, pools):
+            hdn = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
+            q, k, v = token_qkv(pl["attn"], hdn, dt)
             q, k = _rope_rows(q, pos), _rope_rows(k, pos)
-            # scatter each row's k/v at its own position. A finished row
-            # rewrites its frozen position with the identical value; an
-            # empty slot writes garbage it alone can see — both no-ops in
-            # effect, and cheaper than masking the write.
-            ck = ck.at[rows, pos].set(k[:, 0].astype(dt))
-            cv = cv.at[rows, pos].set(v[:, 0].astype(dt))
-            if self._cache_sh is not None:
-                # keep the pool layout pinned through the scan: slots over
+            kp = self._page_write(kp, pg, off, k[:, 0].astype(dt))
+            vp = self._page_write(vp, pg, off, v[:, 0].astype(dt))
+            if self._pool_sh is not None:
+                # keep the pool layout pinned through the scan: pages over
                 # dp, heads over tp — GSPMD then partitions the scatter and
                 # the attention einsums in place instead of re-laying-out
-                ck = jax.lax.with_sharding_constraint(ck, self._cache_sh)
-                cv = jax.lax.with_sharding_constraint(cv, self._cache_sh)
-            new_caches.append((ck, cv))
+                kp = jax.lax.with_sharding_constraint(kp, self._pool_sh)
+                vp = jax.lax.with_sharding_constraint(vp, self._pool_sh)
+            new_pools.append((kp, vp))
+            # gather the dense [S, T, H, D] view back out of the pool — a
+            # permutation copy, so the einsum sees bit-identical operands
+            # to the dense-row engine it replaced
+            ck = kp[bt].reshape(s, self.max_total, nh, hd)
+            cv = vp[bt].reshape(s, self.max_total, nh, hd)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                 preferred_element_type=jnp.float32) * scale
             mask = (jnp.arange(self.max_total)[None, None, None, :]
@@ -296,28 +457,146 @@ class SlotPoolEngine:
         value = jnp.where(active, chosen, buf[rows, pos])
         buf = buf.at[rows, target].set(value)
         pos = jnp.where(active, pos + 1, pos)
-        return buf, pos, new_caches
+        return buf, pos, new_pools
 
-    def _segment_body(self, buf, pos, last, plen, temp, seeds, caches):
+    def _segment_body(self, buf, pos, last, plen, temp, seeds, pools, bt):
         def step(carry, _):
-            buf, pos, caches = carry
-            buf, pos, caches = self._micro_step(
-                buf, pos, last, plen, temp, seeds, caches)
-            return (buf, pos, caches), None
+            buf, pos, pools = carry
+            buf, pos, pools = self._micro_step(
+                buf, pos, last, plen, temp, seeds, pools, bt)
+            return (buf, pos, pools), None
 
-        (buf, pos, caches), _ = jax.lax.scan(
-            step, (buf, pos, caches), None, length=self.segment)
-        return buf, pos, caches
+        (buf, pos, pools), _ = jax.lax.scan(
+            step, (buf, pos, pools), None, length=self.segment)
+        return buf, pos, pools
 
-    # -- host protocol ------------------------------------------------------
+    # -- host-side page accounting ------------------------------------------
+    def pages_for(self, prompt_len: int, max_tokens: int) -> int:
+        """Pages one request reserves: its full decode extent, rounded up
+        to whole pages. Prefix hits only ever need fewer, so admitting
+        against this number is safe (never over-commits)."""
+        return -(-(int(prompt_len) + int(max_tokens)) // self.page)
+
+    def free_pages(self, shard: int = 0) -> int:
+        return len(self._shards[shard].free)
+
+    def evictable_pages(self, shard: int = 0) -> int:
+        """Pages only the prefix cache is keeping alive (ref == cache_ref):
+        exactly the pages a full LRU drain would hand back."""
+        sh = self._shards[shard]
+        return sum(1 for pg, r in sh.ref.items()
+                   if r == sh.cache_ref.get(pg, 0))
+
+    def pages_in_use(self, shard: int = 0) -> int:
+        """Allocated pages on one dp shard (live slots + prefix cache),
+        excluding the reserved trash page."""
+        sh = self._shards[shard]
+        return sh.span - 1 - len(sh.free)
+
+    @property
+    def max_request_pages(self) -> int:
+        """Largest page reservation one request may ask for: a full dp
+        shard minus its trash page."""
+        return self._span - 1
+
+    def _lookup_prefix(self, shard_i: int, prompt: list[int]
+                       ) -> tuple[int, tuple[int, ...]]:
+        """Longest cached page-aligned prefix of ``prompt`` on this shard.
+        Returns (n_pages, pages); token-equality is verified so a hash
+        collision degrades to a miss, never to wrong tokens."""
+        sh = self._shards[shard_i]
+        for n in range(len(prompt) // self.page, 0, -1):
+            toks = tuple(prompt[:n * self.page])
+            key = hash(toks)
+            ent = sh.prefix.get(key)
+            if ent is not None and ent[0] == toks:
+                sh.prefix.move_to_end(key)      # LRU touch
+                return n, ent[1]
+        return 0, ()
+
+    def _ensure_free(self, sh: _PageShard, need: int) -> None:
+        """Evict LRU prefix entries until ``need`` pages are free. Pages a
+        live slot still pins survive eviction (ref stays > 0)."""
+        while len(sh.free) < need and sh.prefix:
+            _key, (_toks, pgs) = sh.prefix.popitem(last=False)
+            for pg in pgs:
+                sh.cache_ref[pg] -= 1
+                if not sh.cache_ref[pg]:
+                    del sh.cache_ref[pg]
+                sh.ref[pg] -= 1
+                if not sh.ref[pg]:
+                    del sh.ref[pg]
+                    sh.free.append(pg)
+        if len(sh.free) < need:
+            raise RuntimeError(
+                f"page pool exhausted on dp shard {sh.index}: need {need} "
+                f"free pages, {len(sh.free)} available after draining the "
+                f"prefix cache ({sh.span - 1} usable pages per shard; "
+                f"raise pages= or admit less concurrency)")
+
+    def _release_slot(self, slot: int) -> None:
+        pages = self._slot_pages.pop(slot, None)
+        if not pages:
+            return
+        sh = self._shards[slot // self._shard_slots]
+        for pg in pages:
+            sh.ref[pg] -= 1
+            if not sh.ref[pg]:
+                del sh.ref[pg]
+                sh.free.append(pg)
+
+    def release(self, slots: Sequence[int]) -> None:
+        """Hand retired slots' pages back to the allocator. Pages the
+        prefix cache also holds stay resident (refcounted) for future
+        hits; every retired block table is pointed at the trash page so
+        the frozen row's no-op K/V writes can never corrupt a page the
+        next admission hands out."""
+        freed = [int(s) for s in slots if int(s) in self._slot_pages]
+        for s in freed:
+            self._release_slot(s)
+            self._bt_np[s, :] = self._shards[s // self._shard_slots].trash
+        self._push_block_tables(freed)
+
+    # -- admission ----------------------------------------------------------
     def admit(self, entries: Sequence[tuple[int, Sequence[int], int, float,
                                             int]]) -> dict[int, int]:
         """Admit ``(slot, prompt_ids, max_tokens, temperature, seed)``
-        tuples into their (free) slots. Groups by pow2 prefill bucket so
-        one admission wave costs one chunked forward pass per distinct
-        bucket, then writes each slot's cache region / buffer row /
-        state-vector entries in place. Returns {slot: pos}."""
-        by_c: dict[int, list[tuple[int, list[int], int, float, int]]] = {}
+        tuples into their (free) slots. Pages are reserved per request
+        (prefix-cache hits map shared pages in and skip their prefill;
+        copy-on-write duplicates the first divergent page), then one
+        chunked forward pass per distinct (bucket, hit-length) pair fills
+        the fresh pages in place and the per-slot state vectors are set.
+        Returns {slot: pos}.
+
+        Ordering matters and is fixed: plan/allocate -> copy-on-write ->
+        prefill scatters -> state vectors -> block-table push -> prefix
+        registration. Copy-on-write reads its source pages before any
+        write in this wave can touch a recycled page, so even a source
+        freed by LRU eviction mid-wave is copied intact."""
+        plans, cow_pairs = self._plan_entries(entries)
+        self._apply_cow(cow_pairs)
+        groups: dict[tuple[int, int], list[dict]] = {}
+        nopass: list[dict] = []
+        for pl in plans:
+            if pl["h"] < pl["c"]:
+                groups.setdefault((pl["c"], pl["h"]), []).append(pl)
+            else:
+                nopass.append(pl)
+        out: dict[int, int] = {}
+        for (c, h), group in sorted(groups.items()):
+            out.update(self._admit_group(c, h, group))
+        if nopass:
+            out.update(self._admit_nopass(nopass))
+        self._push_block_tables([pl["slot"] for pl in plans])
+        self._register_prefixes(plans)
+        return out
+
+    def _plan_entries(self, entries) -> tuple[list[dict],
+                                              list[tuple[int, int]]]:
+        """Validate, look up prefixes, and reserve pages for one admission
+        wave. Host-only: no device work happens here."""
+        plans: list[dict] = []
+        cow_pairs: list[tuple[int, int]] = []
         for slot, prompt_ids, max_tokens, temperature, seed in entries:
             prompt = list(map(int, prompt_ids))
             if not prompt:
@@ -326,102 +605,229 @@ class SlotPoolEngine:
                 raise ValueError(
                     f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
                     f"exceed max_seq_len ({self.max_total})")
-            if not 0 <= slot < self.slots:
+            if not 0 <= int(slot) < self.slots:
                 raise ValueError(f"slot {slot} outside pool [0, {self.slots})")
-            c = _pow2_at_most(len(prompt))
-            by_c.setdefault(c, []).append(
-                (int(slot), prompt, int(max_tokens), float(temperature),
-                 int(seed)))
-        out: dict[int, int] = {}
-        for c, group in by_c.items():
-            out.update(self._admit_group(c, group))
-        return out
+            slot, mt = int(slot), int(max_tokens)
+            plen = len(prompt)
+            shard_i = slot // self._shard_slots
+            sh = self._shards[shard_i]
+            # a re-admitted slot implicitly releases its previous pages
+            # (its block table is rewritten below, before any segment runs)
+            self._release_slot(slot)
+            blocks_needed = self.pages_for(plen, mt)
+            n_hit, hit_pages = self._lookup_prefix(shard_i, prompt)
+            c = _pow2_at_most(plen)
+            h = n_hit * self.page
+            if h == plen:
+                # the whole prompt is cached: re-decode the final prompt
+                # token (one micro-step) to recover its logits — its page
+                # is copy-on-write so the shared copy stays pristine
+                pos0 = plen - 1
+            elif h >= c:
+                pos0 = h        # hit covers the prefill bucket: skip it
+            else:
+                pos0 = c        # prefill [h, c), seeded from shared pages
+            first_write_blk = pos0 // self.page
+            cow_blk = first_write_blk if first_write_blk < n_hit else None
+            # pin the pages we are about to share BEFORE eviction can free
+            # them, then make room for the fresh ones
+            shared = [hit_pages[b] for b in range(n_hit) if b != cow_blk]
+            for pg in shared:
+                sh.ref[pg] += 1
+            need = blocks_needed - len(shared)
+            self._ensure_free(sh, need)
+            if n_hit:
+                self.prefix_hits += 1
+                self.prefix_pages_reused += n_hit
+            pages: list[int] = []
+            for b in range(blocks_needed):
+                if b < n_hit and b != cow_blk:
+                    pages.append(hit_pages[b])
+                else:
+                    pg = sh.free.pop()
+                    sh.ref[pg] = 1
+                    if b == cow_blk:
+                        cow_pairs.append((pg, hit_pages[b]))
+                        self.cow_copies += 1
+                    pages.append(pg)
+            self._slot_pages[slot] = list(pages)
+            self._bt_np[slot, :] = sh.trash
+            self._bt_np[slot, :blocks_needed] = pages
+            plans.append(dict(slot=slot, prompt=prompt, plen=plen, mt=mt,
+                              temp=float(temperature), seed=int(seed),
+                              c=c, h=h, pos0=pos0, pages=pages,
+                              shard=shard_i))
+        return plans, cow_pairs
 
-    def _admit_group(self, c: int, group: list) -> dict[int, int]:
+    def _apply_cow(self, cow_pairs: list[tuple[int, int]]) -> None:
+        if not cow_pairs:
+            return
+        dst = jnp.asarray([d for d, _ in cow_pairs], jnp.int32)
+        src = jnp.asarray([s for _, s in cow_pairs], jnp.int32)
+        self._pools = [
+            (self._pin(self._page_copy(kp, dst, src), self._pool_sh),
+             self._pin(self._page_copy(vp, dst, src), self._pool_sh))
+            for kp, vp in self._pools]
+
+    def _admit_group(self, c: int, h: int, group: list[dict]
+                     ) -> dict[int, int]:
+        """One chunked prefill for every plan sharing (bucket c, hit h):
+        the chunk covers positions [h, c) — on a prefix hit the scratch
+        cache is seeded [0, h) from the shared pages first, so the pass
+        attends over exactly the K/V a fresh prefill would have computed."""
         cfg = self._decode_cfg
+        nh, hd = cfg.n_heads, cfg.head_dim
         k = len(group)
-        slots_np = np.array([g[0] for g in group], np.int32)
-        chunk = np.zeros((k, c), np.int32)
-        for i, (_, prompt, _, _, _) in enumerate(group):
-            chunk[i] = prompt[:c]
+        w = c - h
+        chunk = np.zeros((k, w), np.int32)
+        for i, pl in enumerate(group):
+            chunk[i] = pl["prompt"][h:c]
         # compact [k, C] prefill: a C-wide scratch cache (transformer.py's
-        # decode branch masks to the cache width) — the full prompt prefix
-        # in one MXU-shaped pass instead of C token dispatches
+        # decode branch masks to the cache width) — the fresh prompt region
+        # in one MXU-shaped pass instead of per-token dispatches
+        scratch_k = jnp.zeros((cfg.n_layers, k, c, nh, hd), cfg.dtype)
+        scratch_v = jnp.zeros((cfg.n_layers, k, c, nh, hd), cfg.dtype)
+        if h:
+            blk_np = np.array([pl["pages"][:h // self.page] for pl in group],
+                              np.int32)
+            blk = jnp.asarray(blk_np)
+            seed_k = jnp.stack([kp[blk] for kp, _ in self._pools])
+            seed_v = jnp.stack([vp[blk] for _, vp in self._pools])
+            scratch_k = scratch_k.at[:, :, :h].set(
+                seed_k.reshape(cfg.n_layers, k, h, nh, hd))
+            scratch_v = scratch_v.at[:, :, :h].set(
+                seed_v.reshape(cfg.n_layers, k, h, nh, hd))
         scratch = {"layers": {"attn": {
-            "cached_k": self._pin(
-                jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
-                           cfg.head_dim), cfg.dtype), self._scratch_sh),
-            "cached_v": self._pin(
-                jnp.zeros((cfg.n_layers, k, c, cfg.n_heads,
-                           cfg.head_dim), cfg.dtype), self._scratch_sh)}}}
+            "cached_k": self._pin(scratch_k, self._scratch_sh),
+            "cached_v": self._pin(scratch_v, self._scratch_sh)}}}
         logits, mutated = self._model.apply(
             {"params": self._params, "cache": scratch}, jnp.asarray(chunk),
-            jnp.arange(c, dtype=jnp.int32), mutable=["cache"])
+            jnp.arange(h, c, dtype=jnp.int32), mutable=["cache"])
         chunk_k = mutated["cache"]["layers"]["attn"]["cached_k"]  # [L,k,C,H,D]
         chunk_v = mutated["cache"]["layers"]["attn"]["cached_v"]
-        idx = jnp.asarray(slots_np)
-        new_caches = []
-        for l, (ck, cv) in enumerate(self._caches):
+
+        # route the fresh positions [h, c) through each plan's block table
+        # into the pool: stack indices on host, transfer ONCE, then one
+        # page-routed scatter per pool buffer (KO121's legal path)
+        hpos = np.arange(h, c)
+        pg_np = np.array([[pl["pages"][p // self.page] for p in hpos]
+                          for pl in group], np.int32).reshape(-1)
+        off_np = np.tile((hpos % self.page).astype(np.int32), k)
+        pg_j, off_j = jnp.asarray(pg_np), jnp.asarray(off_np)
+        new_pools = []
+        for l, (kp, vp) in enumerate(self._pools):
+            kv = chunk_k[l][:, h:c].reshape(k * w, nh, hd)
+            vv = chunk_v[l][:, h:c].reshape(k * w, nh, hd)
             # re-pin after the host-side scatter: admission writes arrive
             # from the (tp-only) scratch layout, and the segment jit's
             # donated inputs must keep the canonical dp×tp placement
-            new_caches.append(
-                (self._pin(ck.at[idx, :c].set(chunk_k[l]), self._cache_sh),
-                 self._pin(cv.at[idx, :c].set(chunk_v[l]), self._cache_sh)))
-        self._caches = new_caches
+            new_pools.append(
+                (self._pin(self._page_write(kp, pg_j, off_j, kv),
+                           self._pool_sh),
+                 self._pin(self._page_write(vp, pg_j, off_j, vv),
+                           self._pool_sh)))
+        self._pools = new_pools
 
-        # stack the group's rows on host, transfer ONCE, then one batched
-        # scatter per pool buffer — the per-request jnp.asarray +
-        # .at[slot].set loop this replaces cost k host->device dispatches
-        # per buffer per admission wave (the linter's KO101 flagship)
-        plens_np = np.array([len(g[1]) for g in group], np.int32)
-        maxtok_np = np.array([g[2] for g in group], np.int32)
-        temps_np = np.array([g[3] for g in group], np.float32)
-        seeds_np = np.array([g[4] for g in group], np.int32)
-        rows_np = np.zeros((k, self.max_total), np.int32)
-        for i, (_, prompt, _, _, _) in enumerate(group):
-            rows_np[i, : len(prompt)] = prompt
-        rows_j = jnp.asarray(rows_np)
-
-        boundary = np.nonzero(plens_np == c)[0]
+        rows_j = jnp.asarray(self._prompt_rows(group))
+        boundary = np.array([i for i, pl in enumerate(group)
+                             if pl["plen"] == c], np.int32)
         if boundary.size:
             # pow2-length prompts: position C holds the FIRST generated
             # token, chosen from the prefill's last-position logits — the
             # same boundary choose as generate()'s prefill, batched the
             # way _micro_step batches its per-row choose
-            bidx = jnp.asarray(boundary.astype(np.int32))
+            bidx = jnp.asarray(boundary)
             lg = logits[bidx, -1]                       # [b, vocab]
-            b_temp = jnp.asarray(temps_np[boundary])
+            b_temp = jnp.asarray(
+                np.array([group[i]["temp"] for i in boundary], np.float32))
+            b_seed = jnp.asarray(
+                np.array([group[i]["seed"] for i in boundary], np.int32))
             keys = jax.vmap(lambda sd: jax.random.fold_in(
-                jax.random.key(sd), c - 1))(jnp.asarray(seeds_np[boundary]))
+                jax.random.key(sd), c - 1))(b_seed)
             safe_t = jnp.where(b_temp > 0, b_temp, 1.0)
             sampled = jax.vmap(jax.random.categorical)(
                 keys, lg / safe_t[:, None]).astype(jnp.int32)
             greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
             rows_j = rows_j.at[bidx, c].set(
                 jnp.where(b_temp > 0, sampled, greedy))
+        self._scatter_state(group, np.full(k, c, np.int32), rows_j)
+        return {pl["slot"]: c for pl in group}
 
-        buf = self._buf.at[idx].set(rows_j)
-        pos = self._pos.at[idx].set(c)
-        last = self._last.at[idx].set(jnp.asarray(plens_np + maxtok_np - 1))
-        plen_v = self._plen.at[idx].set(jnp.asarray(plens_np))
-        temp_v = self._temp.at[idx].set(jnp.asarray(temps_np))
-        seeds_v = self._seeds.at[idx].set(jnp.asarray(seeds_np))
-        out = {int(slot): c for slot in slots_np}
-        self._buf = self._pin(buf, self._buf_sh)
-        self._pos = self._pin(pos, self._vec_sh)
-        self._last = self._pin(last, self._vec_sh)
-        self._plen = self._pin(plen_v, self._vec_sh)
-        self._temp = self._pin(temp_v, self._vec_sh)
-        self._seeds = self._pin(seeds_v, self._vec_sh)
-        return out
+    def _admit_nopass(self, group: list[dict]) -> dict[int, int]:
+        """Plans whose prefill is fully covered by the prefix cache: no
+        forward pass at all. A full-prompt hit starts one position back
+        (pos = plen-1) and re-decodes the boundary token inside its
+        copy-on-write page to recover the first generated token's logits."""
+        pos_np = np.array([pl["pos0"] for pl in group], np.int32)
+        self._scatter_state(group, pos_np,
+                            jnp.asarray(self._prompt_rows(group)))
+        return {pl["slot"]: int(pl["pos0"]) for pl in group}
+
+    def _prompt_rows(self, group: list[dict]) -> np.ndarray:
+        rows_np = np.zeros((len(group), self.max_total), np.int32)
+        for i, pl in enumerate(group):
+            rows_np[i, :pl["plen"]] = pl["prompt"]
+        return rows_np
+
+    def _scatter_state(self, group: list[dict], pos_np: np.ndarray,
+                       rows_j: jnp.ndarray) -> None:
+        """One batched transfer + one scatter per state buffer — the
+        per-request host loop this replaces cost k host->device dispatches
+        per buffer per admission wave (the linter's KO101 flagship)."""
+        slots_np = np.array([pl["slot"] for pl in group], np.int32)
+        plens_np = np.array([pl["plen"] for pl in group], np.int32)
+        maxtok_np = np.array([pl["mt"] for pl in group], np.int32)
+        temps_np = np.array([pl["temp"] for pl in group], np.float32)
+        seeds_np = np.array([pl["seed"] for pl in group], np.int32)
+        idx = jnp.asarray(slots_np)
+        self._buf = self._pin(self._buf.at[idx].set(rows_j), self._buf_sh)
+        self._pos = self._pin(
+            self._pos.at[idx].set(jnp.asarray(pos_np)), self._vec_sh)
+        self._last = self._pin(
+            self._last.at[idx].set(jnp.asarray(plens_np + maxtok_np - 1)),
+            self._vec_sh)
+        self._plen = self._pin(
+            self._plen.at[idx].set(jnp.asarray(plens_np)), self._vec_sh)
+        self._temp = self._pin(
+            self._temp.at[idx].set(jnp.asarray(temps_np)), self._vec_sh)
+        self._seeds = self._pin(
+            self._seeds.at[idx].set(jnp.asarray(seeds_np)), self._vec_sh)
+
+    def _push_block_tables(self, slots: Sequence[int]) -> None:
+        if not slots:
+            return
+        idx_np = np.asarray(sorted(set(int(s) for s in slots)), np.int32)
+        self._bt = self._pin(
+            self._bt.at[jnp.asarray(idx_np)].set(
+                jnp.asarray(self._bt_np[idx_np])), self._bt_sh)
+
+    def _register_prefixes(self, plans: list[dict]) -> None:
+        """Publish every page-aligned prefix strictly below each plan's
+        write frontier (pages at/above pos may still be written by the
+        slot and must never be shared)."""
+        for pl in plans:
+            sh = self._shards[pl["shard"]]
+            n_max = pl["pos0"] // self.page
+            for n in range(1, n_max + 1):
+                toks = tuple(pl["prompt"][:n * self.page])
+                key = hash(toks)
+                ent = sh.prefix.get(key)
+                if ent is not None:
+                    if ent[0] == toks:
+                        sh.prefix.move_to_end(key)
+                    continue        # hash collision: keep the resident entry
+                pgs = tuple(pl["pages"][:n])
+                sh.prefix[key] = (toks, pgs)
+                for pg in pgs:
+                    sh.ref[pg] += 1
+                    sh.cache_ref[pg] = sh.cache_ref.get(pg, 0) + 1
 
     def run_segment(self) -> None:
         """One device dispatch: every active slot advances ``segment``
         tokens (finished/empty slots no-op in place)."""
-        self._buf, self._pos, self._caches = self._seg_fn(
+        self._buf, self._pos, self._pools = self._seg_fn(
             self._buf, self._pos, self._last, self._plen, self._temp,
-            self._seeds, self._caches)
+            self._seeds, self._pools, self._bt)
 
     def poll(self) -> tuple[np.ndarray, np.ndarray]:
         """ONE batched device->host fetch: (token buffers [S, max_total],

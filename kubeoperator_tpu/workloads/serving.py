@@ -144,6 +144,22 @@ class BatcherStats:
     def segment(self, seconds: float) -> None:
         self._m["segment"].observe(seconds)
 
+    def pages_used(self, pages: int, shard: int | str = 0) -> None:
+        """Allocated KV pages (live slots + prefix cache) on one dp mesh
+        shard of the paged continuous engine."""
+        self._m["kv_pages_used"].set(pages, shard=str(shard))
+
+    def prefix_hit(self, n: int = 1) -> None:
+        self._m["prefix_hits"].inc(n)
+
+    def ttft_mean(self) -> float:
+        """Mean observed time-to-first-token in seconds (0.0 before any
+        observation). The paged-vs-dense bench compares means; p95 lives
+        in PromQL over the histogram buckets."""
+        h = self._m["ttft"]
+        n = h.count()
+        return h.sum() / n if n else 0.0
+
     def snapshot(self) -> dict:
         hist = self._m["batch_size"]
         slot = hist.samples().get(())
@@ -160,6 +176,9 @@ class BatcherStats:
             # summed over dp shards: the pool-wide busy count
             "slot_occupancy": int(sum(
                 self._m["slot_occupancy"].samples().values())),
+            "kv_pages_used": int(sum(
+                self._m["kv_pages_used"].samples().values())),
+            "prefix_hits_total": int(self._m["prefix_hits"].value()),
             "batch_size_hist": batch_hist,
             "latency_p50_s": round(self._m["latency"].quantile(0.50), 4),
             "latency_p95_s": round(self._m["latency"].quantile(0.95), 4),
@@ -310,6 +329,19 @@ class ContinuousBatcher:
     reads: admission returns each slot's position and every segment adds
     exactly K (clamped at the row's stop index), so the host mirror of
     ``pos`` is exact and ``poll()`` runs only when some row finished.
+
+    Paged engines (round 8): when the engine exposes page accounting
+    (``pages_for`` / ``free_pages`` / ``evictable_pages`` / ``release``),
+    admission reserves *pages*, not slots — a request enters when some dp
+    shard with a free slot can cover ``ceil((plen+max_tokens)/page)``
+    pages (counting prefix-cache pages the engine could evict), so short
+    requests stop paying worst-case max_seq memory and concurrency is
+    bounded by actual token demand. The reservation is prefix-agnostic
+    and therefore conservative: a hit only ever uses fewer pages than
+    admitted against. Admission stays FIFO — a head request that does not
+    fit blocks the line (no starvation), and retirement ``release``s its
+    slots' pages back before new admissions. A dense engine without these
+    methods gets the old slot-count admission unchanged.
     """
 
     def __init__(self, engine: Any, *, stats: BatcherStats | None = None):
@@ -324,6 +356,8 @@ class ContinuousBatcher:
         # occupancy can be reported per shard without device reads
         self._dp = max(1, int(getattr(engine, "dp", 1)))
         self._shard_slots = engine.slots // self._dp
+        self._paged = hasattr(engine, "pages_for")
+        self._prefix_hits_seen = 0
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ko-serve-continuous")
         self._worker.start()
@@ -338,6 +372,14 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(prompt_ids)}) + max_tokens ({max_tokens}) "
                 f"exceed max_seq_len ({self.engine.max_total})")
+        if self._paged:
+            need = self.engine.pages_for(len(prompt_ids), max_tokens)
+            if need > self.engine.max_request_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages but one dp shard only "
+                    f"has {self.engine.max_request_pages} allocatable "
+                    f"(pages={self.engine.pages}, page={self.engine.page}): "
+                    f"it could never be admitted")
         req = _Pending(list(prompt_ids), int(max_tokens), float(temperature),
                        int(seed))
         self.stats.enqueued()
@@ -364,14 +406,59 @@ class ContinuousBatcher:
         for shard, n in enumerate(busy):
             self.stats.occupancy(n, shard=shard)
 
+    def _report_pages(self) -> None:
+        if not self._paged:
+            return
+        for shard in range(self._dp):
+            self.stats.pages_used(self.engine.pages_in_use(shard),
+                                  shard=shard)
+        hits = int(getattr(self.engine, "prefix_hits", 0))
+        if hits > self._prefix_hits_seen:
+            self.stats.prefix_hit(hits - self._prefix_hits_seen)
+            # ko: lint-ok[KO201] single-writer: only the worker thread reads the engine counter
+            self._prefix_hits_seen = hits
+
+    def _admit_wave_locked(self) -> list[tuple[int, _Pending]]:
+        """Pick the next admissions (caller holds the lock). Dense
+        engines: every queued request gets a free slot. Paged engines:
+        FIFO page accounting — the head request enters when a shard with
+        a free slot can cover its full page reservation net of pages
+        already promised to earlier picks in this same wave (``pending``;
+        without it two requests could both be admitted against the same
+        free pages). A head that fits nowhere stops the wave: in-flight
+        rows keep decoding, retirement releases pages, and — because
+        submit caps every request at ``max_request_pages`` — a fully
+        drained shard always re-admits, so backpressure cannot deadlock."""
+        admit_now: list[tuple[int, _Pending]] = []
+        if not self._paged:
+            while self._queue and self._free:
+                admit_now.append((self._free.pop(), self._queue.popleft()))
+            return admit_now
+        pending = [0] * self._dp
+        while self._queue and self._free:
+            r = self._queue[0]
+            need = self.engine.pages_for(len(r.prompt_ids), r.max_tokens)
+            slot = None
+            for i, s in enumerate(self._free):
+                shard = s // self._shard_slots
+                cap = (self.engine.free_pages(shard)
+                       + self.engine.evictable_pages(shard) - pending[shard])
+                if need <= cap:
+                    slot = self._free.pop(i)
+                    pending[shard] += need
+                    break
+            if slot is None:
+                break           # head-of-line backpressure: keep FIFO order
+            self._queue.popleft()
+            admit_now.append((slot, r))
+        return admit_now
+
     def _loop(self) -> None:
         while True:
             with self._cond:
                 while not self._queue and not self._track:
                     self._cond.wait()           # pool drained: idle
-                admit_now = []
-                while self._queue and self._free:
-                    admit_now.append((self._free.pop(), self._queue.popleft()))
+                admit_now = self._admit_wave_locked()
             try:
                 self._step(admit_now)
             except Exception as e:  # noqa: BLE001 — engine boundary
@@ -395,6 +482,7 @@ class ContinuousBatcher:
                 # ko: lint-ok[KO201] single-writer: only the worker thread mutates _track
                 self._track[slot] = t
             self._report_occupancy()
+            self._report_pages()
 
         active = [s for s, t in self._track.items() if t["pos"] < t["last"]]
         if active:
@@ -420,9 +508,14 @@ class ContinuousBatcher:
                             for x in buf[s][:t["plen"] + r.max_tokens]]
                 self.stats.finished(r, ok=True)
                 r.done.set()
+            if self._paged:
+                # hand the retired slots' pages back BEFORE the slots are
+                # offered for re-admission (prefix-cache pages stay warm)
+                self.engine.release(done)
             with self._cond:
                 self._free.extend(done)
             self._report_occupancy()
+            self._report_pages()
 
     def _fail_all(self, admit_now: list[tuple[int, _Pending]],
                   err: Exception) -> None:
@@ -434,6 +527,14 @@ class ContinuousBatcher:
             victims += [r for _, r in admit_now if not r.done.is_set()]
             self._track.clear()
             self._free = list(range(self.engine.slots))
+        if self._paged:
+            try:
+                # drop every slot's page reservation so the reset pool
+                # starts from a consistent allocator (best-effort: the
+                # engine may be the thing that just failed)
+                self.engine.release(list(range(self.engine.slots)))
+            except Exception:  # noqa: BLE001 — already failing
+                pass
         for r in victims:
             if not r.done.is_set():
                 r.error = err

@@ -269,3 +269,23 @@ def shard_params_decode_tp(params: Any, mesh: Mesh) -> Any:
         return replicated(mesh)
 
     return jax.tree_util.tree_map_with_path(place, params)
+
+
+def shard_page_pool(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    """Placement for the serving engine's paged KV layout
+    (``decode_loop.SlotPoolEngine`` round 8): per-layer page pools
+    ``[P, page, H, D]`` and per-slot block tables ``[S, T/page]``.
+
+    The page axis P splits over ``dp`` exactly like the dense slot rows it
+    replaces — the host allocator hands each dp group a contiguous range
+    of pages, so a slot's block table only ever names pages its own group
+    owns and no cross-dp gather exists. Attention heads split over ``tp``
+    as before. Block tables replicate: they are tiny int32 index arrays
+    every shard needs to gather its pages, and replication keeps the
+    segment jit's gather local. Missing axes degrade to None, so the same
+    call works on any dp×tp mesh. Returns (pool_sharding, table_sharding).
+    """
+    dp_ax = "dp" if "dp" in mesh.axis_names else None
+    tp_ax = "tp" if "tp" in mesh.axis_names else None
+    return (NamedSharding(mesh, P(dp_ax, None, tp_ax, None)),
+            NamedSharding(mesh, P(None, None)))

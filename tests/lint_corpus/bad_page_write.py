@@ -1,0 +1,24 @@
+"""Corpus: paged-KV pool write bypassing the block-table helper (KO121)."""
+import jax.numpy as jnp
+
+
+class PagedPool:
+    def __init__(self, kv_pool, bt, page):
+        self._kv_pool = kv_pool
+        self._bt = bt
+        self._page = page
+
+    def _page_write(self, pool, pages, offsets, vals):
+        return pool.at[pages, offsets].set(vals)
+
+    def _page_copy(self, pool, dst, src):
+        return pool.at[dst].set(pool[src])
+
+    def admit(self, slot, pos, vals):
+        # KO121: raw slot-indexed write straight into the paged pool
+        self._kv_pool = self._kv_pool.at[slot, pos].set(vals)
+
+    def admit_routed(self, slot, pos, vals):
+        pages = self._bt[slot, pos // self._page]
+        offsets = pos % self._page
+        self._kv_pool = self._page_write(self._kv_pool, pages, offsets, vals)

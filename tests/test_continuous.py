@@ -17,7 +17,8 @@ import numpy as np
 import pytest
 
 from kubeoperator_tpu.workloads.decode_loop import (
-    SlotPoolEngine, donation_argnums, validate_serve_mesh,
+    SlotPoolEngine, donation_argnums, validate_page_pool,
+    validate_serve_mesh,
 )
 from kubeoperator_tpu.workloads.generate import generate
 from kubeoperator_tpu.workloads.serving import ContinuousBatcher
@@ -367,3 +368,246 @@ def test_scaling_cost_model_8dev_vs_1dev():
     first, last = out["curve"][0], out["curve"][-1]
     assert first["n_devices"] == 1 and last["n_devices"] == 8
     assert last["tok_s"] >= 1.5 * first["tok_s"], out
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache + hashed prefix reuse (round 8)
+# ---------------------------------------------------------------------------
+
+# a 16-token system prompt = exactly 2 pages at the page size the tiny
+# CFG resolves to (max_seq_len 24 -> page 8, 3 blocks per slot)
+PRE = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+
+
+def test_page_pool_defaults(params):
+    """Defaults keep existing callers dense-equivalent: 8-token pages for
+    the 24-token test context, and enough pages that every slot can hold
+    a full-length row (plus the per-shard trash page)."""
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    assert eng.page == 8 and eng.blocks == 3
+    assert eng.pages == 2 * 3 + 1
+    assert eng.max_request_pages == eng.pages - 1
+    assert eng.pages_for(5, 4) == 2                 # ceil(9/8)
+    assert eng.free_pages(0) == eng.pages - 1       # trash page reserved
+
+
+def test_validate_page_pool_rejections():
+    """Satellite 1: un-serveable page-pool layouts fail fast with
+    actionable messages, standalone and through validate_serve_mesh."""
+    with pytest.raises(ValueError, match=r"page size \(6\) must be a "
+                                         r"power of two"):
+        validate_page_pool(page=6, pages=8, max_seq_len=24)
+    with pytest.raises(ValueError, match=r"page size \(32\) must be <= "
+                                         r"max_seq_len \(24\)"):
+        validate_page_pool(page=32, pages=8, max_seq_len=24)
+    with pytest.raises(ValueError, match=r"max_seq_len \(24\) must be "
+                                         r"divisible by the page size"):
+        validate_page_pool(page=16, pages=8, max_seq_len=24)
+    with pytest.raises(ValueError, match=r"pages \(9\) must be divisible "
+                                         r"by dp \(2\)"):
+        validate_page_pool(page=8, pages=9, max_seq_len=24, dp=2)
+    with pytest.raises(ValueError, match="reserved trash page"):
+        validate_page_pool(page=8, pages=2, max_seq_len=24, dp=2)
+    with pytest.raises(ValueError, match="power of two"):
+        validate_serve_mesh(MeshSpec(dp=2, tp=4), slots=8, n_heads=4,
+                            page=6, pages=8, max_seq_len=24)
+    # a valid paged layout passes the combined validator
+    validate_serve_mesh(MeshSpec(dp=2, tp=4), slots=8, n_heads=4,
+                        page=8, pages=8, max_seq_len=24)
+
+
+def test_prefix_hits_match_solo_all_shapes(params):
+    """Every hit shape stays bit-identical to solo generate(): a
+    bucket-covering hit (h >= prefill bucket, no pass at all), a
+    full-prompt hit (copy-on-write re-decode of the boundary token), and
+    a partial hit (scratch prefill seeded from the shared pages)."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3)
+    track = {}
+    admit_tracked(eng, track, [(0, PRE + [11, 12], 6, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[0][:24].tolist() == solo(params, PRE + [11, 12], 6)
+    assert eng.prefix_hits == 0          # cold pool: nothing to hit
+    reqs = {1: (PRE + [13, 14, 15], 5),  # h=16 >= bucket 16: no pass
+            2: (PRE, 8),                 # full-prompt hit -> CoW
+            3: (PRE[:8] + [7] * 9, 4)}   # h=8 < bucket 16: seeded prefill
+    track = {}
+    admit_tracked(eng, track, [(s, p, mt, 0.0, 0)
+                               for s, (p, mt) in reqs.items()])
+    assert eng.prefix_hits == 3
+    assert eng.cow_copies >= 1
+    buf = drain(eng, track)
+    for s, (prompt, mt) in reqs.items():
+        got = buf[s][:len(prompt) + mt].tolist()
+        assert got == solo(params, prompt, mt), f"slot {s} diverged"
+
+
+def test_cow_isolation_between_sharers(params):
+    """Two requests hitting the SAME cached prefix in one wave each get
+    their own copy-on-write page: neither corrupts the other, and the
+    cached original stays intact for a third request after both wrote."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3)
+    track = {}
+    admit_tracked(eng, track, [(0, PRE, 4, 0.0, 0)])     # publish pages
+    drain(eng, track)
+    track = {}
+    admit_tracked(eng, track, [(1, PRE, 6, 0.0, 0),      # both full hits:
+                               (2, PRE, 8, 0.0, 0)])     # both CoW
+    assert eng.cow_copies >= 2
+    buf = drain(eng, track)
+    assert buf[1][:22].tolist() == solo(params, PRE, 6)
+    assert buf[2][:24].tolist() == solo(params, PRE, 8)
+    track = {}
+    admit_tracked(eng, track, [(3, PRE + [17, 18], 4, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[3][:22].tolist() == solo(params, PRE + [17, 18], 4)
+
+
+def test_page_exhaustion_raises_at_engine(params):
+    """With nothing evictable, over-admitting past the pool raises the
+    actionable engine error (the batcher's page accounting is what keeps
+    production from ever reaching it)."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2, pages=5)
+    assert eng.max_request_pages == 4
+    eng.admit([(0, [1, 2, 3], 8, 0.0, 0),     # 2 pages each, short
+               (1, [4, 5, 6], 8, 0.0, 1)])    # prompts cache nothing
+    assert eng.free_pages(0) == 0 and eng.evictable_pages(0) == 0
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        eng.admit([(2, [7, 8, 9], 8, 0.0, 2)])
+
+
+def test_batcher_backpressure_on_pages(params):
+    """More requests than the page pool holds at once: the batcher's
+    FIFO page accounting delays admission instead of crashing the
+    engine, every reply still matches solo, and retirement returns all
+    pages."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, pages=5)
+    cb = ContinuousBatcher(eng)
+    reqs = [([5 + i, 6 + i, 7 + i], 8) for i in range(4)]   # 2 pages each
+    results = {}
+
+    def client(i, prompt, mt):
+        time.sleep(0.005 * i)
+        results[i] = cb.submit(prompt, mt, timeout=60.0)
+
+    threads = [threading.Thread(target=client, args=(i, *r))
+               for i, r in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (prompt, mt) in enumerate(reqs):
+        assert results[i] == solo(params, prompt, mt), f"request {i}"
+    assert eng.free_pages(0) + eng.evictable_pages(0) == 4
+    # an impossible request is rejected client-side, never queued: on a
+    # 3-page pool (2 allocatable) a full-length 3-page request can't fit
+    tiny = ContinuousBatcher(SlotPoolEngine(CFG, params, slots=2,
+                                            segment=2, pages=3))
+    with pytest.raises(ValueError, match="could never be admitted"):
+        tiny.submit([1] * 16, 8)
+
+
+def test_eviction_refcount_correctness(params):
+    """Released prefix pages stay cached (pages_in_use == evictable),
+    are evicted LRU-first when admission needs the room, and pages
+    shared by a live slot AND the cache are never evictable."""
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=4, pages=7)
+    track = {}
+    admit_tracked(eng, track, [(0, PRE, 8, 0.0, 0)])     # 3 pages
+    drain(eng, track)
+    eng.release([0])
+    # decode page freed; the 2 prefix pages stay, held only by the cache
+    assert eng.pages_in_use(0) == 2 == eng.evictable_pages(0)
+    assert eng.free_pages(0) == 4
+    # two fresh 3-page admissions need 6 pages -> evicts the cached 2
+    fresh = {0: ([7 + i for i in range(16)], 8),
+             1: ([31 - i for i in range(16)], 8)}
+    track = {}
+    admit_tracked(eng, track, [(s, p, mt, 0.0, 0)
+                               for s, (p, mt) in fresh.items()])
+    assert eng.free_pages(0) == 0
+    # the new prompts registered their own prefixes, but live slots pin
+    # those pages: nothing is evictable while the slots decode
+    assert eng.evictable_pages(0) == 0
+    buf = drain(eng, track)
+    for s, (prompt, mt) in fresh.items():
+        assert buf[s][:24].tolist() == solo(params, prompt, mt)
+    eng.release([0, 1])
+    assert eng.pages_in_use(0) == eng.evictable_pages(0)
+
+
+def test_batcher_reports_paged_metrics(params):
+    """Satellite 6 end-to-end: the batcher detects the paged protocol,
+    a repeat prompt scores a prefix hit, and both new prometheus
+    families carry data."""
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    cb = ContinuousBatcher(eng)
+    assert cb._paged
+    out1 = cb.submit(PRE, 4)
+    out2 = cb.submit(PRE, 4)           # full-prompt hit -> CoW re-decode
+    assert out1 == out2 == solo(params, PRE, 4)
+    assert eng.prefix_hits >= 1
+    s = cb.stats.snapshot()
+    assert s["prefix_hits_total"] >= 1
+    text = cb.stats.prometheus()
+    assert 'ko_serve_kv_pages_used{shard="0"}' in text
+    assert "ko_serve_prefix_hits_total" in text
+    # retired slots returned their pages; only the prefix cache holds any
+    assert eng.pages_in_use(0) == eng.evictable_pages(0)
+
+
+@needs_8dev
+def test_sharded_prefix_hit_matches_solo(params):
+    """Paging + prefix reuse on the 2×4 mesh: the cache is per dp shard
+    (block tables may only name pages the slot's own shard owns), hits
+    stay bit-identical to solo, and a cold admission of the same prompt
+    on the OTHER shard produces the same tokens without a hit."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         mesh_spec=MESH_2x4)
+    track = {}
+    admit_tracked(eng, track, [(0, PRE + [11, 12], 6, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[0][:24].tolist() == solo(params, PRE + [11, 12], 6)
+    # slot 1 shares shard 0's cache; slot 2 lives on shard 1 (cold)
+    track = {}
+    admit_tracked(eng, track, [(1, PRE + [13, 14], 4, 0.0, 0),
+                               (2, PRE + [13, 14], 4, 0.0, 0)])
+    assert eng.prefix_hits == 1
+    buf = drain(eng, track)
+    want = solo(params, PRE + [13, 14], 4)
+    assert buf[1][:22].tolist() == want
+    assert buf[2][:22].tolist() == want
+
+
+def test_fake_paged_engine_shares_protocol(params):
+    """The bench's paged fake must keep mirroring SlotPoolEngine's page
+    accounting protocol, or the equal-HBM microbench stops modeling
+    production."""
+    bs = _bench_mod()
+    fake = bs.FakePagedEngine(slots=2, segment=2, max_total=24, page=8,
+                              step_s=0.0, dispatch_s=0.0, prefill_s=0.0)
+    real = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    for eng in (fake, real):
+        assert eng.page == 8 and eng.pages == 7
+        assert eng.pages_for(5, 4) == 2
+        assert eng.max_request_pages == 6
+        free0 = eng.free_pages(0)
+        eng.admit([(0, [1, 2, 3, 4, 5], 4, 0.0, 0)])
+        assert eng.free_pages(0) == free0 - 2
+        eng.release([0])
+        assert eng.free_pages(0) == free0
+
+
+def test_paged_cost_model_equal_hbm_win():
+    """Round-8 acceptance guard on the injected-latency cost model: at
+    EQUAL KV HBM (dense_slots × max_seq_len cached tokens) the paged
+    pool must admit >= 1.3x the dense peak concurrency (6x+ typical on
+    this shape — page-granular reservations vs full-length rows) and
+    cut mean TTFT (prefix hits skip the cached share of prefill and
+    short requests stop queueing)."""
+    bs = _bench_mod()
+    out = bs.bench_paged(requests=32, dense_slots=4, segment=8, page=16,
+                         step_s=0.001, dispatch_s=0.003, prefill_s=0.002,
+                         stagger_s=0.002)
+    assert out["concurrency_gain"] >= 1.3, out
+    assert out["paged"]["mean_ttft_s"] < out["dense"]["mean_ttft_s"], out
+    assert out["paged"]["prefix_hits"] >= 1, out

@@ -32,6 +32,7 @@ GOLDEN = {
     "bad_retrace.py": {"KO112"},
     "bad_closure.py": {"KO113"},
     "bad_unpinned.py": {"KO120"},
+    "bad_page_write.py": {"KO121"},
     "bad_locking.py": {"KO201"},
     "bad_metric.py": {"KO210"},
     "bad_pragma.py": {"KO000", "KO001", "KO201"},
