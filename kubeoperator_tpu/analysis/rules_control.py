@@ -30,7 +30,7 @@ _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 #: prometheus-style type suffixes the registry uses — this keeps KO210
 #: off ContextVar/logger names like ``ko_current_span``
 _METRIC_SUFFIXES = ("_total", "_seconds", "_depth", "_size", "_occupancy",
-                    "_bytes", "_ratio") + _SERIES_SUFFIXES
+                    "_bytes", "_ratio", "_rate") + _SERIES_SUFFIXES
 
 
 def _lock_call(ctx: ModuleContext, node: ast.AST) -> bool:

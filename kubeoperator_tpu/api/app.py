@@ -258,6 +258,39 @@ async def get_execution_trace(request: web.Request) -> web.Response:
                               "spans": rec.spans, "dropped": rec.dropped})
 
 
+async def get_serve_request_trace(request: web.Request) -> web.Response:
+    """Span tree for one recent serving request (``ko trace --serve <id>``
+    consumes this). Serve traces live in a bounded per-process ring, not
+    the resource store — they describe this controller's in-process serve
+    engine, so there is no cluster scope to check."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        SERVE_TRACES, render_record,
+    )
+    rec = SERVE_TRACES.get(request.match_info["id"])
+    if rec is None:
+        return json_error(404, "no trace recorded for this request "
+                               "(retired requests age out of the ring)")
+    return web.json_response(render_record(rec))
+
+
+async def list_serve_request_traces(request: web.Request) -> web.Response:
+    """Recent serve traces, newest first — ``?slowest=N`` ranks by root
+    duration instead (the ``ko trace --serve --slowest N`` read path)."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        SERVE_TRACES, render_record,
+    )
+    try:
+        slowest = int(request.query.get("slowest", "0"))
+    except ValueError:
+        return json_error(400, "slowest must be an integer")
+    if slowest > 0:
+        recs = SERVE_TRACES.slowest(slowest)
+    else:
+        recs = list(reversed(SERVE_TRACES.records()))
+    return web.json_response({"traces": [render_record(r) for r in recs],
+                              "evicted": SERVE_TRACES.evicted})
+
+
 # ---------------------------------------------------------------------------
 # generic CRUD
 # ---------------------------------------------------------------------------
@@ -1166,6 +1199,8 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/executions/{id}", get_execution)
     r.add_get("/api/v1/executions/{id}/trace", get_execution_trace)
     r.add_post("/api/v1/executions/{id}/retry", retry_execution)
+    r.add_get("/api/v1/serve/requests/traces", list_serve_request_traces)
+    r.add_get("/api/v1/serve/requests/{id}/trace", get_serve_request_trace)
     r.add_get("/api/v1/tasks", tasks_monitor)
     r.add_get("/api/v1/tasks/{id}", get_task)
     r.add_get("/api/v1/schema", openapi_schema)

@@ -286,7 +286,14 @@ data:
       {{"title": "KV pages used (by mesh shard) / prefix hit rate", "type": "timeseries", "gridPos": {{"x":0,"y":24,"w":12,"h":8}},
         "targets": [{{"expr": "sum(ko_serve_kv_pages_used)"}},
                     {{"expr": "sum(ko_serve_kv_pages_used) by (shard)", "legendFormat": "shard {{{{shard}}}}"}},
-                    {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}}
+                    {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}},
+      {{"title": "SLO burn rate (by slo, fast/slow window)", "type": "timeseries", "gridPos": {{"x":12,"y":24,"w":12,"h":8}},
+        "targets": [{{"expr": "ko_slo_burn_rate", "legendFormat": "{{{{slo}}}} {{{{window}}}}"}},
+                    {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment"}}]}},
+      {{"title": "TTFT decomposition: queue vs device vs host-blocked", "type": "timeseries", "gridPos": {{"x":0,"y":32,"w":12,"h":8}},
+        "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}},
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_segment_device_seconds_bucket[5m])) by (le))"}},
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_host_blocked_seconds_bucket[5m])) by (le, shard))", "legendFormat": "host-blocked shard {{{{shard}}}}"}}]}}
     ]}}
 ---
 apiVersion: v1

@@ -67,6 +67,14 @@ DEFAULTS: dict[str, Any] = {
     # monitoring cadence (seconds); ref kubeops_api/tasks.py:40-89 (5 min / hourly / daily)
     "monitor_interval": 300,
     "health_interval": 300,
+    # serve SLOs (ISSUE 9): declarative spec evaluated by the monitor beat
+    # over the snapshot history — {"ttft_p95_ms": 500} shorthand, or
+    # {"ttft_p95_ms": {"target": 500, "objective": 0.999}}. Supported keys
+    # live in services/monitor.SLO_SIGNALS; window lengths are in history
+    # points (one per monitor_interval tick).
+    "serve_slos": {},
+    "slo_fast_window": 12,                  # ~1 h at the 5-min beat
+    "slo_slow_window": 72,                  # ~6 h
     "backup_hour": 1,
     # executor selection: "ssh" | "fake"
     "executor": "ssh",

@@ -11,6 +11,7 @@ hosts, packages, and executions. Zero dependencies — stdlib urllib.
     ko op demo install            # streams step progress until done
     ko retry <execution-id>
     ko trace <execution-id> --slowest 3
+    ko trace --serve --slowest 5          # slowest recent serve requests
     ko hosts | ko packages | ko logs --query error
 """
 
@@ -242,17 +243,55 @@ def cmd_tasks(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """Render an execution's persisted span tree: an indented timeline by
-    default, or the N slowest spans with their ancestry (--slowest N) —
-    the critical-path answer to "where did my provision time go"."""
-    d = Client().call("GET", f"/api/v1/executions/{args.id}/trace")
+    """Render a persisted span tree: an indented timeline by default, or
+    the N slowest spans with their ancestry (--slowest N) — the
+    critical-path answer to "where did my provision time go". With
+    ``--serve`` the tree is a serving request's (enqueue → admit →
+    prefill → segments → retire) from the controller's in-process ring:
+    one request by id, or the recent/slowest requests without one.
+    ``--json`` emits the schema-v1 span dicts instead of the timeline."""
+    c = Client()
     # rendering lives next to the tracer so the API and CLI can't drift
     from kubeoperator_tpu.telemetry.tracing import format_trace
+    if args.serve:
+        if args.id:
+            one = c.call("GET", f"/api/v1/serve/requests/{args.id}/trace")
+            traces, evicted = [one], None
+        else:
+            q = f"?slowest={args.slowest}" if args.slowest > 0 else ""
+            d = c.call("GET", f"/api/v1/serve/requests/traces{q}")
+            traces, evicted = d["traces"], d.get("evicted", 0)
+        if args.as_json:
+            print(json.dumps(traces[0] if args.id else
+                             {"traces": traces, "evicted": evicted},
+                             indent=2))
+            return 0
+        if not traces:
+            print("(no serve traces recorded)")
+            return 0
+        for t in traces:
+            print(f"request {t['request']} — {len(t['spans'])} spans, "
+                  f"{_fmt_s(t.get('duration_s', 0.0))}"
+                  + (f", {t['dropped']} dropped" if t.get("dropped") else ""))
+            print(format_trace(t["spans"]))
+        return 0
+    if not args.id:
+        print("error: `ko trace` needs an execution id (or --serve)",
+              file=sys.stderr)
+        return 2
+    d = c.call("GET", f"/api/v1/executions/{args.id}/trace")
+    if args.as_json:
+        print(json.dumps({"version": 1, **d}, indent=2))
+        return 0
     print(f"execution {d['execution']} ({d['operation']}) — "
           f"{len(d['spans'])} spans"
           + (f", {d['dropped']} dropped" if d.get("dropped") else ""))
     print(format_trace(d["spans"], slowest=args.slowest))
     return 0
+
+
+def _fmt_s(seconds: float) -> str:
+    return f"{seconds * 1000:.1f}ms" if seconds < 1 else f"{seconds:.2f}s"
 
 
 def cmd_dashboard(args) -> int:
@@ -308,10 +347,18 @@ def build_parser(sub) -> None:
     retry.add_argument("--no-wait", action="store_true")
     retry.set_defaults(fn=cmd_retry)
 
-    trace = sub.add_parser("trace", help="span-tree timeline of an execution")
-    trace.add_argument("id", help="execution id")
+    trace = sub.add_parser(
+        "trace", help="span-tree timeline of an execution or serve request")
+    trace.add_argument("id", nargs="?", default="",
+                       help="execution id (or request id with --serve)")
+    trace.add_argument("--serve", action="store_true",
+                       help="serving-request traces from the controller's "
+                            "in-process ring instead of an execution")
     trace.add_argument("--slowest", type=int, default=0, metavar="N",
-                       help="show only the N slowest spans (critical path)")
+                       help="execution: only the N slowest spans (critical "
+                            "path); --serve: the N slowest recent requests")
+    trace.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the schema-v1 span dicts as JSON")
     trace.set_defaults(fn=cmd_trace)
 
     apps = sub.add_parser("apps", help="runtime app store on a cluster")

@@ -24,6 +24,7 @@ from kubeoperator_tpu.resources.entities import (
     Cluster, ClusterStatus, Credential, HealthRecord, Host, Node, new_id,
 )
 from kubeoperator_tpu.resources.entities import iso as iso_now
+from kubeoperator_tpu.telemetry import metrics as tm
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -82,6 +83,108 @@ PROMQL = {
     "serve_kv_pages_used": "sum(ko_serve_kv_pages_used)",
     "serve_prefix_hit_rate": "sum(rate(ko_serve_prefix_hits_total[5m]))",
 }
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (round 9): declarative serve SLOs judged over the snapshot
+# history. The spec lives in config ("serve_slos"); every supported key maps
+# a target to one serve series the monitor already persists per beat, so SLO
+# evaluation adds NO new PromQL — it is pure arithmetic over the sliding
+# window, which is exactly what the future autoscaler beat will consume.
+# ---------------------------------------------------------------------------
+
+DEFAULT_OBJECTIVE = 0.99     # attainment goal; budget = 1 - objective
+
+#: SLO spec key -> (history point key, scale applied to the raw series).
+#: Every supported SLO is an upper bound: the window point MEETS the SLO
+#: when ``value * scale <= target``.
+SLO_SIGNALS: dict[str, tuple[str, float]] = {
+    "ttft_p95_ms": ("serve_ttft_p95", 1000.0),
+    "latency_p95_ms": ("serve_latency_p95", 1000.0),
+    "queue_depth_max": ("serve_queue_depth", 1.0),
+    "slot_occupancy_max": ("serve_slot_occupancy", 1.0),
+    "kv_page_pressure_max": ("serve_kv_pages_used", 1.0),
+}
+
+
+def _slo_series(points: list[dict], key: str, scale: float) -> list[float | None]:
+    """The scaled series for one signal; ``None`` (and the legacy ``-1.0``
+    sentinel in old history points) means "no jax-serve data that tick"."""
+    out: list[float | None] = []
+    for p in points:
+        v = p.get(key)
+        out.append(None if v is None or v < 0 else float(v) * scale)
+    return out
+
+
+def _burn(vals: list[float | None], target: float,
+          budget: float) -> float | None:
+    """Error-budget burn over one window: the fraction of known points
+    breaching the target, divided by the budget (1 - objective). 1.0 burns
+    exactly the whole budget within the window; None = no data at all."""
+    known = [v for v in vals if v is not None]
+    if not known:
+        return None
+    breach = sum(1 for v in known if v > target) / len(known)
+    return round(breach / budget, 3)
+
+
+def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
+                  slow_window: int = 72) -> dict:
+    """Judge every configured SLO over the history ``points`` (oldest
+    first). Pure: no store, no gauges — the monitor wrapper emits those.
+
+    Returns ``{"slos": {name: {target, objective, signal, value, met,
+    attainment, burn_rate: {fast, slow}, state}}, "events": [...]}`` where
+    ``state`` is ok | breach | no_data and each event is one breach-edge
+    (ok→breach or breach→ok) introduced by the newest point — derived by
+    re-judging the fast window without it, so the beat needs no cross-tick
+    state."""
+    slos: dict[str, dict] = {}
+    events: list[dict] = []
+    for name in sorted(spec):
+        raw = spec[name]
+        if isinstance(raw, dict):
+            target = float(raw.get("target", 0.0))
+            objective = float(raw.get("objective", DEFAULT_OBJECTIVE))
+        else:
+            target, objective = float(raw), DEFAULT_OBJECTIVE
+        sig = SLO_SIGNALS.get(name)
+        if sig is None:
+            slos[name] = {"target": target, "state": "unknown_slo",
+                          "supported": sorted(SLO_SIGNALS)}
+            continue
+        key, scale = sig
+        budget = max(1e-9, 1.0 - objective)
+        vals = _slo_series(points, key, scale)
+        burn_fast = _burn(vals[-fast_window:], target, budget)
+        burn_slow = _burn(vals[-slow_window:], target, budget)
+        known_slow = [v for v in vals[-slow_window:] if v is not None]
+        attainment = (round(sum(1 for v in known_slow if v <= target)
+                            / len(known_slow), 4) if known_slow else None)
+        value = next((v for v in reversed(vals) if v is not None), None)
+
+        def _state(b: float | None) -> str:
+            return "no_data" if b is None else \
+                "breach" if b >= 1.0 else "ok"
+
+        state = _state(burn_fast)
+        prev = _state(_burn(vals[:-1][-fast_window:], target, budget)
+                      if len(vals) > 1 else None)
+        if state != prev and "breach" in (state, prev):
+            events.append({
+                "slo": name, "from": prev, "to": state,
+                "burn_fast": burn_fast, "value": value, "target": target,
+                "time": points[-1].get("time") if points else None})
+        slos[name] = {
+            "target": target, "objective": objective, "signal": key,
+            "value": value,
+            "met": None if value is None else value <= target,
+            "attainment": attainment,
+            "burn_rate": {"fast": burn_fast, "slow": burn_slow},
+            "state": state,
+        }
+    return {"slos": slos, "events": events}
 
 
 def urllib_transport(method: str, url: str, headers: dict, timeout: float) -> tuple[int, str]:
@@ -173,6 +276,16 @@ class PromClient:
             return float(result[0]["value"][1]) if result else default
         except Exception:  # noqa: BLE001 — metric gaps are data, not errors
             return default
+
+    def scalar_or_none(self, promql: str) -> float | None:
+        """Like ``scalar`` but with ``None`` as the "series unavailable"
+        sentinel — what JSON snapshots carry for serve metrics (round 9:
+        ``-1.0`` stays a ``scalar`` default choice, never a JSON value)."""
+        try:
+            result = self.query(promql)
+            return float(result[0]["value"][1]) if result else None
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            return None
 
     def targets_health(self) -> dict[str, bool]:
         """Component availability (reference ``:27-86`` scores targets)."""
@@ -274,23 +387,22 @@ class ClusterMonitor:
         mem_used = prom.scalar(PROMQL["mem_used"])
         mem_total = prom.scalar(PROMQL["mem_total"])
         tpu_util = prom.scalar(PROMQL["tpu_util"], default=-1.0)
-        # serving plane: -1 marks "no jax-serve deployed" (charts hide it)
-        serve_queue = prom.scalar(PROMQL["serve_queue_depth"], default=-1.0)
-        serve_p95 = prom.scalar(PROMQL["serve_latency_p95"], default=-1.0)
-        serve_rate = prom.scalar(PROMQL["serve_tokens_rate"], default=-1.0)
-        serve_slots = prom.scalar(PROMQL["serve_slot_occupancy"],
-                                  default=-1.0)
+        # serving plane: None marks "no jax-serve deployed" in the JSON
+        # snapshot (charts and SLO evaluation skip it; the old -1.0
+        # sentinel survives only as a PromClient.scalar default)
+        serve_queue = prom.scalar_or_none(PROMQL["serve_queue_depth"])
+        serve_p95 = prom.scalar_or_none(PROMQL["serve_latency_p95"])
+        serve_rate = prom.scalar_or_none(PROMQL["serve_tokens_rate"])
+        serve_slots = prom.scalar_or_none(PROMQL["serve_slot_occupancy"])
         try:
             serve_shards = {
                 r.get("metric", {}).get("shard", "?"): float(r["value"][1])
                 for r in prom.query(PROMQL["serve_slot_occupancy_by_shard"])}
         except Exception:  # noqa: BLE001 — metric gaps are data, not errors
             serve_shards = {}
-        serve_ttft = prom.scalar(PROMQL["serve_ttft_p95"], default=-1.0)
-        serve_pages = prom.scalar(PROMQL["serve_kv_pages_used"],
-                                  default=-1.0)
-        serve_hit_rate = prom.scalar(PROMQL["serve_prefix_hit_rate"],
-                                     default=-1.0)
+        serve_ttft = prom.scalar_or_none(PROMQL["serve_ttft_p95"])
+        serve_pages = prom.scalar_or_none(PROMQL["serve_kv_pages_used"])
+        serve_hit_rate = prom.scalar_or_none(PROMQL["serve_prefix_hit_rate"])
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -326,9 +438,6 @@ class ClusterMonitor:
         existing = store.find(MonitorSnapshot, scoped=False, name=self.cluster.name)
         snap = existing[0] if existing else MonitorSnapshot(
             project=self.cluster.name, name=self.cluster.name)
-        snap.data = data
-        snap.created_at = iso_now()
-        store.save(snap)
         # rolling time series for the dashboard charts (reference: echarts
         # panels read the Redis history; here a capped :history snapshot)
         found = store.find(MonitorSnapshot, scoped=False,
@@ -350,9 +459,39 @@ class ClusterMonitor:
                        "serve_kv_pages_used": data["serve_kv_pages_used"],
                        "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
                        "pod_count": data["pod_count"]})
-        hist.data = {"points": points[-self.HISTORY_POINTS:]}
+        points = points[-self.HISTORY_POINTS:]
+        # SLO evaluation rides the same beat, judged over the freshly
+        # appended window, so snapshot()["slo"], the persisted snapshot
+        # and the ko_slo_* gauges always agree tick by tick
+        data["slo"] = self._slo_block(points)
+        snap.data = data
+        snap.created_at = iso_now()
+        store.save(snap)
+        hist.data = {"points": points}
         hist.created_at = iso_now()
         store.save(hist)
+
+    def _slo_block(self, points: list[dict]) -> dict:
+        """Evaluate the configured SLO spec and publish the gauges +
+        breach-edge events (the autoscaler beat's future input)."""
+        cfg = self.platform.config
+        block = evaluate_slos(
+            cfg.get("serve_slos") or {}, points,
+            fast_window=int(cfg.get("slo_fast_window", 12)),
+            slow_window=int(cfg.get("slo_slow_window", 72)))
+        for name, s in block["slos"].items():
+            if s.get("attainment") is not None:
+                tm.SLO_TARGET_RATIO.set(s["attainment"], slo=name)
+            for win in ("fast", "slow"):
+                burn = (s.get("burn_rate") or {}).get(win)
+                if burn is not None:
+                    tm.SLO_BURN_RATE.set(burn, slo=name, window=win)
+        for ev in block["events"]:
+            log.warning(
+                "slo %s %s -> %s on %s (burn_fast=%s value=%s target=%s)",
+                ev["slo"], ev["from"], ev["to"], self.cluster.name,
+                ev["burn_fast"], ev["value"], ev["target"])
+        return block
 
     # -- events (reference put_event_data_to_es, :506-534) -----------------
     def harvest_events(self) -> list[dict]:

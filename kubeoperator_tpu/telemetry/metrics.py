@@ -442,7 +442,35 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             "ko_serve_prefix_hits_total",
             "Admissions that reused cached prompt-prefix pages (their "
             "prefill was skipped; paged continuous engine)."),
+        "segment_device": registry.histogram(
+            "ko_serve_segment_device_seconds",
+            "Device share of one decode segment: dispatch to the ready "
+            "signal the retirement fetch observes (continuous engine).",
+            buckets=SERVE_SEGMENT_BUCKETS),
+        "host_blocked": registry.histogram(
+            "ko_serve_host_blocked_seconds",
+            "Host-blocked share of retirement: time the worker waited in "
+            "the batched result fetch, per dp mesh shard retiring rows.",
+            labels=("shard",),
+            buckets=SERVE_SEGMENT_BUCKETS),
     }
+
+
+# -- SLO engine families (services/monitor.evaluate_slos) -------------------
+# Set by the controller's monitor beat, not by BatcherStats: SLO attainment
+# and burn are judged over the persisted snapshot history, so they live on
+# the process-global REGISTRY directly.
+SLO_TARGET_RATIO = REGISTRY.gauge(
+    "ko_slo_target_ratio",
+    "Fraction of the sliding window meeting the SLO target (1.0 = fully "
+    "attained), per configured serve SLO.",
+    labels=("slo",))
+SLO_BURN_RATE = REGISTRY.gauge(
+    "ko_slo_burn_rate",
+    "Error-budget burn rate per configured serve SLO and window "
+    "(fast | slow); 1.0 burns the whole budget within the objective "
+    "period, sustained fast burn >1.0 is a page.",
+    labels=("slo", "window"))
 
 
 declare_serve_metrics(REGISTRY)

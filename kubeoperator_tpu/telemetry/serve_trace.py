@@ -1,0 +1,218 @@
+"""Per-request span trees for the serving data plane.
+
+PR 2 gave executions a span tree; this module extends it to serving
+requests. ``ContinuousBatcher`` calls a ``ServeTracer`` (when one is
+wired — tracing is strictly opt-in and zero-cost when off) at the four
+scheduling edges it already owns: submit, admission, segment advance and
+retirement. Each request becomes one ``tracing.Trace`` whose tree is
+
+    request
+    ├── enqueue            (submit → admission pick)
+    ├── admit              (the admission wave; slot/shard/pages/hit_kind)
+    │   └── prefill        (only when the prefix cache did NOT cover it)
+    ├── segment ×N         (one per decode-segment dispatch touching it)
+    └── retire             (device_s / host_blocked_s attribution)
+
+All spans are annotated from values the batcher already holds on the
+host — admission plans, segment wall times, the retirement fetch — so
+tracing adds **no** device reads or dispatches to the decode loop.
+Spans past ``max_spans`` hit the usual dropped counter. The root and
+enqueue spans are recorded at ``begin`` and mutated in place until the
+tree is serialized, so a long generation that overflows the cap loses
+trailing ``segment``/``retire`` spans — never the request root.
+
+Completed trees persist as ``TraceRecord``s (``name`` = request id,
+``operation`` = "serve") into a bounded per-process ring —
+``ServeTraceStore`` — read by ``GET /api/v1/serve/requests/{id}/trace``
+and ``ko trace --serve``. The ring is process-local by design: serve
+traces describe one engine's scheduling, not cluster state, so they do
+not belong in the resource store.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from kubeoperator_tpu.telemetry.tracing import (
+    DEFAULT_MAX_SPANS, Span, Trace, TraceRecord,
+)
+
+#: completed request traces kept per process — small: the ring answers
+#: "which recent request stalled where", not long-term storage
+DEFAULT_MAX_RECORDS = 256
+
+
+class ServeTraceStore:
+    """Bounded ring of recent serve ``TraceRecord``s keyed by request id
+    (insertion-ordered; adding past ``max_records`` evicts the oldest and
+    increments ``evicted`` — the ring-level analogue of a trace's dropped
+    counter)."""
+
+    def __init__(self, max_records: int = DEFAULT_MAX_RECORDS):
+        self.max_records = max(1, int(max_records))
+        self.evicted = 0
+        self._lock = threading.Lock()
+        self._records: OrderedDict[str, TraceRecord] = OrderedDict()
+
+    def add(self, record: TraceRecord) -> None:
+        with self._lock:
+            self._records.pop(record.name, None)
+            self._records[record.name] = record
+            while len(self._records) > self.max_records:
+                self._records.popitem(last=False)
+                self.evicted += 1
+
+    def get(self, request_id: str) -> TraceRecord | None:
+        with self._lock:
+            return self._records.get(request_id)
+
+    def records(self) -> list[TraceRecord]:
+        """Newest last (insertion order), a snapshot."""
+        with self._lock:
+            return list(self._records.values())
+
+    def slowest(self, n: int) -> list[TraceRecord]:
+        """The ``n`` records with the longest root-span duration."""
+        return sorted(self.records(), key=_root_duration, reverse=True)[:n]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.evicted = 0
+
+
+def _root_duration(rec: TraceRecord) -> float:
+    for s in rec.spans:
+        if not s.get("parent_id"):
+            return float(s.get("duration_s") or 0.0)
+    return 0.0
+
+
+#: the per-process ring the API handlers and ``ko trace --serve`` read;
+#: the serve job's batcher writes here via the default ``ServeTracer``
+SERVE_TRACES = ServeTraceStore()
+
+
+class RequestTrace:
+    """One in-flight request's span tree — the handle the batcher stashes
+    on its ``_Pending`` record. Only the batcher's worker thread calls the
+    mutating methods after ``ServeTracer.begin`` (same single-writer
+    contract as the slot tracker), so no lock beyond the ``Trace``'s own
+    span-list lock is needed."""
+
+    def __init__(self, request_id: str, store: ServeTraceStore,
+                 max_spans: int, prompt_len: int, max_tokens: int):
+        self.store = store
+        self.trace = Trace(request_id, max_spans=max_spans)
+        self.root = Span("request", "serve", self.trace, attributes={
+            "prompt_len": prompt_len, "max_tokens": max_tokens})
+        self.queue_span: Span | None = Span(
+            "enqueue", "serve", self.trace, parent_id=self.root.span_id)
+        # recorded up-front (records hold live Span objects; durations land
+        # via finish() before serialization) so cap overflow can only drop
+        # later segment/retire spans, never the request root
+        self.trace.record(self.root)
+        self.trace.record(self.queue_span)
+        self.segments = 0
+
+    # -- span helpers --------------------------------------------------------
+    def _post_span(self, name: str, parent_id: str, dur_s: float,
+                   attrs: dict) -> Span:
+        """A span whose work already happened: shift its start back by the
+        measured duration so the sorted timeline reads correctly."""
+        sp = Span(name, "serve", self.trace, parent_id=parent_id,
+                  attributes=attrs)
+        sp.start_offset_s = round(sp._t0 - dur_s - self.trace.t0, 6)
+        sp.duration_s = round(dur_s, 6)
+        self.trace.record(sp)
+        return sp
+
+    # -- batcher edges -------------------------------------------------------
+    def admitted(self, *, slot: int, shard: int, wave_s: float,
+                 plan: dict | None) -> None:
+        if self.queue_span is not None:
+            self.queue_span.finish()
+            self.queue_span = None
+        attrs: dict[str, Any] = {"slot": slot, "shard": shard}
+        prefilled = True
+        if plan:
+            attrs.update(
+                pages=plan.get("pages"), bucket=plan.get("bucket"),
+                hit_kind=plan.get("hit_kind"), pos0=plan.get("pos0"),
+                pages_reused=plan.get("pages_reused"),
+                hit_len=plan.get("hit_len"))
+            # full/cover hits restart from cached pages — no prefill pass
+            prefilled = plan.get("hit_kind") in (None, "miss", "partial")
+        admit = self._post_span("admit", self.root.span_id, wave_s, attrs)
+        if prefilled:
+            chunk = {"start": 0, "stop": attrs.get("bucket")}
+            if plan:
+                chunk = {"start": plan.get("hit_len", 0),
+                         "stop": plan.get("bucket")}
+            self._post_span("prefill", admit.span_id, wave_s, chunk)
+
+    def segment(self, seg_s: float, *, pos: int, k: int, shard: int) -> None:
+        self.segments += 1
+        self._post_span("segment", self.root.span_id, seg_s, {
+            "index": self.segments, "pos": pos, "k": k, "shard": shard})
+
+    def compile_event(self, n: int) -> None:
+        self.root.add_event("compile", n=n)
+
+    def ttft(self, seconds: float) -> None:
+        self.root.attributes["ttft_s"] = round(seconds, 6)
+
+    def retire(self, *, blocked_s: float, device_s: float | None,
+               shard: int, tokens: int) -> None:
+        attrs: dict[str, Any] = {"shard": shard, "tokens": tokens,
+                                 "host_blocked_s": round(blocked_s, 6)}
+        if device_s is not None:
+            attrs["device_s"] = round(device_s, 6)
+        self._post_span("retire", self.root.span_id, blocked_s, attrs)
+        self._finish()
+
+    def fail(self, err: Exception) -> None:
+        self.root.status = "error"
+        self.root.attributes["error"] = f"{type(err).__name__}: {err}"
+        self._finish()
+
+    def _finish(self) -> None:
+        if self.queue_span is not None:      # failed before admission
+            self.queue_span.finish()
+            self.queue_span = None
+        self.root.finish()
+        self.store.add(TraceRecord(
+            name=self.trace.trace_id, operation="serve",
+            spans=self.trace.to_dicts(), dropped=self.trace.dropped))
+
+
+class ServeTracer:
+    """Factory the batcher holds: ``begin(req)`` opens a ``RequestTrace``
+    into ``store``. ``max_spans`` reuses the execution tracer's cap (the
+    config key ``trace_max_spans``) so one knob bounds both trees."""
+
+    def __init__(self, store: ServeTraceStore | None = None,
+                 max_spans: int = DEFAULT_MAX_SPANS):
+        self.store = store if store is not None else SERVE_TRACES
+        self.max_spans = max_spans
+
+    def begin(self, request_id: str, *, prompt_len: int,
+              max_tokens: int) -> RequestTrace:
+        return RequestTrace(request_id, self.store, self.max_spans,
+                            prompt_len, max_tokens)
+
+
+def render_record(rec: TraceRecord) -> dict:
+    """The wire/JSON shape shared by the API endpoint and ``ko trace
+    --serve --json`` (schema v1 — the span dicts are ``Span.to_dict``)."""
+    root = next((s for s in rec.spans if not s.get("parent_id")), None)
+    return {
+        "version": 1,
+        "request": rec.name,
+        "operation": rec.operation,
+        "duration_s": float(root.get("duration_s", 0.0)) if root else 0.0,
+        "spans": rec.spans,
+        "dropped": rec.dropped,
+    }

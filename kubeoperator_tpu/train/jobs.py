@@ -363,7 +363,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                     mesh_spec=mesh_spec)
         except ValueError as e:
             raise SystemExit(f"serve: {e}") from e
-        batcher = ContinuousBatcher(engine, stats=stats)
+        # round 9: per-request span trees into the in-process ring —
+        # `ko trace --serve` / /api/v1/serve/requests/{id}/trace read it
+        from kubeoperator_tpu.config.loader import load_config
+        from kubeoperator_tpu.telemetry.serve_trace import ServeTracer
+
+        tracer = ServeTracer(
+            max_spans=int(load_config().get("trace_max_spans", 4000)))
+        batcher = ContinuousBatcher(engine, stats=stats, tracer=tracer)
         # ONE compile to warm: every request shape shares the same segment
         # dispatch (per-slot vectors, not bucketed dims), and prefill runs
         # eager — so a single empty-pool segment is full warm-up. --warm

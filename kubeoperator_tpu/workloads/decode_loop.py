@@ -324,6 +324,7 @@ class SlotPoolEngine:
         self.prefix_hits = 0          # admissions that reused cached pages
         self.prefix_pages_reused = 0  # pages whose prefill was skipped
         self.cow_copies = 0           # copy-on-write page duplications
+        self.last_plans: dict[int, dict] = {}   # last wave's admission plans
 
         self._emb = self._params["embedding"]
         self._layers = [jax.tree.map(lambda x: x[l], self._params["layers"])
@@ -574,6 +575,18 @@ class SlotPoolEngine:
         write in this wave can touch a recycled page, so even a source
         freed by LRU eviction mid-wave is copied intact."""
         plans, cow_pairs = self._plan_entries(entries)
+        # host-side admission summary for the serve tracer: serving.py
+        # reads this right after admit() returns (overwritten per wave),
+        # so trace attrs never need a device fetch
+        self.last_plans = {
+            pl["slot"]: {
+                "shard": pl["shard"], "pages": len(pl["pages"]),
+                "bucket": pl["c"], "hit_len": pl["h"], "pos0": pl["pos0"],
+                "pages_reused": pl["h"] // self.page,
+                "hit_kind": ("full" if pl["h"] == pl["plen"]
+                             else "cover" if pl["h"] >= pl["c"]
+                             else "partial" if pl["h"] else "miss"),
+            } for pl in plans}
         self._apply_cow(cow_pairs)
         groups: dict[tuple[int, int], list[dict]] = {}
         nopass: list[dict] = []

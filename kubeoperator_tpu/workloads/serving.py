@@ -41,6 +41,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.utils.ids import short_id
 
 
 def _pow2_at_least(n: int, floor: int = 1) -> int:
@@ -87,6 +88,11 @@ class _Pending:
     result: list[int] | None = None
     error: Exception | None = None
     submitted_at: float = field(default_factory=time.monotonic)
+    # request identity for serve traces (``ko trace --serve <id>``); the
+    # trace handle is a telemetry.serve_trace.RequestTrace when the
+    # batcher was built with a tracer, else None (tracing off)
+    id: str = field(default_factory=lambda: short_id(12))
+    trace: Any = None
 
 
 class BatcherStats:
@@ -143,6 +149,17 @@ class BatcherStats:
 
     def segment(self, seconds: float) -> None:
         self._m["segment"].observe(seconds)
+
+    def segment_device(self, seconds: float) -> None:
+        """Device share of a segment: dispatch to the ready signal the
+        retirement fetch observed (no extra sync — the fetch happens
+        anyway)."""
+        self._m["segment_device"].observe(seconds)
+
+    def host_blocked(self, seconds: float, shard: int | str = 0) -> None:
+        """Host-blocked share of retirement: the worker's wait inside the
+        batched result fetch, attributed to each dp shard retiring rows."""
+        self._m["host_blocked"].observe(seconds, shard=str(shard))
 
     def pages_used(self, pages: int, shard: int | str = 0) -> None:
         """Allocated KV pages (live slots + prefix cache) on one dp mesh
@@ -342,11 +359,25 @@ class ContinuousBatcher:
     fit blocks the line (no starvation), and retirement ``release``s its
     slots' pages back before new admissions. A dense engine without these
     methods gets the old slot-count admission unchanged.
+
+    Request tracing (round 9): pass a ``telemetry.serve_trace.ServeTracer``
+    and every request gets a span tree (enqueue → admit → prefill →
+    segments → retire) annotated purely from host-side values the worker
+    already holds — admission plans (``engine.last_plans``), segment wall
+    times, the retirement fetch. No tracer (the default) means no ids
+    resolve to trace handles and every hook is a single ``is None`` test:
+    zero device work either way, near-zero host work when off.
     """
 
-    def __init__(self, engine: Any, *, stats: BatcherStats | None = None):
+    def __init__(self, engine: Any, *, stats: BatcherStats | None = None,
+                 tracer: Any = None):
         self.engine = engine
         self.stats = stats if stats is not None else BatcherStats()
+        self._tracer = tracer
+        # dispatch→ready attribution: when the retirement fetch returns,
+        # the segment dispatched at _dispatch_t0 is known device-complete
+        self._dispatch_t0: float | None = None
+        self._compiles_seen = 0
         self._cond = threading.Condition()
         self._queue: deque[_Pending] = deque()
         self._track: dict[int, dict] = {}       # slot -> in-flight state
@@ -389,6 +420,10 @@ class ContinuousBatcher:
             req.result = list(req.prompt_ids)
             self.stats.finished(req, ok=True)
             return req.result
+        if self._tracer is not None:
+            req.trace = self._tracer.begin(
+                req.id, prompt_len=len(req.prompt_ids),
+                max_tokens=req.max_tokens)
         with self._cond:
             self._queue.append(req)
             self._cond.notify()
@@ -464,20 +499,50 @@ class ContinuousBatcher:
             except Exception as e:  # noqa: BLE001 — engine boundary
                 self._fail_all(admit_now, e)
 
+    def _note_compiles(self) -> None:
+        """Compile events for in-flight traces — meaningful only when a
+        ``compile_count_guard`` was active while the engine built its
+        segment fn (tier-1 and the bench wrap it); otherwise a getattr."""
+        guard = getattr(getattr(self.engine, "_seg_fn", None),
+                        "_ko_compile_guard", None)
+        if guard is None:
+            return
+        n = guard.total()
+        if n > self._compiles_seen:
+            delta = n - self._compiles_seen
+            # ko: lint-ok[KO201] single-writer: only the worker thread reads the guard
+            self._compiles_seen = n
+            for t in self._track.values():
+                if t["req"].trace is not None:
+                    t["req"].trace.compile_event(delta)
+
     def _step(self, admit_now: list[tuple[int, _Pending]]) -> None:
         now = time.monotonic
         if admit_now:
+            t_admit = now()
             pos_map = self.engine.admit(
                 [(slot, r.prompt_ids, r.max_tokens, r.temperature, r.seed)
                  for slot, r in admit_now])
+            admit_s = now() - t_admit
+            # per-slot admission plans the paged engine already built on
+            # the host (shard, pages, prefix hit_kind) — trace annotation
+            # costs a dict lookup, never a device read
+            plans = getattr(self.engine, "last_plans", None) or {}
             for slot, r in admit_now:
                 plen = len(r.prompt_ids)
                 t = {"req": r, "plen": plen, "pos": pos_map[slot],
                      "last": plen + r.max_tokens - 1, "ttft": False}
+                if r.trace is not None:
+                    r.trace.admitted(slot=slot,
+                                     shard=slot // self._shard_slots,
+                                     wave_s=admit_s, plan=plans.get(slot))
                 if t["pos"] >= plen:
                     # pow2-length prompt: its first token was born in the
                     # admission prefill itself
-                    self.stats.ttft(now() - r.submitted_at)
+                    ttft_s = now() - r.submitted_at
+                    self.stats.ttft(ttft_s)
+                    if r.trace is not None:
+                        r.trace.ttft(ttft_s)
                     t["ttft"] = True
                 # ko: lint-ok[KO201] single-writer: only the worker thread mutates _track
                 self._track[slot] = t
@@ -488,24 +553,55 @@ class ContinuousBatcher:
         if active:
             t0 = now()
             self.engine.run_segment()
-            self.stats.segment(now() - t0)
+            seg_s = now() - t0
+            self.stats.segment(seg_s)
             self.stats.executed(len(active))
+            # ko: lint-ok[KO201] single-writer: only the worker thread times dispatches
+            self._dispatch_t0 = t0
+            if self._tracer is not None:
+                self._note_compiles()
             k = self.engine.segment
             for s in active:
                 t = self._track[s]
-                t["pos"] = min(t["pos"] + k, t["last"])
+                r = t["req"]
+                prev = t["pos"]
+                t["pos"] = min(prev + k, t["last"])
                 if not t["ttft"] and t["pos"] >= t["plen"]:
-                    self.stats.ttft(now() - t["req"].submitted_at)
+                    ttft_s = now() - r.submitted_at
+                    self.stats.ttft(ttft_s)
+                    if r.trace is not None:
+                        r.trace.ttft(ttft_s)
                     t["ttft"] = True
+                if r.trace is not None:
+                    r.trace.segment(seg_s, pos=prev, k=t["pos"] - prev,
+                                    shard=s // self._shard_slots)
 
         done = [s for s, t in self._track.items() if t["pos"] >= t["last"]]
         if done:
+            t0 = now()
             buf, _ = self.engine.poll()         # ONE batched fetch
+            poll_end = now()
+            blocked_s = poll_end - t0
+            # the fetch forces the last dispatch to device-complete, so
+            # dispatch→fetch-return bounds its device time — attribution
+            # from a sync the retirement was doing anyway
+            device_s = (None if self._dispatch_t0 is None
+                        else poll_end - self._dispatch_t0)
+            if device_s is not None:
+                self.stats.segment_device(device_s)
+            # ko: lint-ok[KO201] single-writer: only the worker thread times dispatches
+            self._dispatch_t0 = None
+            for shard in {s // self._shard_slots for s in done}:
+                self.stats.host_blocked(blocked_s, shard=shard)
             for s in done:
                 t = self._track.pop(s)
                 r = t["req"]
                 r.result = [int(x)
                             for x in buf[s][:t["plen"] + r.max_tokens]]
+                if r.trace is not None:
+                    r.trace.retire(blocked_s=blocked_s, device_s=device_s,
+                                   shard=s // self._shard_slots,
+                                   tokens=r.max_tokens)
                 self.stats.finished(r, ok=True)
                 r.done.set()
             if self._paged:
@@ -538,6 +634,8 @@ class ContinuousBatcher:
         for r in victims:
             if not r.done.is_set():
                 r.error = err
+                if r.trace is not None:
+                    r.trace.fail(err)
                 self.stats.finished(r, ok=False)
                 r.done.set()
         self._report_occupancy()

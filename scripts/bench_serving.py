@@ -407,6 +407,41 @@ def bench_paged(requests: int, dense_slots: int, segment: int, page: int,
     }
 
 
+def bench_tracing_overhead(requests: int, slots: int, segment: int,
+                           step_s: float, dispatch_s: float,
+                           prefill_s: float, stagger_s: float,
+                           max_total: int = 2048) -> dict:
+    """Round 9: the serve tracer's cost, measured as an A/B on the SAME
+    continuous cost model and trace — tracing off, then on with every
+    request traced into a private ring. The tier-1 guard pins aggregate
+    new-tok/s overhead at ≤5%: span bookkeeping is pure host-side dict
+    and list work between injected sleeps, so a bigger gap means someone
+    put real work (or a device sync) on the traced path."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        ServeTracer, ServeTraceStore,
+    )
+
+    trace = make_trace(requests)
+
+    def engine():
+        return FakeSlotEngine(slots=slots, segment=segment,
+                              max_total=max_total, step_s=step_s,
+                              dispatch_s=dispatch_s, prefill_s=prefill_s)
+
+    off = run_load(ContinuousBatcher(engine()), trace, stagger_s)
+    store = ServeTraceStore(max_records=requests)
+    on = run_load(ContinuousBatcher(engine(), tracer=ServeTracer(store)),
+                  trace, stagger_s)
+    overhead = (off["tok_s"] - on["tok_s"]) / off["tok_s"]
+    return {
+        "requests": requests,
+        "tok_s_off": round(off["tok_s"], 1),
+        "tok_s_on": round(on["tok_s"], 1),
+        "overhead_pct": round(100 * overhead, 2),
+        "traced": len(store.records()),
+    }
+
+
 # 1 → 2 → 4 → 8 devices: dp first (slot capacity is what the r5 trace is
 # starved of at 16 slots), then fold in tp once the pool covers the trace
 SCALING_SHAPES = ((1, 1), (2, 1), (2, 2), (4, 2))
@@ -527,9 +562,17 @@ def main() -> None:
                     help="scaling mode: also run the real sharded engine "
                          "on available JAX devices (gated: shapes that "
                          "don't fit are marked skipped)")
+    ap.add_argument("--tracing-overhead", action="store_true",
+                    help="A/B the continuous engine with the serve tracer "
+                         "off vs on (round 9: must stay under 5%% tok/s)")
     ap.add_argument("--out", type=str, default=None,
                     help="also write a MULTICHIP-style JSON artifact here")
     args = ap.parse_args()
+    if args.tracing_overhead:
+        print(json.dumps(bench_tracing_overhead(
+            args.requests, args.slots, args.segment, args.step,
+            args.dispatch, args.prefill, args.stagger)))
+        return
     if args.paged:
         result = bench_paged(args.requests, args.dense_slots, args.segment,
                              args.page, args.step, args.dispatch,

@@ -1,3 +1,4 @@
 """Corpus: undeclared ko_* metric name (KO210)."""
 
 REQUESTS = "ko_serve_requestz_total"     # KO210: typo, not in the registry
+BURN = "ko_slo_burnz_rate"               # KO210: _rate family, unregistered

@@ -202,3 +202,97 @@ def test_host_health_detects_clock_drift(platform, installed, fake_executor):
     assert recs["demo-worker-1"].detail["clock_drift_s"] > 250
     # hosts whose probe returns no timestamp (fake default) stay healthy
     assert recs["demo-master-1"].healthy is True
+
+
+# ---------------------------------------------------------------------------
+# round 9: None sentinels for absent serve series + the SLO beat
+# ---------------------------------------------------------------------------
+
+class NoServeTransport(FakeTransport):
+    """Prometheus answers every instant query with an empty result set —
+    the shape a cluster without a jax-serve deployment produces."""
+
+    def __call__(self, method, url, headers, timeout):
+        if "/api/v1/query" in url and "/loki/" not in url:
+            self.calls.append(url)
+            return 200, json.dumps({"data": {"result": []}})
+        return super().__call__(method, url, headers, timeout)
+
+
+def test_snapshot_serve_series_none_not_sentinel(platform, installed):
+    """Unanswerable serve series surface as None in the JSON snapshot
+    (the old -1.0 sentinel survives only as a PromClient.scalar default,
+    still used by tpu_utilization)."""
+    mon.monitor_tick(platform, transport=NoServeTransport())
+    data = platform.store.find(mon.MonitorSnapshot, scoped=False,
+                               name="demo")[0].data
+    for key in ("serve_queue_depth", "serve_latency_p95",
+                "serve_tokens_rate", "serve_slot_occupancy",
+                "serve_ttft_p95", "serve_kv_pages_used",
+                "serve_prefix_hit_rate"):
+        assert data[key] is None, key
+    assert data["tpu_utilization"] == -1.0
+    assert data["serve_slot_shards"] == {}
+    assert data["cpu_usage"] == 0.0          # non-serve scalars keep defaults
+    # JSON round-trips as null, not a fake measurement
+    assert json.loads(json.dumps(data))["serve_ttft_p95"] is None
+    # and the SLO engine treats the gap as no_data, not a breach
+    assert data["slo"]["slos"] == {} and data["slo"]["events"] == []
+
+
+class ServeValueTransport(FakeTransport):
+    """FakeTransport with a settable answer for the serve TTFT quantile
+    query (seconds), so a test can walk an SLO through breach→recover."""
+
+    def __init__(self, ttft_s=0.1):
+        super().__init__()
+        self.ttft_s = ttft_s
+
+    def __call__(self, method, url, headers, timeout):
+        if "histogram_quantile" in url:
+            self.calls.append(url)
+            return 200, json.dumps({"data": {"result": [
+                {"value": [0, str(self.ttft_s)]}]}})
+        return super().__call__(method, url, headers, timeout)
+
+
+def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
+    """A configured ttft_p95_ms SLO rides the monitor beat: a slow tick
+    flips it to breach (event + burn gauges), fast ticks age the breach
+    out of the window and the recovery edge lands in snapshot()["slo"]."""
+    from kubeoperator_tpu.telemetry import metrics as tm
+
+    platform.config["serve_slos"] = {"ttft_p95_ms": 500}
+    platform.config["slo_fast_window"] = 2
+    platform.config["slo_slow_window"] = 4
+    t = ServeValueTransport(ttft_s=4.5)      # 4500ms >> 500ms target
+    mon.monitor_tick(platform, transport=t)
+
+    def slo_block():
+        return platform.store.find(mon.MonitorSnapshot, scoped=False,
+                                   name="demo")[0].data["slo"]
+
+    block = slo_block()
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "breach" and s["value"] == 4500.0
+    assert s["met"] is False and s["burn_rate"]["fast"] >= 1.0
+    # first-ever point: the edge comes from no_data, still worth an event
+    assert [(e["from"], e["to"])
+            for e in block["events"]] == [("no_data", "breach")]
+    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast") >= 1.0
+
+    t.ttft_s = 0.1                            # recovered: 100ms
+    mon.monitor_tick(platform, transport=t)
+    assert slo_block()["slos"]["ttft_p95_ms"]["state"] == "breach"  # in window
+    mon.monitor_tick(platform, transport=t)
+    block = slo_block()
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "ok" and s["met"] is True
+    assert [(e["from"], e["to"]) for e in block["events"]] == [("breach", "ok")]
+    assert s["burn_rate"]["fast"] == 0.0
+    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast") == 0.0
+    assert tm.SLO_TARGET_RATIO.value(slo="ttft_p95_ms") == s["attainment"]
+    # history carried the whole walk for the dashboard charts
+    hist = platform.store.find(mon.MonitorSnapshot, scoped=False,
+                               name="demo:history")[0]
+    assert [p["serve_ttft_p95"] for p in hist.data["points"]] == [4.5, 0.1, 0.1]

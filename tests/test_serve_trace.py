@@ -1,0 +1,412 @@
+"""Serve observability (round 9): per-request span trees from the
+continuous batcher — ring-buffer semantics, prefix-hit vs miss trace
+shape, the 2-shard end-to-end acceptance (complete trees + bit-exact
+tokens + compile events), the serve-trace API routes, `ko trace --serve`
+/ `--json` CLI goldens, the SLO burn-rate engine, and the ≤5% tracing
+overhead guard on the cost-model bench."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu import ctl
+from kubeoperator_tpu.analysis import compile_count_guard
+from kubeoperator_tpu.api.app import ensure_admin
+from kubeoperator_tpu.services.monitor import evaluate_slos
+from kubeoperator_tpu.telemetry.serve_trace import (
+    SERVE_TRACES, RequestTrace, ServeTracer, ServeTraceStore, render_record,
+)
+from kubeoperator_tpu.telemetry.tracing import TraceRecord, format_trace
+from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+from kubeoperator_tpu.workloads.generate import generate
+from kubeoperator_tpu.workloads.serving import ContinuousBatcher
+from kubeoperator_tpu.workloads.sharding import MeshSpec
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig,
+)
+from tests.test_api import login, run_api
+from tests.test_ctl import run_with_server
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq_len=24, dtype=jnp.float32,
+                        remat=False, attention="dense")
+
+# 16 tokens = exactly 2 pages at the page size this config resolves to
+PRE = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Transformer(CFG)
+    return nn.unbox(model.init(jax.random.key(7),
+                               jnp.zeros((2, 8), jnp.int32))["params"])
+
+
+def solo(params, prompt, max_tokens):
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), max_tokens,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.fixture
+def clean_ring():
+    SERVE_TRACES.clear()
+    yield SERVE_TRACES
+    SERVE_TRACES.clear()
+
+
+def fake_record(rid: str, duration: float) -> TraceRecord:
+    root = {"name": "request", "kind": "serve", "span_id": "r" + rid,
+            "parent_id": "", "start_offset_s": 0.0, "duration_s": duration,
+            "status": "ok", "attributes": {}, "events": []}
+    child = {"name": "retire", "kind": "serve", "span_id": "c" + rid,
+             "parent_id": "r" + rid, "start_offset_s": duration / 2,
+             "duration_s": duration / 2, "status": "ok", "attributes": {},
+             "events": []}
+    return TraceRecord(name=rid, operation="serve", spans=[root, child])
+
+
+def spans_by_name(rec: TraceRecord) -> dict:
+    out = {}
+    for s in rec.spans:
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ring buffer + span cap
+# ---------------------------------------------------------------------------
+
+def test_store_ring_evicts_oldest():
+    store = ServeTraceStore(max_records=3)
+    for i in range(4):
+        store.add(fake_record(f"req{i}", 0.1 * (i + 1)))
+    assert store.evicted == 1
+    assert store.get("req0") is None                 # oldest gone
+    assert [r.name for r in store.records()] == ["req1", "req2", "req3"]
+    # re-adding an existing id refreshes, never evicts
+    store.add(fake_record("req2", 9.0))
+    assert store.evicted == 1
+    assert len(store.records()) == 3
+    store.clear()
+    assert store.records() == [] and store.evicted == 0
+
+
+def test_store_slowest_orders_by_root_duration():
+    store = ServeTraceStore()
+    for rid, dur in (("a", 0.2), ("b", 0.9), ("c", 0.5)):
+        store.add(fake_record(rid, dur))
+    assert [r.name for r in store.slowest(2)] == ["b", "c"]
+
+
+def test_span_cap_drops_tail_never_the_root():
+    """Past trace_max_spans the dropped counter ticks and trailing
+    segment/retire spans are lost — the request root (recorded first)
+    always survives, so duration and rendering stay meaningful."""
+    store = ServeTraceStore()
+    rt = RequestTrace("rq", store, max_spans=4, prompt_len=5, max_tokens=99)
+    rt.admitted(slot=0, shard=0, wave_s=0.01, plan=None)   # admit + prefill
+    for _ in range(3):                      # root/enqueue/admit/prefill = cap
+        rt.segment(0.001, pos=4, k=1, shard=0)
+    rt.retire(blocked_s=0.002, device_s=0.003, shard=0, tokens=99)
+    rec = store.get("rq")
+    assert rec is not None and rec.dropped == 4
+    names = spans_by_name(rec)
+    assert "request" in names and "enqueue" in names and "admit" in names
+    root = names["request"][0]
+    assert not root["parent_id"] and root["duration_s"] > 0
+    assert render_record(rec)["duration_s"] == root["duration_s"]
+    assert "request" in format_trace(rec.spans)
+
+
+# ---------------------------------------------------------------------------
+# trace shape: prefix-cache full hit skips prefill; miss records it
+# ---------------------------------------------------------------------------
+
+def test_full_hit_trace_skips_prefill_span(params):
+    store = ServeTraceStore()
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    cb = ContinuousBatcher(eng, tracer=ServeTracer(store))
+    out1 = cb.submit(PRE, 4)
+    out2 = cb.submit(PRE, 4)               # full-prompt hit -> CoW re-decode
+    assert out1 == out2 == solo(params, PRE, 4)
+    miss, hit = store.records()
+    m, h = spans_by_name(miss), spans_by_name(hit)
+    assert m["admit"][0]["attributes"]["hit_kind"] == "miss"
+    assert "prefill" in m                              # cold pool prefills
+    assert m["prefill"][0]["parent_id"] == m["admit"][0]["span_id"]
+    assert m["prefill"][0]["attributes"] == {"start": 0, "stop": 16}
+    a = h["admit"][0]["attributes"]
+    assert a["hit_kind"] == "full" and a["pages_reused"] == 2
+    assert "prefill" not in h                          # cached pages cover it
+    assert {"enqueue", "segment", "retire"} <= set(h)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2-shard paged engine, complete trees, bit-exact, compiles
+# ---------------------------------------------------------------------------
+
+needs_8dev = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs the 8 forced host devices")
+
+
+@needs_8dev
+def test_trace_tree_complete_on_2shard_mesh(params):
+    """Every retired request carries a complete span tree (enqueue →
+    admit → prefill/segments → retire) with shard/page/prefix attrs and
+    segment-time attribution, tokens stay bit-identical to solo
+    generate() with tracing on, and the engine still compiles its
+    segment fn exactly once — surfaced as a compile event."""
+    store = ServeTraceStore()
+    with compile_count_guard() as guard:
+        eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                             mesh_spec=MeshSpec(dp=2, tp=4))
+        cb = ContinuousBatcher(eng, tracer=ServeTracer(store))
+        reqs = [([1, 2, 3, 4, 5], 6), ([7, 8, 9], 4),
+                ([3, 1, 4, 1, 5, 9, 2], 8), ([2, 2, 2], 5)]
+        results = {}
+
+        def client(i, prompt, mt):
+            time.sleep(0.01 * i)
+            results[i] = cb.submit(prompt, mt, timeout=120.0)
+
+        threads = [threading.Thread(target=client, args=(i, *r))
+                   for i, r in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert guard.traces_for("_segment_body") == [1]    # tracing adds no jit
+    for i, (prompt, mt) in enumerate(reqs):
+        assert results[i] == solo(params, prompt, mt), f"request {i}"
+
+    recs = store.records()
+    assert len(recs) == 4 and store.evicted == 0
+    shards_seen = set()
+    for rec in recs:
+        assert rec.operation == "serve" and rec.dropped == 0
+        names = spans_by_name(rec)
+        assert {"request", "enqueue", "admit", "prefill",
+                "segment", "retire"} <= set(names)
+        root = names["request"][0]
+        assert not root["parent_id"]
+        assert root["duration_s"] > 0
+        assert root["attributes"]["ttft_s"] > 0
+        # the warm-up compile landed on whichever requests were in flight
+        for name in ("enqueue", "admit", "segment", "retire"):
+            for s in names[name]:
+                assert s["parent_id"] == root["span_id"], name
+        a = names["admit"][0]["attributes"]
+        assert a["shard"] == a["slot"] // 2              # 4 slots over dp=2
+        assert a["pages"] >= 1 and a["hit_kind"] == "miss"
+        shards_seen.add(a["shard"])
+        r = names["retire"][0]["attributes"]
+        assert r["host_blocked_s"] >= 0 and r["tokens"] > 0
+        assert "device_s" in r
+    assert shards_seen == {0, 1}                         # both dp shards used
+    assert any(ev["name"] == "compile"
+               for rec in recs
+               for s in rec.spans for ev in s["events"])
+    # segment-time attribution reached the prometheus families too
+    text = cb.stats.prometheus()
+    assert "ko_serve_segment_device_seconds_count" in text
+    assert 'ko_serve_host_blocked_seconds_count{shard="' in text
+
+
+# ---------------------------------------------------------------------------
+# API routes
+# ---------------------------------------------------------------------------
+
+def test_serve_trace_api_routes(platform, clean_ring):
+    ensure_admin(platform)
+    clean_ring.add(fake_record("abc123", 0.4))
+    clean_ring.add(fake_record("def456", 0.8))
+
+    async def scenario(client):
+        r = await client.get("/api/v1/serve/requests/abc123/trace")
+        assert r.status == 401                         # /api is protected
+        hdrs = await login(client)
+        r = await client.get("/api/v1/serve/requests/abc123/trace",
+                             headers=hdrs)
+        assert r.status == 200
+        d = await r.json()
+        assert d["version"] == 1 and d["request"] == "abc123"
+        assert d["duration_s"] == 0.4 and len(d["spans"]) == 2
+        r = await client.get("/api/v1/serve/requests/nope/trace",
+                             headers=hdrs)
+        assert r.status == 404
+        r = await client.get("/api/v1/serve/requests/traces", headers=hdrs)
+        assert r.status == 200
+        d = await r.json()
+        assert [t["request"] for t in d["traces"]] == ["def456", "abc123"]
+        assert d["evicted"] == 0
+        r = await client.get("/api/v1/serve/requests/traces?slowest=1",
+                             headers=hdrs)
+        assert [t["request"] for t in (await r.json())["traces"]] == ["def456"]
+        r = await client.get("/api/v1/serve/requests/traces?slowest=x",
+                             headers=hdrs)
+        assert r.status == 400
+        return True
+
+    assert run_api(platform, scenario)
+
+
+# ---------------------------------------------------------------------------
+# ko trace --serve / --json CLI
+# ---------------------------------------------------------------------------
+
+def test_ko_trace_serve_cli_and_json_golden(platform, clean_ring, tmp_path,
+                                            monkeypatch, capsys):
+    ensure_admin(platform)
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+    clean_ring.add(fake_record("abc123", 0.4))
+    clean_ring.add(fake_record("def456", 0.8))
+
+    def drive(url):
+        assert ctl.main(["login", url, "admin",
+                         "--password", "KubeOperator@tpu1"]) == 0
+        assert ctl.main(["trace", "--serve"]) == 0
+        assert ctl.main(["trace", "--serve", "--slowest", "1"]) == 0
+        assert ctl.main(["trace", "--serve", "abc123"]) == 0
+        assert ctl.main(["trace", "--serve", "--json"]) == 0
+        assert ctl.main(["trace", "--serve", "abc123", "--json"]) == 0
+        assert ctl.main(["trace"]) == 2        # execution mode needs an id
+        return True
+
+    assert run_with_server(platform, drive)
+    out = capsys.readouterr().out
+    assert "request def456 — 2 spans, 800.0ms" in out
+    assert "request abc123 — 2 spans, 400.0ms" in out
+    assert "\n  retire  " in out                       # indented child span
+    # --json emits the schema-v1 dict shared with the API handler
+    payload = json.loads(out[out.index('{\n  "traces"'):
+                             out.index('{\n  "version"')])
+    assert payload["evicted"] == 0 and len(payload["traces"]) == 2
+    single = json.loads(out[out.index('{\n  "version"'):])
+    assert single == render_record(clean_ring.get("abc123"))
+
+
+def test_ko_trace_execution_json_golden(platform, manual_cluster, tmp_path,
+                                        monkeypatch, capsys):
+    from kubeoperator_tpu.resources.entities import ExecutionState
+
+    ex = platform.run_operation("demo", "install")
+    assert ex.state == ExecutionState.SUCCESS
+    ensure_admin(platform)
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+
+    def drive(url):
+        assert ctl.main(["login", url, "admin",
+                         "--password", "KubeOperator@tpu1"]) == 0
+        assert ctl.main(["trace", ex.id, "--json"]) == 0
+        return True
+
+    assert run_with_server(platform, drive)
+    out = capsys.readouterr().out
+    d = json.loads(out[out.index('{\n  "version"'):])
+    assert d["version"] == 1 and d["execution"] == ex.id
+    assert d["operation"] == "install" and d["spans"]
+    assert {"name", "kind", "span_id", "parent_id", "start_offset_s",
+            "duration_s", "status", "attributes",
+            "events"} <= set(d["spans"][0])
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: synthetic breach-then-recover window
+# ---------------------------------------------------------------------------
+
+def _pts(ttfts):
+    return [{"time": f"t{i}", "serve_ttft_p95": v}
+            for i, v in enumerate(ttfts)]
+
+
+def test_slo_burn_breach_then_recover():
+    spec = {"ttft_p95_ms": 500}
+    kw = dict(fast_window=3, slow_window=6)
+    good, bad = 0.1, 0.9                    # seconds -> 100ms / 900ms
+
+    out = evaluate_slos(spec, _pts([good, good, good]), **kw)
+    s = out["slos"]["ttft_p95_ms"]
+    assert s["state"] == "ok" and s["met"] is True and out["events"] == []
+    assert s["burn_rate"]["fast"] == 0.0 and s["attainment"] == 1.0
+    assert s["value"] == pytest.approx(100.0)
+
+    # one bad point breaches the fast window and emits the ok->breach edge
+    out = evaluate_slos(spec, _pts([good, good, good, bad]), **kw)
+    s = out["slos"]["ttft_p95_ms"]
+    assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
+    assert s["burn_rate"]["slow"] < s["burn_rate"]["fast"]
+    assert out["events"] == [{
+        "slo": "ttft_p95_ms", "from": "ok", "to": "breach",
+        "burn_fast": s["burn_rate"]["fast"], "value": pytest.approx(900.0),
+        "target": 500.0, "time": "t3"}]
+
+    # still breaching while the bad point sits in the window: no new edge
+    out = evaluate_slos(spec, _pts([good, good, good, bad, good]), **kw)
+    assert out["slos"]["ttft_p95_ms"]["state"] == "breach"
+    assert out["events"] == []
+
+    # the bad point ages out of the fast window: breach->ok edge
+    out = evaluate_slos(
+        spec, _pts([good, good, good, bad, good, good, good]), **kw)
+    s = out["slos"]["ttft_p95_ms"]
+    assert s["state"] == "ok"
+    assert s["attainment"] == pytest.approx(5 / 6, abs=1e-3)
+    assert [(e["from"], e["to"]) for e in out["events"]] == [("breach", "ok")]
+
+
+def test_slo_engine_edge_cases():
+    # no data at all -> no_data, no events, None everywhere
+    out = evaluate_slos({"ttft_p95_ms": 500},
+                        _pts([None, -1.0]), fast_window=3, slow_window=6)
+    s = out["slos"]["ttft_p95_ms"]
+    assert s["state"] == "no_data" and s["value"] is None
+    assert s["met"] is None and s["attainment"] is None
+    assert s["burn_rate"] == {"fast": None, "slow": None}
+    assert out["events"] == []
+    # unknown spec keys are reported, not crashed on
+    out = evaluate_slos({"bogus_slo": 1}, _pts([0.1]))
+    assert out["slos"]["bogus_slo"]["state"] == "unknown_slo"
+    assert "ttft_p95_ms" in out["slos"]["bogus_slo"]["supported"]
+    # dict form carries a custom objective; a loose budget absorbs one
+    # breach in ten points without burning through
+    pts = _pts([0.9] + [0.1] * 9)
+    out = evaluate_slos({"ttft_p95_ms": {"target": 500, "objective": 0.5}},
+                        pts, fast_window=10, slow_window=10)
+    s = out["slos"]["ttft_p95_ms"]
+    assert s["objective"] == 0.5
+    assert s["state"] == "ok" and s["burn_rate"]["fast"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# tracing overhead guard on the cost-model bench (tier-1)
+# ---------------------------------------------------------------------------
+
+def _bench_mod():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tracing_overhead_under_5_percent():
+    """Tracing every request must cost ≤5% aggregate new-tok/s on the
+    injected-latency cost model (span bookkeeping is host-side dict work
+    between sleeps; the margin absorbs CI scheduling noise)."""
+    out = _bench_mod().bench_tracing_overhead(
+        requests=32, slots=16, segment=8, step_s=0.001, dispatch_s=0.002,
+        prefill_s=0.002, stagger_s=0.002)
+    assert out["traced"] == 32               # every request left a tree
+    assert out["overhead_pct"] <= 5.0, out
