@@ -72,6 +72,21 @@ def stats_brief(result: dict) -> dict:
     return brief
 
 
+def record_config(out: dict, name: str, result: dict, n: int) -> None:
+    """Append this point to ``out["configs"]`` in the shared per-config
+    schema (workloads.costmodel.config_record) — the same record shape
+    bench_multichip and the dryrun artifact emit, so the historical drift
+    between this file's ad-hoc ``llm_mfu``/``vit_step_ms`` keys and the
+    structured artifacts stops at the legacy keys (kept for dashboards)."""
+    from kubeoperator_tpu.workloads.costmodel import config_record
+
+    step_ms = result.get("step_time_ms")
+    out.setdefault("configs", []).append(config_record(
+        config=name, n_devices=n,
+        step_time_s=step_ms / 1e3 if step_ms is not None else None,
+        mfu=result.get("mfu"), step_ms=stats_brief(result)))
+
+
 def main() -> None:
     from kubeoperator_tpu.workloads.sharding import MeshSpec
     from kubeoperator_tpu.workloads.train import (
@@ -117,6 +132,7 @@ def main() -> None:
                           "error": "all batch sizes failed"}))
         return
 
+    record_config(out, "resnet", result, n)
     target_mfu = 0.60
     out |= {
         "metric": "resnet50_img_per_sec_per_chip",
@@ -158,6 +174,7 @@ def main() -> None:
             out["llm_mfu"] = round(lm["mfu"], 4)
             out["llm_tokens_per_sec"] = round(lm["tokens_per_sec"])
             out["llm_step_ms"] = stats_brief(lm)
+            record_config(out, "llm", lm, n)
             # long-context point: flash attention made seq 4096 compile on
             # this chip (dense previously failed the relay, PERF.md r3)
             import dataclasses
@@ -167,6 +184,7 @@ def main() -> None:
             lm4k = guarded("llm4k", lambda: LMTrainer(lm4k_cfg, lm_spec).measure(
                 batch=4 * n, seq_len=4096, steps=4, warmup=2), out)
             out["llm_mfu_seq4k"] = round(lm4k["mfu"], 4)
+            record_config(out, "llm4k", lm4k, n)
             # 8k long-context point (r4: flash block 512 makes longer
             # sequences FASTER per FLOP than short — 62.4% measured)
             lm8k_cfg = dataclasses.replace(lm_cfg, max_seq_len=8192,
@@ -174,6 +192,7 @@ def main() -> None:
             lm8k = guarded("llm8k", lambda: LMTrainer(lm8k_cfg, lm_spec).measure(
                 batch=2 * n, seq_len=8192, steps=4, warmup=2), out)
             out["llm_mfu_seq8k"] = round(lm8k["mfu"], 4)
+            record_config(out, "llm8k", lm8k, n)
         except Exception as e:  # noqa: BLE001 — secondary metric only
             print(f"# llm secondary metric failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -202,6 +221,7 @@ def main() -> None:
             out["vit_img_per_sec_per_chip"] = round(
                 vit["img_per_sec_per_chip"], 1)
             out["vit_step_ms"] = stats_brief(vit)
+            record_config(out, "vit", vit, n)
         except Exception as e:  # noqa: BLE001 — secondary metric only
             print(f"# vit secondary metric failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

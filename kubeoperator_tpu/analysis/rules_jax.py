@@ -473,6 +473,47 @@ def _array_locals(ctx: ModuleContext, func: ast.AST) -> set[str]:
     return out
 
 
+#: cross-device collectives — each call inside an unrolled Python loop is
+#: one separately-scheduled collective per iteration
+_COLLECTIVE_FNS = {
+    "jax.lax.all_gather", "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax",
+    "jax.lax.psum_scatter", "jax.lax.ppermute", "jax.lax.all_to_all",
+}
+
+
+@register
+class CollectiveInUnrolledLoop(Rule):
+    """KO130 — a ``lax`` collective inside an unrolled Python ``for`` over
+    layers/stages issues one independently-scheduled collective per
+    iteration: XLA cannot fuse or pre-issue them across iterations the way
+    it can inside a single ``lax.scan`` body, so the gather for layer i+1
+    can never overlap layer i's compute — exactly the latency the chunked
+    ZeRO-3 schedule (``sharding.fsdp_overlapped_loss_fn``) exists to hide.
+    Collectives inside a function handed to ``scan``/``fori_loop`` are a
+    nested scope and do not trip this."""
+
+    id = "KO130"
+    severity = "warning"
+    title = "collective inside an unrolled Python loop"
+    hint = ("roll the loop into lax.scan over stacked per-layer params so "
+            "the collective is scheduled once and can overlap compute "
+            "(double-buffer the gather like fsdp_overlapped_loss_fn)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and ctx.in_loop(node)):
+                continue
+            name = ctx.dotted(node.func)
+            if name in _COLLECTIVE_FNS:
+                short = name.replace("jax.lax.", "lax.")
+                yield self.finding(
+                    ctx, node,
+                    f"{short} inside an unrolled Python loop is one "
+                    f"un-overlappable collective per iteration")
+
+
 @register
 class UnpinnedShardedWrite(Rule):
     """KO120 — in an engine that routes pool buffers through a canonical

@@ -178,6 +178,18 @@ data:
         regex: '([^:]+)(?::\\d+)?'
         replacement: "$1:8080"
         target_label: __address__
+    - job_name: ko-train
+      # the train jobs' telemetry registry (step time, MFU, collective
+      # attribution) on --metrics-port 8080 of the trainer pods
+      kubernetes_sd_configs: [{{role: pod}}]
+      relabel_configs:
+      - source_labels: [__meta_kubernetes_pod_label_app]
+        regex: jax-llm-train
+        action: keep
+      - source_labels: [__address__]
+        regex: '([^:]+)(?::\\d+)?'
+        replacement: "$1:8080"
+        target_label: __address__
 ---
 apiVersion: apps/v1
 kind: DaemonSet
@@ -293,7 +305,11 @@ data:
       {{"title": "TTFT decomposition: queue vs device vs host-blocked", "type": "timeseries", "gridPos": {{"x":0,"y":32,"w":12,"h":8}},
         "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}},
                     {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_segment_device_seconds_bucket[5m])) by (le))"}},
-                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_host_blocked_seconds_bucket[5m])) by (le, shard))", "legendFormat": "host-blocked shard {{{{shard}}}}"}}]}}
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_host_blocked_seconds_bucket[5m])) by (le, shard))", "legendFormat": "host-blocked shard {{{{shard}}}}"}}]}},
+      {{"title": "Training: step p95 / MFU / collective seconds", "type": "timeseries", "gridPos": {{"x":12,"y":32,"w":12,"h":8}},
+        "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_train_step_seconds_bucket[5m])) by (le, workload))", "legendFormat": "step p95 {{{{workload}}}}"}},
+                    {{"expr": "avg(ko_train_mfu) by (workload)", "legendFormat": "mfu {{{{workload}}}}"}},
+                    {{"expr": "sum(rate(ko_train_collective_seconds[5m])) by (collective)", "legendFormat": "{{{{collective}}}}"}}]}}
     ]}}
 ---
 apiVersion: v1
@@ -620,7 +636,8 @@ spec:
         image: "{registry}/ko-workloads:latest"
         command: ["python", "-m", "kubeoperator_tpu.train.jobs", "llm",
                   "--seq-len", "8192", "--mesh", "dp:auto,tp:4",
-                  "--ckpt-dir", "/ckpt"]
+                  "--ckpt-dir", "/ckpt", "--metrics-port", "8080"]
+        ports: [{{containerPort: 8080, name: metrics}}]
         resources: {{limits: {{google.com/tpu: "4"}}}}
         volumeMounts:
         - {{name: tpuenv, mountPath: /etc/kubeoperator}}

@@ -53,6 +53,11 @@ QUERIED_METRICS = {
     # paged KV cache (round 8): page-pool pressure + prefix-cache payoff
     "ko_serve_kv_pages_used": "jax-serve",
     "ko_serve_prefix_hits_total": "jax-serve",
+    # multi-chip training (round 10): step time, MFU, and the collective
+    # attribution the train jobs publish on --metrics-port
+    "ko_train_step_seconds_bucket": "jax-train",
+    "ko_train_mfu": "jax-train",
+    "ko_train_collective_seconds": "jax-train",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -82,6 +87,16 @@ PROMQL = {
     # the prefix cache's hit rate (skipped prefills per second)
     "serve_kv_pages_used": "sum(ko_serve_kv_pages_used)",
     "serve_prefix_hit_rate": "sum(rate(ko_serve_prefix_hits_total[5m]))",
+    # training plane (round 10): the fsdp/pipeline jobs' step-time p95,
+    # fleet MFU, and where the collective seconds go by family — the same
+    # split bench_multichip attributes per config
+    "train_step_p95":
+        "histogram_quantile(0.95, "
+        "sum(rate(ko_train_step_seconds_bucket[5m])) by (le))",
+    "train_mfu": "avg(ko_train_mfu)",
+    "train_collective_rate": "sum(rate(ko_train_collective_seconds[5m]))",
+    "train_collective_by_kind":
+        "sum(rate(ko_train_collective_seconds[5m])) by (collective)",
 }
 
 
@@ -403,6 +418,16 @@ class ClusterMonitor:
         serve_ttft = prom.scalar_or_none(PROMQL["serve_ttft_p95"])
         serve_pages = prom.scalar_or_none(PROMQL["serve_kv_pages_used"])
         serve_hit_rate = prom.scalar_or_none(PROMQL["serve_prefix_hit_rate"])
+        # training plane: None marks "no train job publishing metrics"
+        train_step_p95 = prom.scalar_or_none(PROMQL["train_step_p95"])
+        train_mfu = prom.scalar_or_none(PROMQL["train_mfu"])
+        train_coll_rate = prom.scalar_or_none(PROMQL["train_collective_rate"])
+        try:
+            train_collectives = {
+                r.get("metric", {}).get("collective", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["train_collective_by_kind"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            train_collectives = {}
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -424,6 +449,10 @@ class ClusterMonitor:
             "serve_ttft_p95": serve_ttft,
             "serve_kv_pages_used": serve_pages,
             "serve_prefix_hit_rate": serve_hit_rate,
+            "train_step_p95": train_step_p95,
+            "train_mfu": train_mfu,
+            "train_collective_rate": train_coll_rate,
+            "train_collectives": train_collectives,
             "time": iso_now(),
         }
         self._save_snapshot(data)
@@ -458,6 +487,8 @@ class ClusterMonitor:
                        "serve_ttft_p95": data["serve_ttft_p95"],
                        "serve_kv_pages_used": data["serve_kv_pages_used"],
                        "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
+                       "train_step_p95": data["train_step_p95"],
+                       "train_mfu": data["train_mfu"],
                        "pod_count": data["pod_count"]})
         points = points[-self.HISTORY_POINTS:]
         # SLO evaluation rides the same beat, judged over the freshly

@@ -456,6 +456,59 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
     }
 
 
+# -- training-plane families (train/jobs.py, scripts/bench_multichip.py) ----
+# One training step spans ~1 ms (tiny CI meshes) to minutes (checkpoint-
+# sized models through cold caches); start finer than DEFAULT_BUCKETS.
+TRAIN_STEP_BUCKETS: tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+
+
+def declare_train_metrics(registry: Registry) -> dict:
+    """Declare the ``ko_train_*`` vocabulary on ``registry`` and return the
+    families keyed by short name — the train-plane mirror of
+    :func:`declare_serve_metrics`. The training jobs (train/jobs.py) and
+    the multi-chip bench record into the process-global REGISTRY so one
+    ``/metrics`` scrape covers a training pod the way it covers a serving
+    pod; declared at import so the README drift lint sees the vocabulary."""
+    return {
+        "step": registry.histogram(
+            "ko_train_step_seconds",
+            "Wall-clock duration of one optimizer step (fwd + bwd + "
+            "update), per workload.",
+            labels=("workload",), buckets=TRAIN_STEP_BUCKETS),
+        "collective": registry.counter(
+            "ko_train_collective_seconds",
+            "Seconds attributed to inter-chip collectives per step, by "
+            "collective family (all_gather | reduce_scatter | ppermute | "
+            "all_reduce); cost-model derived on CPU meshes, profiler-"
+            "derived on device.",
+            labels=("workload", "collective")),
+        "mfu": registry.gauge(
+            "ko_train_mfu",
+            "Model FLOPs utilization of the last measured step window, "
+            "per workload (model FLOPs / peak FLOPs of the mesh).",
+            labels=("workload",)),
+    }
+
+
+def record_train_step(workload: str, step_seconds: float,
+                      mfu: float | None = None,
+                      collective_seconds: dict[str, float] | None = None,
+                      registry: Registry | None = None) -> None:
+    """One call per measured step window from the training jobs: observes
+    the step histogram and updates the attribution counters and MFU gauge.
+    Takes plain floats so workloads stay import-light — the collective
+    split comes from ``workloads.costmodel`` attribution upstream."""
+    fams = declare_train_metrics(registry if registry is not None else REGISTRY)
+    fams["step"].observe(float(step_seconds), workload=workload)
+    if mfu is not None:
+        fams["mfu"].set(float(mfu), workload=workload)
+    for kind, secs in (collective_seconds or {}).items():
+        if secs > 0:
+            fams["collective"].inc(float(secs), workload=workload,
+                                   collective=kind)
+
+
 # -- SLO engine families (services/monitor.evaluate_slos) -------------------
 # Set by the controller's monitor beat, not by BatcherStats: SLO attainment
 # and burn are judged over the persisted snapshot history, so they live on
@@ -474,3 +527,4 @@ SLO_BURN_RATE = REGISTRY.gauge(
 
 
 declare_serve_metrics(REGISTRY)
+declare_train_metrics(REGISTRY)

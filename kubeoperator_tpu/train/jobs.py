@@ -99,6 +99,41 @@ def emit(record: dict) -> None:
     print(json.dumps(record), flush=True)
 
 
+def maybe_start_metrics_server(port: int):
+    """Serve the process-global telemetry registry (the ``ko_train_*``
+    families the training loops record) as Prometheus text exposition on
+    ``/metrics`` — what the bundled prometheus stack's ``ko-train`` scrape
+    job reads off the trainer pods. ``port <= 0`` disables (the default;
+    the manifests pass ``--metrics-port 8080``). Daemon thread, so job
+    exit is never blocked on the server."""
+    if port <= 0:
+        return None
+    import http.server
+    import threading
+
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102 — scrape noise
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
 # -- subcommands ---------------------------------------------------------------
 
 def cmd_smoke(args: argparse.Namespace) -> int:
@@ -499,6 +534,7 @@ def cmd_llm(args: argparse.Namespace) -> int:
     """Transformer LM over dp×fsdp×tp×sp (ring attention when sp>1) —
     the long-context workload chart."""
     dist = maybe_initialize_distributed()
+    maybe_start_metrics_server(getattr(args, "metrics_port", 0))
     import jax
     import jax.numpy as jnp
 
@@ -557,11 +593,117 @@ def cmd_llm(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fsdp(args: argparse.Namespace) -> int:
+    """Chunked ZeRO-3 MLP LM over an fsdp (or dp×fsdp) mesh with the
+    latency-hiding schedule (sharding.fsdp_overlapped_loss_fn): the
+    all-gather for layer i+1's param chunk is issued before layer i's
+    compute, and the transposed backward overlaps each reduce-scatter with
+    the previous layer's grads. ``--no-overlap`` runs the same chunked
+    step gathering serially — the A/B bench_multichip measures. Emits a
+    collective-time attribution (cost-model shares scaled onto the
+    measured step; profiler-derived on real devices) and records the
+    ``ko_train_*`` telemetry families."""
+    dist = maybe_initialize_distributed()
+    maybe_start_metrics_server(getattr(args, "metrics_port", 0))
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.telemetry.metrics import record_train_step
+    from kubeoperator_tpu.workloads import costmodel
+    from kubeoperator_tpu.workloads.sharding import (
+        batch_sharding, build_mesh, fsdp_overlapped_loss_fn,
+        fsdp_overlapped_shardings, pack_stages,
+    )
+    from kubeoperator_tpu.workloads.train import peak_flops_per_chip
+
+    devices = jax.devices()
+    spec = parse_mesh(args.mesh or f"fsdp:{len(devices)}", len(devices))
+    if spec.fsdp < 2:
+        raise SystemExit("the fsdp job needs an fsdp axis >= 2 "
+                         "(e.g. --mesh dp:2,fsdp:4)")
+    mesh = build_mesh(spec, devices)
+    d, vocab = args.d_model, args.vocab
+    ks = jax.random.split(jax.random.key(args.seed), args.layers + 2)
+    stages, unpack = pack_stages(
+        [{"w1": jax.random.normal(jax.random.split(k)[0], (d, d)) * 0.1,
+          "w2": jax.random.normal(jax.random.split(k)[1], (d, d)) * 0.1}
+         for k in ks[1:-1]], multiple=spec.fsdp)
+    shd = fsdp_overlapped_shardings(mesh)
+    params = {
+        "embed": jax.device_put(
+            jax.random.normal(ks[0], (vocab, d)) * 0.1, shd["embed"]),
+        "stages": jax.device_put(stages, shd["stages"]),
+        "head": jax.device_put(
+            jax.random.normal(ks[-1], (d, vocab)) * 0.1, shd["head"]),
+    }
+    loss_fn = fsdp_overlapped_loss_fn(
+        mesh,
+        embed_fn=lambda e, t: e[t],
+        stage_fn=lambda p, h: h + jnp.tanh(h @ p["w1"]) @ p["w2"],
+        head_fn=lambda p, h: h @ p,
+        loss_fn=lambda out, y: -jax.nn.log_softmax(out)[
+            jnp.arange(y.shape[0]), y],
+        unpack=unpack, prefetch=not args.no_overlap)
+
+    def step_fn(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        return jax.tree.map(lambda p, g: p - args.lr * g, params, grads), loss
+
+    step = jax.jit(step_fn, donate_argnums=(0,))
+
+    batch = args.batch or 8 * max(1, spec.dp * spec.fsdp)
+    bs = batch_sharding(mesh, spec)
+    x = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch,), 0, vocab), bs)
+    y = jax.device_put(
+        jax.random.randint(jax.random.key(2), (batch,), 0, vocab), bs)
+
+    times: list[float] = []
+    for i in range(args.warmup + args.steps):
+        t0 = time.perf_counter()
+        params, loss = step(params, x, y)
+        loss.block_until_ready()
+        if i >= args.warmup:
+            times.append(time.perf_counter() - t0)
+        if (i + 1) % max(1, (args.warmup + args.steps) // 5) == 0:
+            emit({"job": "fsdp", "step": i + 1,
+                  "loss": round(float(loss), 4)})
+    step_s = sum(times) / len(times)
+
+    # attribution: the cost model prices this exact schedule's shares,
+    # the measurement supplies the total; a real-device profile (when the
+    # platform offers one) replaces the modeled collective split
+    peak = peak_flops_per_chip(devices[0])
+    local_batch = batch // max(1, spec.dp * spec.fsdp)
+    model = costmodel.fsdp_step_model(
+        n_layers=args.layers,
+        layer_param_bytes=4.0 * stages.shape[1],
+        fwd_flops_per_layer=4.0 * local_batch * d * d,
+        n_fsdp=spec.fsdp, peak_flops=peak,
+        overlap=not args.no_overlap)
+    att = costmodel.attribute(step_s, model)
+    prof = costmodel.profiled_collective_seconds(
+        jax.jit(loss_fn), params, x, y)
+    if prof is not None:
+        att.collective_s, att.source = prof, "profiler"
+
+    model_flops = 3 * (args.layers * 4 * batch * d * d
+                       + 2 * batch * d * vocab)
+    mfu = model_flops / (peak * len(devices) * step_s)
+    record_train_step("fsdp", step_s, mfu, att.collective_s)
+    emit({"job": "fsdp", "done": True, "mesh": dict(spec.sizes()),
+          "layers": args.layers, "overlap": not args.no_overlap,
+          "mfu": round(mfu, 6), "loss": round(float(loss), 4),
+          **att.as_dict(), **dist})
+    return 0
+
+
 def cmd_pipeline(args: argparse.Namespace) -> int:
     """Device-pipelined MLP LM over a real ``pp`` mesh axis (GPipe
     fill/drain, pipeline.gpipe_loss_fn) — the pipeline-parallel
     launchable. Composes with dp: ``--mesh dp:2,pp:4``."""
     dist = maybe_initialize_distributed()
+    maybe_start_metrics_server(getattr(args, "metrics_port", 0))
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -612,15 +754,26 @@ def cmd_pipeline(args: argparse.Namespace) -> int:
     batch = args.batch or args.microbatches * max(1, spec.dp * spec.fsdp)
     x = jax.random.randint(jax.random.key(1), (batch,), 0, vocab)
     y = jax.random.randint(jax.random.key(2), (batch,), 0, vocab)
+    times = []
     for i in range(args.steps):
+        t0 = time.perf_counter()
         params, loss = step(params, x, y)
+        loss.block_until_ready()
+        times.append(time.perf_counter() - t0)
         if (i + 1) % max(1, args.steps // 5) == 0:
             emit({"job": "pipeline", "step": i + 1,
                   "loss": round(float(loss), 4)})
+    # drop compile-inclusive first steps when there are enough to spare
+    measured = times[min(2, len(times) - 1):]
+    step_s = sum(measured) / len(measured)
+    from kubeoperator_tpu.telemetry.metrics import record_train_step
+
+    record_train_step("pipeline", step_s)
     emit({"job": "pipeline", "done": True, "mesh": dict(spec.sizes()),
           "stages": spec.pp, "microbatches": args.microbatches,
-          "bubble_fraction": round((spec.pp - 1)
-                                   / (args.microbatches + spec.pp - 1), 3),
+          "step_time_s": round(step_s, 6),
+          "bubble_fraction": round(
+              pipe.bubble_fraction(spec.pp, args.microbatches), 3),
           **dist})
     return 0
 
@@ -707,6 +860,25 @@ def build_parser() -> argparse.ArgumentParser:
                          "slots * max_seq_len/page + dp, dense-"
                          "equivalent HBM)")
 
+    fs = sub.add_parser("fsdp", help="chunked ZeRO-3 training with "
+                                     "latency-hiding gather/compute overlap")
+    fs.add_argument("--mesh", help="e.g. fsdp:8 or dp:2,fsdp:4 "
+                                   "(default fsdp:<all devices>)")
+    fs.add_argument("--steps", type=int, default=10,
+                    help="measured steps (after --warmup)")
+    fs.add_argument("--warmup", type=int, default=2)
+    fs.add_argument("--batch", type=int, default=0)
+    fs.add_argument("--layers", type=int, default=4)
+    fs.add_argument("--d-model", type=int, default=64)
+    fs.add_argument("--vocab", type=int, default=256)
+    fs.add_argument("--lr", type=float, default=0.1)
+    fs.add_argument("--seed", type=int, default=0)
+    fs.add_argument("--metrics-port", type=int, default=0,
+                    help=">0: serve ko_train_* prometheus text on this port")
+    fs.add_argument("--no-overlap", action="store_true",
+                    help="gather each layer chunk serially before its "
+                         "compute (the A/B baseline schedule)")
+
     pp = sub.add_parser("pipeline",
                         help="device-pipelined training over a pp mesh axis")
     pp.add_argument("--mesh", help="e.g. dp:2,pp:4 (default pp:<all devices>)")
@@ -717,8 +889,12 @@ def build_parser() -> argparse.ArgumentParser:
     pp.add_argument("--vocab", type=int, default=256)
     pp.add_argument("--lr", type=float, default=0.1)
     pp.add_argument("--seed", type=int, default=0)
+    pp.add_argument("--metrics-port", type=int, default=0,
+                    help=">0: serve ko_train_* prometheus text on this port")
 
     lm = sub.add_parser("llm", help="transformer LM (ring attention for long context)")
+    lm.add_argument("--metrics-port", type=int, default=0,
+                    help=">0: serve ko_train_* prometheus text on this port")
     lm.add_argument("--steps", type=int, default=100)
     lm.add_argument("--seq-len", type=int, default=2048)
     lm.add_argument("--batch", type=int, default=None)
@@ -748,7 +924,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 COMMANDS = {"smoke": cmd_smoke, "mnist": cmd_mnist,
             "resnet50": cmd_resnet50, "vit": cmd_vit, "llm": cmd_llm,
-            "serve": cmd_serve, "pipeline": cmd_pipeline}
+            "serve": cmd_serve, "pipeline": cmd_pipeline, "fsdp": cmd_fsdp}
 
 
 def main(argv: list[str] | None = None) -> int:

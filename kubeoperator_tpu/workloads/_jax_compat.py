@@ -1,0 +1,41 @@
+"""Version-tolerant aliases for jax APIs the workloads lean on.
+
+The CI image and the TPU hosts do not always carry the same jax: newer
+releases export ``jax.shard_map`` with varying-manual-axes typing
+(``check_vma``) and ``jax.lax.pcast``, while 0.4.x keeps shard_map under
+``jax.experimental`` with the older ``check_rep`` replication checker and
+has no ``pcast`` at all. Routing every call site through this module keeps
+the workloads runnable on both without scattering try/except at each use.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+
+except ImportError:                    # pre-0.5 jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        # the old replication checker predates vma typing and rejects
+        # valid control-flow carries (scanned ppermute chains), so it is
+        # always off here; the new checker runs wherever jax is new
+        # enough to have it
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+
+def pcast(x, axes, to=None):
+    """``jax.lax.pcast`` where it exists; identity on jax versions without
+    vma typing (there is nothing to cast — manual-axes values carry no
+    varying/invariant type there)."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)

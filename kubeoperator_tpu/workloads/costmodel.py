@@ -1,0 +1,375 @@
+"""Analytic collective cost model and step-time attribution.
+
+The multi-chip dryruns prove loss correctness; this module prices what the
+schedule *spends*. One :class:`LinkModel` (per-hop launch latency + per-link
+bandwidth, v5e ICI defaults) prices each collective a schedule issues, and
+per-schedule step models split a training step into compute / all-gather /
+reduce-scatter / ppermute / ici-hop terms:
+
+* :func:`fsdp_step_model` — the chunked ZeRO-3 step, overlapped
+  (``sharding.fsdp_overlapped_loss_fn`` with prefetch) or not. The
+  overlapped schedule's critical path is ``max(compute, comm)`` per layer
+  with one exposed gather per direction; the non-overlapped one pays
+  ``compute + comm`` serially. Their ratio is the overlap win
+  ``bench_multichip --cost-model`` guards (≥1.15× at 8 devices on the
+  reference scale).
+* :func:`gpipe_step_model` — the fill/drain schedule tick by tick; its
+  measured bubble (via ``pipeline.bubble_from_timings`` on the simulated
+  step times) is checked against the analytic ``(pp−1)/(M+pp−1)``.
+* :func:`ring_attention_model` — the long-context curve: per-hop block
+  compute vs K/V ppermute traffic at seq 8k→32k.
+
+On CPU meshes (CI) wall-clock says nothing about ICI, so measured step
+times are attributed by :func:`attribute` — cost-model shares scaled to
+the measured total, labeled ``source="cost-model"``. On real devices
+:func:`profiled_collective_seconds` derives the split from a
+``jax.profiler`` trace when the runtime exposes one (gated; falls back to
+the cost model otherwise).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.workloads.pipeline import (
+    bubble_fraction, bubble_from_timings,
+)
+
+COLLECTIVES = ("all_gather", "reduce_scatter", "ppermute", "all_reduce")
+
+
+@dataclass(frozen=True)
+class LinkModel:
+    """One inter-chip link: fixed per-hop launch cost + streaming rate.
+    Defaults are v5e ICI (~45 GB/s/link each direction, ~1 µs hop setup);
+    DCN crossings are the same shape with worse constants."""
+
+    latency_s: float = 1e-6
+    bytes_per_s: float = 4.5e10
+
+
+DEFAULT_LINK = LinkModel()
+#: multislice DCN: per-hop setup dominated by the network stack, ~25 GB/s
+DCN_LINK = LinkModel(latency_s=1e-4, bytes_per_s=2.5e10)
+
+
+def ici_hops(kind: str, n_devices: int) -> int:
+    """Hops on the critical path of one collective over ``n_devices``
+    (ring algorithms for the gather/scatter family, one hop for a
+    neighbour permute)."""
+    if n_devices <= 1:
+        return 0
+    if kind in ("all_gather", "reduce_scatter"):
+        return n_devices - 1
+    if kind == "all_reduce":                   # reduce-scatter + all-gather
+        return 2 * (n_devices - 1)
+    if kind == "ppermute":
+        return 1
+    raise ValueError(f"unknown collective {kind!r}")
+
+
+def collective_seconds(kind: str, n_bytes: float, n_devices: int,
+                       link: LinkModel = DEFAULT_LINK) -> float:
+    """Ring-algorithm time for one collective moving ``n_bytes`` of
+    payload (the full logical array for gather/scatter/reduce, the
+    per-hop message for ppermute)."""
+    hops = ici_hops(kind, n_devices)
+    if hops == 0:
+        return 0.0
+    if kind in ("all_gather", "reduce_scatter"):
+        wire = n_bytes * (n_devices - 1) / n_devices
+    elif kind == "all_reduce":
+        wire = 2 * n_bytes * (n_devices - 1) / n_devices
+    else:                                      # ppermute: one full message
+        wire = n_bytes
+    return hops * link.latency_s + wire / link.bytes_per_s
+
+
+@dataclass
+class StepAttribution:
+    """One schedule's priced step: where the seconds went.
+
+    ``collective_s`` totals every collective the schedule *issues*;
+    ``exposed_collective_s`` is the share left on the critical path after
+    overlap (equal to the total for non-overlapped schedules). ``step_s``
+    is the critical path: compute + exposed collectives (+ bubble idle
+    for pipelined schedules).
+    """
+
+    step_s: float
+    compute_s: float
+    collective_s: dict[str, float] = field(default_factory=dict)
+    exposed_collective_s: float = 0.0
+    ici_hops: int = 0
+    bubble_fraction: float = 0.0
+    source: str = "cost-model"
+
+    def as_dict(self) -> dict:
+        return {
+            "step_time_s": round(self.step_s, 6),
+            "compute_s": round(self.compute_s, 6),
+            "collective_seconds": {k: round(v, 6)
+                                   for k, v in self.collective_s.items()},
+            "exposed_collective_s": round(self.exposed_collective_s, 6),
+            "ici_hops": self.ici_hops,
+            "bubble_fraction": round(self.bubble_fraction, 4),
+            "attribution_source": self.source,
+        }
+
+
+def attribute(measured_step_s: float, model: StepAttribution) -> StepAttribution:
+    """Scale a cost-model attribution onto a measured step time: the model
+    supplies the *shares*, the measurement supplies the total. This is the
+    CPU-mesh probe — honest about being a model, hence the source label."""
+    if model.step_s <= 0:
+        raise ValueError("model step time must be positive")
+    s = measured_step_s / model.step_s
+    return StepAttribution(
+        step_s=measured_step_s,
+        compute_s=model.compute_s * s,
+        collective_s={k: v * s for k, v in model.collective_s.items()},
+        exposed_collective_s=model.exposed_collective_s * s,
+        ici_hops=model.ici_hops,
+        bubble_fraction=model.bubble_fraction,
+        source="cost-model",
+    )
+
+
+# ---------------------------------------------------------------------------
+# schedule models
+# ---------------------------------------------------------------------------
+
+def fsdp_step_model(*, n_layers: int, layer_param_bytes: float,
+                    fwd_flops_per_layer: float, n_fsdp: int,
+                    peak_flops: float, link: LinkModel = DEFAULT_LINK,
+                    overlap: bool = True) -> StepAttribution:
+    """Chunked ZeRO-3 step time (fwd + bwd) per device.
+
+    ``fwd_flops_per_layer`` is one layer's forward FLOPs on this device's
+    batch shard; backward costs 2×. Per layer the schedule gathers the
+    layer chunk (fwd), re-gathers it under remat and reduce-scatters the
+    grad (bwd). Overlapped, each direction exposes one gather and then
+    runs ``max(compute, comm)`` per layer (the scan-carried prefetch keeps
+    exactly one chunk in flight); non-overlapped it pays the sum.
+    """
+    if n_layers < 1:
+        raise ValueError("need at least one layer")
+    g = collective_seconds("all_gather", layer_param_bytes, n_fsdp, link)
+    rs = collective_seconds("reduce_scatter", layer_param_bytes, n_fsdp, link)
+    c_f = fwd_flops_per_layer / peak_flops
+    c_b = 2 * c_f
+    fwd_comm, bwd_comm = g, g + rs
+    if overlap:
+        fwd = fwd_comm + (n_layers - 1) * max(c_f, fwd_comm) + c_f
+        bwd = bwd_comm + (n_layers - 1) * max(c_b, bwd_comm) + c_b
+        compute = n_layers * (c_f + c_b)
+        step = fwd + bwd
+        exposed = step - compute
+    else:
+        step = n_layers * (c_f + fwd_comm) + n_layers * (c_b + bwd_comm)
+        compute = n_layers * (c_f + c_b)
+        exposed = step - compute
+    return StepAttribution(
+        step_s=step, compute_s=compute,
+        collective_s={"all_gather": 2 * n_layers * g,
+                      "reduce_scatter": n_layers * rs},
+        exposed_collective_s=max(exposed, 0.0),
+        ici_hops=n_layers * (2 * ici_hops("all_gather", n_fsdp)
+                             + ici_hops("reduce_scatter", n_fsdp)),
+    )
+
+
+def fsdp_overlap_win(*, n_layers: int, layer_param_bytes: float,
+                     fwd_flops_per_layer: float, n_fsdp: int,
+                     peak_flops: float,
+                     link: LinkModel = DEFAULT_LINK) -> dict:
+    """A/B the two ZeRO-3 schedules on the cost model; the tier-1 guard
+    pins ``speedup`` ≥ 1.15 at 8 devices on the reference scale."""
+    kw = dict(n_layers=n_layers, layer_param_bytes=layer_param_bytes,
+              fwd_flops_per_layer=fwd_flops_per_layer, n_fsdp=n_fsdp,
+              peak_flops=peak_flops, link=link)
+    eager = fsdp_step_model(overlap=False, **kw)
+    overlapped = fsdp_step_model(overlap=True, **kw)
+    return {
+        "eager": eager.as_dict(),
+        "overlapped": overlapped.as_dict(),
+        "speedup": round(eager.step_s / overlapped.step_s, 3),
+    }
+
+
+def gpipe_step_model(*, pp: int, microbatches: int,
+                     stage_fwd_flops_per_micro: float, hop_bytes: float,
+                     peak_flops: float,
+                     link: LinkModel = DEFAULT_LINK,
+                     overhead_s: float = 0.0) -> StepAttribution:
+    """GPipe fill/drain step (fwd + bwd): ``M + pp − 1`` ticks, each one
+    stage compute (fwd 1× + transposed bwd 2×) plus one activation
+    ppermute hop. ``bubble_fraction`` here is *measured* the way the bench
+    measures it on real steps — two simulated step times at M and 2M
+    through ``pipeline.bubble_from_timings`` — so tests can check it
+    against the analytic formula instead of the formula against itself.
+    """
+    c = 3 * stage_fwd_flops_per_micro / peak_flops
+    hop = collective_seconds("ppermute", hop_bytes, pp, link)
+    tick = c + 2 * hop                      # fwd hop + transposed bwd hop
+
+    def step_s(m: int) -> float:
+        return overhead_s + (m + pp - 1) * tick
+
+    t = step_s(microbatches)
+    measured = (bubble_from_timings(t, microbatches,
+                                    step_s(2 * microbatches),
+                                    2 * microbatches, pp)
+                if pp > 1 else 0.0)
+    ticks = microbatches + pp - 1
+    return StepAttribution(
+        step_s=t, compute_s=ticks * c,
+        collective_s={"ppermute": ticks * 2 * hop},
+        exposed_collective_s=ticks * 2 * hop,
+        ici_hops=ticks * 2 * ici_hops("ppermute", pp),
+        bubble_fraction=measured,
+    )
+
+
+def ring_attention_model(*, seq_len: int, sp: int, batch: int, heads: int,
+                         head_dim: int, peak_flops: float,
+                         bytes_per_elem: int = 4,
+                         link: LinkModel = DEFAULT_LINK) -> StepAttribution:
+    """One ring-attention forward: ``sp`` hops, each a Q-shard × K/V-shard
+    block (4·B·(S/sp)²·H·D FLOPs: two matmuls, two ops each) overlapped
+    with the K/V ppermute for the next hop — the rotation is
+    nearest-neighbour and data-independent of the current block, so the
+    critical path per hop is ``max(block, hop)`` with one exposed hop."""
+    s_local = seq_len // sp
+    block = 4 * batch * s_local * s_local * heads * head_dim / peak_flops
+    kv_bytes = 2 * batch * s_local * heads * head_dim * bytes_per_elem
+    hop = collective_seconds("ppermute", kv_bytes, sp, link)
+    compute = sp * block
+    step = compute if sp == 1 else hop + sp * max(block, hop)
+    return StepAttribution(
+        step_s=step, compute_s=compute,
+        collective_s={"ppermute": sp * hop},
+        exposed_collective_s=step - compute if sp > 1 else 0.0,
+        ici_hops=sp * ici_hops("ppermute", sp) if sp > 1 else 0,
+    )
+
+
+# the guard's reference scale: a 32-layer d=4096 decoder at seq 8192,
+# one sequence per device — per-layer matmul params 12·d², fwd FLOPs
+# 2·params·tokens. At this scale a layer's fsdp gather (~0.8 GB over 8
+# chips) and its forward compute (~17 ms on v5e) are the same order,
+# which is exactly the regime the overlapped schedule exists for.
+REFERENCE_LLM = {
+    "d_model": 4096,
+    "n_layers": 32,
+    "seq_len": 8192,
+    "layer_params": 12 * 4096 * 4096,
+    "peak_flops": 1.97e14,                  # v5e bf16
+}
+
+
+def reference_overlap_win(n_fsdp: int,
+                          link: LinkModel = DEFAULT_LINK) -> dict:
+    layer_params = REFERENCE_LLM["layer_params"]
+    return fsdp_overlap_win(
+        n_layers=REFERENCE_LLM["n_layers"],
+        layer_param_bytes=4 * layer_params,
+        fwd_flops_per_layer=2 * layer_params * REFERENCE_LLM["seq_len"],
+        n_fsdp=n_fsdp, peak_flops=REFERENCE_LLM["peak_flops"], link=link)
+
+
+# ---------------------------------------------------------------------------
+# real-device attribution (gated)
+# ---------------------------------------------------------------------------
+
+#: substrings the profiler names XLA collectives with → attribution keys
+_PROFILE_EVENT_KEYS = (
+    ("all-gather", "all_gather"),
+    ("reduce-scatter", "reduce_scatter"),
+    ("collective-permute", "ppermute"),
+    ("all-reduce", "all_reduce"),
+)
+
+
+def profiled_collective_seconds(step_fn, *args) -> dict[str, float] | None:
+    """Run one step under ``jax.profiler`` and sum device-event durations
+    per collective family. Returns None — caller falls back to the cost
+    model — on CPU, when the jaxlib has no ``ProfileData`` reader, or when
+    the trace parses but carries no device plane (all gated so CI never
+    depends on profiler internals)."""
+    import glob
+    import tempfile
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        return None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            with jax.profiler.trace(td):
+                out = step_fn(*args)
+                jax.block_until_ready(out)
+            paths = glob.glob(os.path.join(td, "**", "*.xplane.pb"),
+                              recursive=True)
+            if not paths:
+                return None
+            totals = {key: 0.0 for _, key in _PROFILE_EVENT_KEYS}
+            data = ProfileData.from_file(paths[0])
+            for plane in data.planes:
+                for line in plane.lines:
+                    for event in line.events:
+                        name = getattr(event, "name", "").lower()
+                        dur = getattr(event, "duration_ns", 0) / 1e9
+                        for needle, key in _PROFILE_EVENT_KEYS:
+                            if needle in name:
+                                totals[key] += dur
+            return totals if any(totals.values()) else None
+    except Exception:                        # profiler formats drift by version
+        return None
+
+
+def config_record(*, config: str, n_devices: int, mesh: dict | None = None,
+                  step_time_s: float | None = None, mfu: float | None = None,
+                  attribution: "StepAttribution | dict | None" = None,
+                  compile_counts: dict | None = None, ok: bool = True,
+                  error: str | None = None, **extra) -> dict:
+    """One benchmark config's structured record — the ONE schema shared by
+    ``scripts/bench_multichip.py`` artifacts, ``bench.py``'s per-config
+    tail, and the ``dryrun_multichip`` artifact, so downstream diffing
+    tools never re-learn a per-producer shape. Only measured fields
+    appear; ``attribution`` splices in :meth:`StepAttribution.as_dict`
+    (which includes its own ``step_time_s``)."""
+    rec: dict = {"config": config, "n_devices": int(n_devices),
+                 "ok": bool(ok)}
+    if mesh:
+        rec["mesh"] = {k: int(v) for k, v in mesh.items() if int(v) > 1}
+    if attribution is not None:
+        rec.update(attribution.as_dict()
+                   if isinstance(attribution, StepAttribution)
+                   else dict(attribution))
+    if step_time_s is not None:
+        rec["step_time_s"] = round(float(step_time_s), 6)
+    if mfu is not None:
+        rec["mfu"] = round(float(mfu), 6)
+    if compile_counts is not None:
+        rec["compile_counts"] = compile_counts
+    if error is not None:
+        rec["ok"] = False
+        rec["error"] = str(error)
+    rec.update(extra)
+    return rec
+
+
+__all__ = [
+    "COLLECTIVES", "LinkModel", "DEFAULT_LINK", "DCN_LINK",
+    "StepAttribution", "ici_hops", "collective_seconds", "attribute",
+    "fsdp_step_model", "fsdp_overlap_win", "gpipe_step_model",
+    "ring_attention_model", "REFERENCE_LLM", "reference_overlap_win",
+    "profiled_collective_seconds", "bubble_fraction", "bubble_from_timings",
+    "config_record",
+]

@@ -38,6 +38,34 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def bubble_fraction(pp: int, microbatches: int) -> float:
+    """Analytic GPipe bubble: the fill/drain schedule runs
+    ``T = microbatches + pp − 1`` ticks but only ``microbatches`` of them
+    advance any given stage's real work, so the idle share is
+    ``(pp − 1) / (microbatches + pp − 1)``. Single source of truth for the
+    dryrun line, the cost model, and the bench artifact."""
+    if pp < 1 or microbatches < 1:
+        raise ValueError(f"pp={pp} microbatches={microbatches} must be >= 1")
+    return (pp - 1) / (microbatches + pp - 1)
+
+
+def bubble_from_timings(t_a: float, micro_a: int, t_b: float, micro_b: int,
+                        pp: int) -> float:
+    """Measured bubble fraction from two step times at different microbatch
+    counts. ``T(M) = overhead + tick × (M + pp − 1)`` for the gpipe
+    schedule, so two measurements give ``tick = (T_b − T_a)/(M_b − M_a)``
+    and the bubble at ``M_a`` is the fill/drain ticks' share of its step:
+    ``tick × (pp − 1) / T_a``. Per-step overhead (dispatch, host work)
+    biases this LOW relative to :func:`bubble_fraction` — attribution can
+    only blame the schedule for time the schedule actually spent."""
+    if micro_b == micro_a:
+        raise ValueError("need two distinct microbatch counts")
+    tick = (t_b - t_a) / (micro_b - micro_a)
+    if tick <= 0 or t_a <= 0:
+        return 0.0
+    return min(1.0, tick * (pp - 1) / t_a)
+
+
 def stack_stages(stage_params: list[Any]) -> Any:
     """Stack per-stage pytrees (same treedef) on a new leading axis —
     the layout ``scan_stages`` consumes, and the layout the trainers shard
@@ -108,7 +136,7 @@ def gpipe_loss_fn(mesh, embed_fn: Callable, stage_fn: Callable,
     stay O(one stage) instead of O(n_micro) — the 1F1B memory bound via
     recompute (module docstring).
     """
-    from jax import shard_map
+    from kubeoperator_tpu.workloads._jax_compat import pcast, shard_map
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     s = sizes[axis]
@@ -124,10 +152,13 @@ def gpipe_loss_fn(mesh, embed_fn: Callable, stage_fn: Callable,
         # the scan carry varies per device (pp stage index, dp data shard);
         # shard_map's varying-manual-axes typing wants the INITIAL carry
         # marked the same way
-        state0 = jax.lax.pcast(jnp.zeros_like(embed_fn(embed_p, x_mb[0])),
-                               (axis,), to="varying")
-        loss0 = jax.lax.pcast(jnp.float32(0), data_axes + (axis,),
-                              to="varying")
+        state0 = pcast(jnp.zeros_like(embed_fn(embed_p, x_mb[0])),
+                       (axis,), to="varying")
+        # the loss carry rides as [1], not a scalar: a float scalar scan
+        # carry crossing the shard_map autodiff boundary becomes a rank-0
+        # residual that jax<0.5's transpose cannot name a spec for
+        loss0 = pcast(jnp.zeros((1,), jnp.float32), data_axes + (axis,),
+                      to="varying")
 
         def tick(carry, t):
             state, loss_sum = carry
@@ -143,7 +174,7 @@ def gpipe_loss_fn(mesh, embed_fn: Callable, stage_fn: Callable,
                 y_mb, jnp.clip(m, 0, m_total - 1), keepdims=False)
             losses = loss_fn(head_fn(head_p, h), yt)
             take = ((i == s - 1) & valid).astype(losses.dtype)
-            loss_sum = loss_sum + take * jnp.sum(losses)
+            loss_sum = loss_sum + (take * jnp.sum(losses))[None]
             # one hop: stage i's output becomes stage i+1's next input
             state = jax.lax.ppermute(
                 h, axis, [(j, (j + 1) % s) for j in range(s)])
@@ -168,6 +199,6 @@ def gpipe_loss_fn(mesh, embed_fn: Callable, stage_fn: Callable,
             in_specs=(P(axis), P(), P(), data_spec, data_spec),
             out_specs=P(),
         )(params["stages"], params["embed"], params["head"], xm, ym)
-        return total / b
+        return total[0] / b
 
     return loss

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeoperator_tpu.workloads._jax_compat import shard_map
+
 
 def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
     """One Q-shard × one K/V-shard block. Returns unnormalised (o, l, m).
@@ -100,7 +102,7 @@ def sharded_ring_attention(mesh: Mesh, q, k, v, causal: bool = True):
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
     sp = "sp" if "sp" in mesh.axis_names else None
     spec = P(data_axes, sp, "tp" if "tp" in mesh.axis_names else None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=sp, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
@@ -140,7 +142,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True) -> jnp.ndarr
     is small and heads ≥ sp), but heads must divide by sp. Per-device
     shards inside shard_map: q/k/v [B, T/sp, H, D] → out [B, T/sp, H, D].
     """
-    sp = lax.axis_size(axis_name)
+    sp = lax.psum(1, axis_name)   # axis size; lax.axis_size needs jax>=0.5
     b, t_local, h, d = q.shape
     if h % sp:
         raise ValueError(f"ulysses needs heads ({h}) divisible by sp ({sp})")
@@ -168,7 +170,7 @@ def sharded_ulysses_attention(mesh: Mesh, q, k, v, causal: bool = True):
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
     sp = "sp" if "sp" in mesh.axis_names else None
     spec = P(data_axes, sp, "tp" if "tp" in mesh.axis_names else None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ulysses_attention, axis_name=sp, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)

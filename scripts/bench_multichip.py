@@ -1,0 +1,501 @@
+#!/usr/bin/env python
+"""Multi-chip scaling bench: the r05 config matrix at 1 → 2 → 4 → 8 devices.
+
+Two modes, one artifact schema (``workloads.costmodel.config_record``):
+
+* ``--cost-model`` — deterministic, no devices: prices the reference-scale
+  schedules analytically. Emits the chunked-ZeRO-3 overlap win
+  (``reference_overlap_win``) per device count, the GPipe bubble measured
+  the way the bench measures it (two-point ``bubble_from_timings`` on the
+  simulated schedule) against the analytic ``(pp−1)/(M+pp−1)``, and the
+  ring-attention curve at seq 8k → 32k. The tier-1 guard
+  (``tests/test_bench_multichip.py``) runs this mode and pins the 8-device
+  overlap speedup ≥ 1.15× and the bubble within 10% of analytic.
+
+* measured (default) — re-execs itself once per device count with
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (virtual CPU
+  devices; the same flag the tests use) and times every matrix config:
+  resnet / transformer / vit / multislice / moe via the trainers' own
+  ``measure()``, plus the fsdp overlap-vs-eager A/B, the GPipe
+  two-microbatch-count bubble measurement, and ring attention at seq 8k
+  (16k/32k behind ``--full``). Each config runs under the compile-count
+  guard; measured steps are attributed through ``costmodel.attribute``
+  (cost-model shares scaled to the measured total on CPU,
+  profiler-derived on real devices).
+
+Usage:
+    python scripts/bench_multichip.py --cost-model
+    python scripts/bench_multichip.py --devices 1,2,4,8 --out MULTICHIP_bench_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SEQ_POINTS = (8192, 16384, 32768)
+
+
+# ---------------------------------------------------------------------------
+# cost-model mode — pure pricing, safe for tier-1
+# ---------------------------------------------------------------------------
+
+def cost_model_records(device_counts: list[int]) -> dict:
+    from kubeoperator_tpu.workloads import costmodel as cm
+    from kubeoperator_tpu.workloads.pipeline import bubble_fraction
+
+    ref = cm.REFERENCE_LLM
+    peak = ref["peak_flops"]
+    records: list[dict] = []
+    guards: dict = {}
+
+    for n in device_counts:
+        win = cm.reference_overlap_win(n)
+        records.append(cm.config_record(
+            config="fsdp-overlap", n_devices=n, mesh={"fsdp": n},
+            attribution=win["overlapped"], speedup=win["speedup"],
+            eager_step_time_s=win["eager"]["step_time_s"]))
+        if n == max(device_counts):
+            guards["fsdp_overlap_speedup"] = win["speedup"]
+
+    microbatches = 8
+    for n in device_counts:
+        if n < 2:
+            continue
+        pp = min(4, n)
+        # reference decoder split over pp stages, seq split over M micros
+        stage_flops = (ref["n_layers"] / pp) * 2 * ref["layer_params"] \
+            * (ref["seq_len"] / microbatches)
+        hop_bytes = 2 * (ref["seq_len"] / microbatches) * ref["d_model"]
+        att = cm.gpipe_step_model(
+            pp=pp, microbatches=microbatches,
+            stage_fwd_flops_per_micro=stage_flops, hop_bytes=hop_bytes,
+            peak_flops=peak)
+        analytic = bubble_fraction(pp, microbatches)
+        records.append(cm.config_record(
+            config="gpipe", n_devices=n, mesh={"pp": pp},
+            attribution=att, microbatches=microbatches,
+            analytic_bubble_fraction=round(analytic, 4)))
+        if n == max(device_counts):
+            guards["bubble_measured"] = att.bubble_fraction
+            guards["bubble_analytic"] = round(analytic, 4)
+
+    heads = ref["d_model"] // 128
+    for n in device_counts:
+        for seq in SEQ_POINTS:
+            att = cm.ring_attention_model(
+                seq_len=seq, sp=n, batch=1, heads=heads, head_dim=128,
+                peak_flops=peak, bytes_per_elem=2)
+            records.append(cm.config_record(
+                config=f"ring-attention-{seq // 1024}k", n_devices=n,
+                mesh={"sp": n}, attribution=att, seq_len=seq))
+
+    return {"records": records, "guards": guards}
+
+
+# ---------------------------------------------------------------------------
+# measured mode — child process per device count
+# ---------------------------------------------------------------------------
+
+def _timed(step, *args, steps: int, warmup: int, block=None):
+    """Average post-warmup wall-clock per call; ``block(out)`` fences."""
+    times: list[float] = []
+    out = None
+    for i in range(warmup + steps):
+        t0 = time.perf_counter()
+        out = step(*args)
+        (block or (lambda o: __import__("jax").block_until_ready(o)))(out)
+        if i >= warmup:
+            times.append(time.perf_counter() - t0)
+    return sum(times) / len(times), out
+
+
+def _measure_fsdp_ab(n: int, steps: int, warmup: int) -> list[dict]:
+    """The tentpole A/B: chunked ZeRO-3 with and without the prefetch
+    overlap, same params, same data — mirrors ``train.jobs fsdp``."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.workloads import costmodel as cm
+    from kubeoperator_tpu.workloads.sharding import (
+        MeshSpec, batch_sharding, build_mesh, fsdp_overlapped_loss_fn,
+        fsdp_overlapped_shardings, pack_stages,
+    )
+    from kubeoperator_tpu.workloads.train import peak_flops_per_chip
+
+    d, vocab, layers, lr = 64, 128, 4, 0.1
+    spec = MeshSpec(fsdp=n)
+    mesh = build_mesh(spec)
+    ks = jax.random.split(jax.random.key(0), layers + 2)
+    stages, unpack = pack_stages(
+        [{"w1": jax.random.normal(jax.random.split(k)[0], (d, d)) * 0.1,
+          "w2": jax.random.normal(jax.random.split(k)[1], (d, d)) * 0.1}
+         for k in ks[1:-1]], multiple=n)
+    shd = fsdp_overlapped_shardings(mesh)
+    batch = 8 * n
+    bs = batch_sharding(mesh, spec)
+    x = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch,), 0, vocab), bs)
+    y = jax.device_put(
+        jax.random.randint(jax.random.key(2), (batch,), 0, vocab), bs)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    model_flops = 3 * (layers * 4 * batch * d * d + 2 * batch * d * vocab)
+
+    out: list[dict] = []
+    step_by_mode: dict[str, float] = {}
+    for name, prefetch in (("fsdp-overlap", True), ("fsdp-eager", False)):
+        params = {
+            "embed": jax.device_put(
+                jax.random.normal(ks[0], (vocab, d)) * 0.1, shd["embed"]),
+            "stages": jax.device_put(stages, shd["stages"]),
+            "head": jax.device_put(
+                jax.random.normal(ks[-1], (d, vocab)) * 0.1, shd["head"]),
+        }
+        loss_fn = fsdp_overlapped_loss_fn(
+            mesh,
+            embed_fn=lambda e, t: e[t],
+            stage_fn=lambda p, h: h + jnp.tanh(h @ p["w1"]) @ p["w2"],
+            head_fn=lambda p, h: h @ p,
+            loss_fn=lambda o, t: -jax.nn.log_softmax(o)[
+                jnp.arange(t.shape[0]), t],
+            unpack=unpack, prefetch=prefetch)
+
+        def step_fn(params, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            return jax.tree.map(lambda p, g: p - lr * g, params, grads), loss
+
+        from kubeoperator_tpu.analysis import compile_count_guard
+
+        with compile_count_guard() as guard:
+            step = jax.jit(step_fn, donate_argnums=(0,))
+            times: list[float] = []
+            for i in range(warmup + steps):
+                t0 = time.perf_counter()
+                # params is donated — rebind every call (the loop cannot
+                # reuse a buffer the previous step consumed)
+                params, loss = step(params, x, y)
+                loss.block_until_ready()
+                if i >= warmup:
+                    times.append(time.perf_counter() - t0)
+            step_s = sum(times) / len(times)
+        step_by_mode[name] = step_s
+        model = cm.fsdp_step_model(
+            n_layers=layers, layer_param_bytes=4.0 * stages.shape[1],
+            fwd_flops_per_layer=4.0 * (batch // n) * d * d,
+            n_fsdp=n, peak_flops=peak, overlap=prefetch)
+        att = cm.attribute(step_s, model)
+        prof = cm.profiled_collective_seconds(jax.jit(loss_fn), params, x, y)
+        if prof is not None:
+            att.collective_s, att.source = prof, "profiler"
+        out.append(cm.config_record(
+            config=name, n_devices=n, mesh=dict(spec.sizes()),
+            attribution=att, mfu=model_flops / (peak * n * step_s),
+            compile_counts=guard.by_function(),
+            loss=round(float(loss), 4)))
+    if "fsdp-eager" in step_by_mode:
+        out[0]["measured_speedup"] = round(
+            step_by_mode["fsdp-eager"] / step_by_mode["fsdp-overlap"], 3)
+    return out
+
+
+def _measure_gpipe(n: int, steps: int, warmup: int) -> dict:
+    """GPipe at M and 2M microbatches → two-point measured bubble vs the
+    analytic ``(pp−1)/(M+pp−1)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.workloads import costmodel as cm, pipeline as pipe
+    from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh
+
+    pp = min(4, n)
+    spec = MeshSpec(dp=n // pp, pp=pp)
+    mesh = build_mesh(spec)
+    d, vocab, m = 32, 64, 4
+    ks = jax.random.split(jax.random.key(3), pp + 2)
+    params0 = {
+        "embed": jax.device_put(jax.random.normal(ks[0], (vocab, d)) * 0.1,
+                                NamedSharding(mesh, P())),
+        "stages": jax.device_put(
+            pipe.stack_stages(
+                [{"w1": jax.random.normal(jax.random.split(k)[0], (d, d)) * 0.1,
+                  "w2": jax.random.normal(jax.random.split(k)[1], (d, d)) * 0.1}
+                 for k in ks[1:-1]]),
+            NamedSharding(mesh, P("pp"))),
+        "head": jax.device_put(jax.random.normal(ks[-1], (d, vocab)) * 0.1,
+                               NamedSharding(mesh, P())),
+    }
+    kw = dict(embed_fn=lambda e, t: e[t],
+              stage_fn=lambda p, h: h + jnp.tanh(h @ p["w1"]) @ p["w2"],
+              head_fn=lambda p, h: h @ p,
+              loss_fn=lambda o, t: -jax.nn.log_softmax(o)[
+                  jnp.arange(t.shape[0]), t])
+
+    from kubeoperator_tpu.analysis import compile_count_guard
+
+    times = {}
+    with compile_count_guard() as guard:
+        for micros in (m, 2 * m):
+            loss_fn = pipe.gpipe_loss_fn(mesh, n_micro=micros, **kw)
+            grad = jax.jit(jax.value_and_grad(loss_fn))
+            batch = micros * max(1, spec.dp)
+            x = jax.random.randint(jax.random.key(1), (batch,), 0, vocab)
+            y = jax.random.randint(jax.random.key(2), (batch,), 0, vocab)
+            times[micros], _ = _timed(
+                grad, params0, x, y, steps=steps, warmup=warmup,
+                block=lambda o: o[0].block_until_ready())
+    measured = pipe.bubble_from_timings(times[m], m, times[2 * m], 2 * m, pp)
+    return cm.config_record(
+        config="gpipe", n_devices=n, mesh=dict(spec.sizes()),
+        step_time_s=times[m], microbatches=m,
+        bubble_fraction=round(measured, 4),
+        analytic_bubble_fraction=round(pipe.bubble_fraction(pp, m), 4),
+        compile_counts=guard.by_function())
+
+
+def _measure_ring(n: int, seq: int, steps: int, warmup: int) -> dict:
+    import jax
+
+    from kubeoperator_tpu.workloads import costmodel as cm
+    from kubeoperator_tpu.workloads.ring_attention import (
+        sharded_ring_attention,
+    )
+    from kubeoperator_tpu.workloads.sharding import MeshSpec, build_mesh
+    from kubeoperator_tpu.workloads.train import peak_flops_per_chip
+
+    heads, head_dim = 4, 16
+    mesh = build_mesh(MeshSpec(sp=n))
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    shape = (1, seq, heads, head_dim)
+    q = jax.random.normal(k1, shape)
+    k = jax.random.normal(k2, shape)
+    v = jax.random.normal(k3, shape)
+
+    from kubeoperator_tpu.analysis import compile_count_guard
+
+    with compile_count_guard() as guard:
+        fn = jax.jit(lambda q, k, v: sharded_ring_attention(mesh, q, k, v))
+        step_s, _ = _timed(fn, q, k, v, steps=steps, warmup=warmup)
+    model = cm.ring_attention_model(
+        seq_len=seq, sp=n, batch=1, heads=heads, head_dim=head_dim,
+        peak_flops=peak_flops_per_chip(jax.devices()[0]))
+    return cm.config_record(
+        config=f"ring-attention-{seq // 1024}k", n_devices=n,
+        mesh={"sp": n}, attribution=cm.attribute(step_s, model)
+        if n > 1 else None, step_time_s=step_s, seq_len=seq,
+        compile_counts=guard.by_function())
+
+
+def child_main(n: int, steps: int, warmup: int, full: bool) -> int:
+    """Runs inside the re-exec'd process with n virtual devices."""
+    import jax
+
+    assert len(jax.devices()) == n, \
+        f"expected {n} devices, got {len(jax.devices())}"
+
+    from kubeoperator_tpu.analysis import compile_count_guard
+    from kubeoperator_tpu.workloads import costmodel as cm
+    from kubeoperator_tpu.workloads.sharding import (
+        MeshSpec, with_virtual_slices,
+    )
+
+    records: list[dict] = []
+
+    def run(name: str, fn) -> None:
+        try:
+            rec = fn()
+            records.extend(rec if isinstance(rec, list) else [rec])
+        except Exception as e:  # noqa: BLE001 — per-config isolation
+            print(f"# {name}@{n}: {type(e).__name__}: {e}", file=sys.stderr)
+            records.append(cm.config_record(
+                config=name, n_devices=n, error=f"{type(e).__name__}: {e}"))
+        else:
+            for r in (rec if isinstance(rec, list) else [rec]):
+                print(f"# {r['config']}@{n}: "
+                      f"step={r.get('step_time_s', '-')}s "
+                      f"mfu={r.get('mfu', '-')}", file=sys.stderr)
+
+    def trainer_point(name: str, make, measure) -> dict:
+        with compile_count_guard() as guard:
+            tr = make()
+            res = measure(tr)
+        return cm.config_record(
+            config=name, n_devices=n, mesh=dict(tr.spec.sizes()),
+            step_time_s=res["step_time_ms"] / 1e3, mfu=res["mfu"],
+            compile_counts=guard.by_function())
+
+    def resnet() -> dict:
+        from kubeoperator_tpu.workloads.train import TrainConfig, Trainer
+
+        spec = MeshSpec(dp=n) if n > 1 else MeshSpec()
+        return trainer_point(
+            "resnet",
+            lambda: Trainer(TrainConfig(batch_size=2 * n, image_size=32,
+                                        stem="space_to_depth"), spec),
+            lambda tr: tr.measure(steps=steps, warmup=warmup, repeats=1))
+
+    def transformer() -> dict:
+        from kubeoperator_tpu.workloads.lm import LMTrainer
+        from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+        if n >= 4:
+            spec = MeshSpec(dp=n // 4, tp=2, sp=2)
+        elif n == 2:
+            spec = MeshSpec(dp=1, sp=2)
+        else:
+            spec = MeshSpec(dp=1)
+        cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq_len=64)
+        return trainer_point(
+            "transformer", lambda: LMTrainer(cfg, spec),
+            lambda tr: tr.measure(batch=2 * max(1, spec.dp), seq_len=64,
+                                  steps=steps, warmup=warmup, repeats=1))
+
+    def vit() -> dict:
+        from kubeoperator_tpu.workloads.transformer import TransformerConfig
+        from kubeoperator_tpu.workloads.vit import ViTConfig, ViTTrainer
+
+        spec = MeshSpec(dp=min(2, n), fsdp=n // min(2, n)) \
+            if n > 1 else MeshSpec()
+        cfg = ViTConfig(num_classes=16, image_size=32, patch=8,
+                        encoder=TransformerConfig(d_model=64, n_heads=4,
+                                                  n_layers=2, d_ff=128,
+                                                  causal=False,
+                                                  max_seq_len=16))
+        return trainer_point(
+            "vit", lambda: ViTTrainer(cfg, spec),
+            lambda tr: tr.measure(batch=2 * n, steps=steps, warmup=warmup,
+                                  repeats=1))
+
+    def multislice() -> dict:
+        from kubeoperator_tpu.workloads.lm import LMTrainer
+        from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+        inner = n // 2
+        tp = 2 if inner >= 2 else 1
+        spec = MeshSpec(dp=2, tp=tp, sp=inner // tp)
+        cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq_len=64)
+        vdevs = with_virtual_slices(jax.devices(), 2)
+        rec = trainer_point(
+            "multislice", lambda: LMTrainer(cfg, spec, devices=vdevs),
+            lambda tr: tr.measure(batch=2 * spec.dp, seq_len=64,
+                                  steps=steps, warmup=warmup, repeats=1))
+        rec["slices"] = 2
+        return rec
+
+    def moe() -> dict:
+        from kubeoperator_tpu.workloads.lm import LMTrainer
+        from kubeoperator_tpu.workloads.transformer import TransformerConfig
+
+        spec = MeshSpec(dp=n // 4, ep=2, tp=2)
+        cfg = TransformerConfig(vocab_size=128, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq_len=32,
+                                moe_experts=4)
+        return trainer_point(
+            "moe", lambda: LMTrainer(cfg, spec),
+            lambda tr: tr.measure(batch=2 * max(1, spec.dp), seq_len=32,
+                                  steps=steps, warmup=warmup, repeats=1))
+
+    run("resnet", resnet)
+    run("transformer", transformer)
+    run("vit", vit)
+    if n >= 4:
+        run("multislice", multislice)
+        run("moe", moe)
+    if n >= 2:
+        run("fsdp-overlap", lambda: _measure_fsdp_ab(n, steps, warmup))
+        run("gpipe", lambda: _measure_gpipe(n, steps, warmup))
+    for seq in (SEQ_POINTS if full else SEQ_POINTS[:1]):
+        run(f"ring-attention-{seq // 1024}k",
+            lambda s=seq: _measure_ring(n, s, max(2, steps // 2), warmup))
+
+    from kubeoperator_tpu.telemetry.metrics import record_train_step
+
+    for r in records:
+        if r.get("ok") and r.get("step_time_s"):
+            record_train_step(r["config"], r["step_time_s"], r.get("mfu"),
+                              r.get("collective_seconds"))
+    print(json.dumps({"n_devices": n, "configs": records}))
+    return 0
+
+
+def run_measured(device_counts: list[int], steps: int, warmup: int,
+                 full: bool) -> list[dict]:
+    records: list[dict] = []
+    for n in device_counts:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n} "
+                            + env.get("XLA_FLAGS", "")).strip()
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", str(n),
+               "--steps", str(steps), "--warmup", str(warmup)]
+        if full:
+            cmd.append("--full")
+        print(f"# measuring at {n} device(s) ...", file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              env=env, timeout=1800)
+        sys.stderr.write(proc.stderr)
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if proc.returncode != 0 or line is None:
+            from kubeoperator_tpu.workloads.costmodel import config_record
+            records.append(config_record(
+                config="matrix", n_devices=n,
+                error=f"child exited {proc.returncode}"))
+            continue
+        records.extend(json.loads(line)["configs"])
+    return records
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--cost-model", action="store_true",
+                    help="price the reference schedules analytically "
+                         "(no devices; what the tier-1 guard runs)")
+    ap.add_argument("--devices", default="1,2,4,8",
+                    help="comma-separated device counts to sweep")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--full", action="store_true",
+                    help="measured mode: ring attention at 16k/32k too")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact JSON here (e.g. "
+                         "MULTICHIP_bench_r01.json)")
+    ap.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child is not None:
+        return child_main(args.child, args.steps, args.warmup, args.full)
+
+    device_counts = sorted({int(x) for x in args.devices.split(",")})
+    artifact: dict = {
+        "bench": "multichip",
+        "mode": "cost-model" if args.cost_model else "measured",
+        "devices": device_counts,
+    }
+    if args.cost_model:
+        priced = cost_model_records(device_counts)
+        artifact["configs"] = priced["records"]
+        artifact["guards"] = priced["guards"]
+    else:
+        artifact["configs"] = run_measured(device_counts, args.steps,
+                                           args.warmup, args.full)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print(f"# wrote {args.out} ({len(artifact['configs'])} configs)",
+              file=sys.stderr)
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,11 @@
+"""Corpus: collective in an unrolled loop (KO130) — one all-gather per
+layer that XLA can never overlap with the previous layer's compute."""
+import jax
+from jax import lax
+
+
+def zero3_forward(layer_shards, h):
+    for shard in layer_shards:                       # unrolled over layers
+        w = lax.all_gather(shard, "fsdp", tiled=True)   # KO130
+        h = jax.nn.tanh(h @ w)
+    return lax.pmean(h, "dp")                        # outside the loop: fine

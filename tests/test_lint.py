@@ -33,6 +33,7 @@ GOLDEN = {
     "bad_closure.py": {"KO113"},
     "bad_unpinned.py": {"KO120"},
     "bad_page_write.py": {"KO121"},
+    "bad_collective_loop.py": {"KO130"},
     "bad_locking.py": {"KO201"},
     "bad_metric.py": {"KO210"},
     "bad_pragma.py": {"KO000", "KO001", "KO201"},

@@ -21,7 +21,8 @@ def _queried_metric_names() -> set[str]:
     names: set[str] = set()
     for expr in mon.PROMQL.values():
         names |= set(re.findall(
-            r"\b((?:node|tpu|container|ko_serve)_[a-zA-Z0-9_]+)\b", expr))
+            r"\b((?:node|tpu|container|ko_serve|ko_train)_[a-zA-Z0-9_]+)\b",
+            expr))
     return names
 
 
@@ -46,6 +47,12 @@ def test_every_queried_metric_has_a_deployed_exporter():
             assert "job_name: ko-serve" in prom, metric
             serve = manifests.render_app("jax-serve", registry="r")
             assert "jobs" in serve and "8080" in serve, metric
+        elif exporter == "jax-train":
+            # the train jobs' registry exposition: a scrape job keyed on
+            # the trainer app label, and the chart passing --metrics-port
+            assert "job_name: ko-train" in prom, metric
+            train = manifests.render_app("jax-llm-train", registry="r")
+            assert "--metrics-port" in train and "8080" in train, metric
         else:  # a new exporter kind must come with its own manifest check
             raise AssertionError(f"no manifest check for exporter {exporter!r}")
     # the Loki log queries need promtail shipping pod logs

@@ -216,6 +216,8 @@ def test_hybrid_mesh_real_constructor_and_execution():
     x = jax.device_put(jnp.arange(8.0).reshape(2, 4),
                        NamedSharding(mesh, P("dp", "tp")))
 
-    total = jax.shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+    from kubeoperator_tpu.workloads._jax_compat import shard_map
+
+    total = shard_map(lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
                       in_specs=P("dp", "tp"), out_specs=P("dp", None))(x)
     np.testing.assert_allclose(np.asarray(total).ravel(), [6.0, 22.0])
