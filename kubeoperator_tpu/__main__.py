@@ -39,7 +39,9 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from kubeoperator_tpu.api.app import ensure_admin, run_server
-    from kubeoperator_tpu.services import backups, healing, ldap_auth, monitor
+    from kubeoperator_tpu.services import (
+        autoscaler, backups, healing, ldap_auth, monitor,
+    )
     from kubeoperator_tpu.services.platform import Platform
 
     platform = Platform()
@@ -49,6 +51,7 @@ def main(argv: list[str] | None = None) -> int:
         backups.schedule(platform)
         ldap_auth.schedule(platform)
         healing.schedule(platform)
+        autoscaler.schedule(platform)
     try:
         run_server(platform, host=args.host, port=args.port)
     finally:

@@ -755,6 +755,15 @@ async def dashboard(request: web.Request) -> web.Response:
                        request.match_info.get("item", ""))
     return web.json_response(data)
 
+async def autoscale_status(request: web.Request) -> web.Response:
+    from kubeoperator_tpu.services import autoscaler as autoscaler_svc
+    platform: Platform = request.app["platform"]
+    rows = await _sync(request, autoscaler_svc.autoscale_status, platform)
+    visible = await _sync(request, visible_cluster_names, request)
+    if visible is not None:
+        rows = [r for r in rows if r["cluster"] in visible]
+    return web.json_response(rows)
+
 
 # ---------------------------------------------------------------------------
 # hosts
@@ -1205,6 +1214,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/tasks/{id}", get_task)
     r.add_get("/api/v1/schema", openapi_schema)
     r.add_get("/api/v1/dashboard/{item}", dashboard)
+    r.add_get("/api/v1/autoscale/status", autoscale_status)
     r.add_get("/api/v1/logs", search_system_logs)
     r.add_get("/api/v1/events", search_cluster_events)
 

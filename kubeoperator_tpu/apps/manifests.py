@@ -301,7 +301,8 @@ data:
                     {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}},
       {{"title": "SLO burn rate (by slo, fast/slow window)", "type": "timeseries", "gridPos": {{"x":12,"y":24,"w":12,"h":8}},
         "targets": [{{"expr": "ko_slo_burn_rate", "legendFormat": "{{{{slo}}}} {{{{window}}}}"}},
-                    {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment"}}]}},
+                    {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment"}},
+                    {{"expr": "sum(rate(ko_serve_requests_requeued_total[5m])) by (reason)", "legendFormat": "requeued {{{{reason}}}}"}}]}},
       {{"title": "TTFT decomposition: queue vs device vs host-blocked", "type": "timeseries", "gridPos": {{"x":0,"y":32,"w":12,"h":8}},
         "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}},
                     {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_segment_device_seconds_bucket[5m])) by (le))"}},

@@ -75,6 +75,17 @@ DEFAULTS: dict[str, Any] = {
     "serve_slos": {},
     "slo_fast_window": 12,                  # ~1 h at the 5-min beat
     "slo_slow_window": 72,                  # ~6 h
+    # autoscaler (ISSUE 11): the beat that acts on the SLO block. Opt-in
+    # per deployment via the `autoscale` setting ("true"), like auto_heal.
+    "autoscale_interval": 300,              # judge once per monitor beat
+    "autoscale_min_workers": 1,             # pool bounds (plain workers)
+    "autoscale_max_workers": 8,
+    "autoscale_step": 1,                    # workers added/removed per action
+    # hysteresis: no second scale action within the cooldown, and a
+    # scale-down additionally needs this many consecutive all-ok beats —
+    # breach-flapping must not thrash terraform
+    "autoscale_cooldown_s": 1800.0,
+    "autoscale_down_after": 6,
     "backup_hour": 1,
     # executor selection: "ssh" | "fake"
     "executor": "ssh",

@@ -303,6 +303,23 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_autoscale(args) -> int:
+    """``ko autoscale status`` — one row per AUTOMATIC cluster: the latest
+    SLO verdict the beat would act on, the pending/desired state, and the
+    hysteresis cooldown remaining."""
+    rows = Client().call("GET", "/api/v1/autoscale/status")
+    for r in rows:
+        r["slos"] = ",".join(f"{k}={v}" for k, v in sorted(r["slos"].items())) \
+            or "(none configured)"
+        r["enabled"] = "on" if r["enabled"] else "off"
+        r["pending"] = (r.get("pending_execution") or "") + \
+            (" (rollback)" if r.get("rolling_back") else "")
+        r["cooldown"] = f"{r['cooldown_remaining_s']:.0f}s"
+    table(rows, ["cluster", "enabled", "verdict", "slos", "desired",
+                 "ok_streak", "pending", "cooldown"])
+    return 0
+
+
 def cmd_lint(args) -> int:
     # local static analysis — no controller, no login
     from kubeoperator_tpu.analysis.cli import run_lint
@@ -377,6 +394,10 @@ def build_parser(sub) -> None:
     tk.set_defaults(fn=cmd_tasks)
     sub.add_parser("packages", help="list offline packages").set_defaults(fn=cmd_packages)
     sub.add_parser("dashboard", help="fleet summary").set_defaults(fn=cmd_dashboard)
+
+    scale = sub.add_parser("autoscale", help="SLO-driven autoscaler state")
+    scale.add_argument("action", choices=("status",))
+    scale.set_defaults(fn=cmd_autoscale)
 
     lint = sub.add_parser(
         "lint", help="static hot-path / control-plane analyzer")

@@ -567,7 +567,11 @@ class ChaosExecutor(Executor):
       probability ``rate`` (seeded RNG → reproducible sequences);
     * ``latency_s``              — fixed injected delay per command;
     * ``kill_after(ip, n)``      — the host dies mid-operation after ``n``
-      more commands and stays dead (``revive`` brings it back).
+      more commands and stays dead (``revive`` brings it back);
+    * ``revoke_slice(slice_id, ips)`` — preemptible-TPU revocation: every
+      member host of the slice drops dead at once, mid-decode, the way
+      GCE reclaims a preemptible v5e slice (``restore_slice`` models the
+      replacement slice coming up after the pool re-converges).
 
     The RNG seeds from ``KO_CHAOS_SEED`` (default 1337) so CI failures
     replay exactly; ``injected``/``calls`` counters let tests assert both
@@ -587,6 +591,7 @@ class ChaosExecutor(Executor):
         self._flakes: list[tuple[re.Pattern, float]] = []
         self._kill: dict[str, int] = {}      # ip -> commands until death
         self._dead: set[str] = set()
+        self._revoked: dict[str, set[str]] = {}  # slice_id -> member ips
         self.calls = 0
         self.injected = 0
 
@@ -611,6 +616,36 @@ class ChaosExecutor(Executor):
         with self._lock:
             self._dead.discard(ip)
             self._kill.pop(ip, None)
+
+    def revoke_slice(self, slice_id: str, ips: list[str]) -> None:
+        """Preemptible-TPU revocation: the whole slice vanishes at once.
+
+        Unlike ``kill_after`` (one host, after a countdown) this is the
+        cloud reclaiming a multi-host slice with zero warning — every
+        member IP goes dead in the same instant, so an in-flight decode
+        step fails on all of the slice's shards together. Recorded once
+        as ``slice_revoked`` plus one ``host_dead``-style kill per member.
+        """
+        with self._lock:
+            members = {ip for ip in ips if ip not in self._dead}
+            self._revoked[slice_id] = set(ips)
+            self._dead |= members
+            self._record("slice_revoked", slice_id)
+
+    def restore_slice(self, slice_id: str) -> list[str]:
+        """The replacement slice is up (pool re-converged): revive every
+        member recorded by ``revoke_slice`` and return their IPs."""
+        with self._lock:
+            ips = sorted(self._revoked.pop(slice_id, ()))
+            for ip in ips:
+                self._dead.discard(ip)
+                self._kill.pop(ip, None)
+            return ips
+
+    @property
+    def revoked_slices(self) -> list[str]:
+        with self._lock:
+            return sorted(self._revoked)
 
     # -- fault evaluation --------------------------------------------------
     def _record(self, kind: str, ip: str) -> None:

@@ -88,3 +88,26 @@ def _machine_type(model) -> str:
     if model is None:
         return "e2-standard-4"
     return f"custom-{model.cpu}-{model.memory_gb * 1024}"
+
+
+def scale_pool_counts(pools: list[dict], delta: int,
+                      lo: int, hi: int) -> list[dict] | None:
+    """The autoscaler's slice-pool lever: a copy of ``pools`` (the
+    ``tpu_pools`` execution param, dict form) with the first pool's
+    ``count`` adjusted by ``delta`` and clamped to ``[lo, hi]``.
+
+    Returns None when clamping makes the adjustment a no-op — the caller
+    records a bounds skip instead of emitting an empty converge. One
+    pool per action on purpose: each slice is an atomic terraform
+    resource, and growing one pool at a time keeps every converge's
+    blast radius to a single ``google_tpu_v2_vm`` create/destroy.
+    """
+    if not pools:
+        return None
+    new = [dict(p) for p in pools]
+    cur = int(new[0].get("count", 1))
+    want = max(lo, min(hi, cur + delta))
+    if want == cur:
+        return None
+    new[0]["count"] = want
+    return new

@@ -1,0 +1,296 @@
+"""SLO-driven autoscaler: the actuator half of the control loop.
+
+The monitor beat (services/monitor.py) already judges every configured
+serve SLO over fast/slow sliding windows and persists the verdict as the
+``slo`` block of each cluster's MonitorSnapshot — burn-rate gauges plus
+breach-edge events. This beat *acts* on it:
+
+* a fast-window **breach** (burn ≥ 1.0 over a full ``slo_fast_window``
+  of points — sustained by construction, the short-history guard means
+  a lone bad beat can never trigger it) schedules a **scale-up** through
+  the ordinary operation engine: ``create_execution(cluster, "scale")``
+  with the current sizing params grown one step — the first TPU pool's
+  ``count`` when the cluster serves from slice pools
+  (providers/gce_tpu.py renders each slice as one atomic terraform
+  resource), else ``worker_size``;
+* ``autoscale_down_after`` consecutive all-ok beats schedule a
+  **scale-down** one step, never below ``autoscale_min_workers``;
+* a scheduled action is tracked to completion: execution SUCCESS counts
+  as ``converged``; FAILURE (a failed post-check — the scale operation's
+  own verify steps) **rolls back** by re-emitting the prior sizing, so
+  desired state never sticks at a size the cluster couldn't reach;
+* hysteresis: no second action within ``autoscale_cooldown_s``, pool
+  bounds clamp every step, and the single-mutator guard
+  (services/mutation.py) is shared with the healing beat — at most one
+  desired-state mutation per cluster, never while an execution runs.
+
+Opt-in per deployment via the ``autoscale`` setting ("true"), mirroring
+``auto_heal``. Everything the beat decides is exported as
+``ko_autoscale_*`` metrics and readable via ``ko autoscale status``.
+
+Serving-plane counterpart: scale actions change topology under live
+decodes. The batcher side of that story is
+``ContinuousBatcher.drain(shards)`` / ``readmit()`` — in-flight requests
+on the leaving shards are snapshotted and requeued, not dropped (see
+workloads/serving.py); the chaos soak drives both halves together.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeoperator_tpu.providers.gce_tpu import scale_pool_counts
+from kubeoperator_tpu.resources.entities import (
+    Cluster, ClusterStatus, DeployExecution, DeployType, ExecutionState, Node,
+    Plan,
+)
+from kubeoperator_tpu.services.healing import _current_sizing
+from kubeoperator_tpu.services.monitor import MonitorSnapshot
+from kubeoperator_tpu.services.mutation import execution_busy, mutation_slot
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+
+# -- persisted per-cluster state (a MonitorSnapshot sibling record) ---------
+
+def _load_state(platform, cluster: Cluster) -> MonitorSnapshot:
+    found = platform.store.find(MonitorSnapshot, scoped=False,
+                                name=f"{cluster.name}:autoscaler")
+    return found[0] if found else MonitorSnapshot(
+        project=cluster.name, name=f"{cluster.name}:autoscaler")
+
+
+def _save_state(platform, rec: MonitorSnapshot) -> None:
+    platform.store.save(rec)
+
+
+def _current_workers(platform, cluster: Cluster, sizing: dict) -> int:
+    if "worker_size" in sizing:
+        return int(sizing["worker_size"])
+    return sum(1 for n in platform.store.find(Node, scoped=False,
+                                              project=cluster.name)
+               if "master" not in n.roles)
+
+
+def _effective_sizing(platform, cluster: Cluster) -> dict:
+    """The cluster's CURRENT sizing: the last successful install/scale's
+    params (healing's ``_current_sizing``), backfilled from the plan for
+    keys no execution ever set — a param-less install means "the plan
+    default", and a scale step must grow from that, not from the floor."""
+    sizing = _current_sizing(platform, cluster)
+    plan = (platform.store.get(Plan, cluster.plan_id, scoped=False)
+            if cluster.plan_id else None)
+    if plan is not None:
+        if "worker_size" not in sizing and plan.worker_size:
+            sizing["worker_size"] = plan.worker_size
+        if "tpu_pools" not in sizing and plan.tpu_pools:
+            sizing["tpu_pools"] = [dict(p) for p in plan.tpu_pools]
+    return sizing
+
+
+def _slo_verdict(platform, cluster: Cluster) -> tuple[str, dict]:
+    """("breach" | "ok" | "no_data", slo block) from the latest persisted
+    monitor snapshot — the autoscaler never talks to Prometheus itself."""
+    found = platform.store.find(MonitorSnapshot, scoped=False,
+                                name=cluster.name)
+    block = (found[0].data.get("slo") if found else None) or {}
+    slos = block.get("slos") or {}
+    states = [s.get("state") for s in slos.values()]
+    if any(s == "breach" for s in states):
+        return "breach", block
+    if states and all(s == "ok" for s in states):
+        return "ok", block
+    return "no_data", block
+
+
+def _scale_params(sizing: dict, direction: str, cfg) -> tuple[dict, int] | None:
+    """(params for the scale execution, resulting size) one step in
+    ``direction``, or None when pool bounds clamp it to a no-op."""
+    step = int(cfg.get("autoscale_step", 1))
+    delta = step if direction == "up" else -step
+    lo = int(cfg.get("autoscale_min_workers", 1))
+    hi = int(cfg.get("autoscale_max_workers", 8))
+    if sizing.get("tpu_pools"):
+        pools = scale_pool_counts(sizing["tpu_pools"], delta, lo, hi)
+        if pools is None:
+            return None
+        return {**sizing, "tpu_pools": pools}, int(pools[0]["count"])
+    cur = int(sizing.get("worker_size", lo))
+    want = max(lo, min(hi, cur + delta))
+    if want == cur:
+        return None
+    return {**sizing, "worker_size": want}, want
+
+
+def _emit_scale(platform, cluster: Cluster, params: dict,
+                direction: str) -> DeployExecution | None:
+    """Create + start one scale execution under the shared mutation slot;
+    None when the slot was refused or preflight rejected the params."""
+    with mutation_slot(platform, cluster) as claimed:
+        if not claimed:
+            tm.AUTOSCALE_SKIPS.inc(cluster=cluster.name, reason="guard")
+            return None
+        try:
+            ex = platform.create_execution(cluster.name, "scale", params)
+        except Exception as e:  # noqa: BLE001 — per-cluster boundary
+            log.warning("[%s] autoscale %s refused: %s",
+                        cluster.name, direction, e)
+            return None
+        platform.start_execution(ex)
+    return ex
+
+
+def _resolve_pending(platform, cluster: Cluster, st: dict, now: float) -> bool:
+    """Track the in-flight scale action. True = still pending (skip the
+    cluster this tick); False = resolved, the beat may judge again."""
+    exid = st.get("pending")
+    if not exid:
+        return False
+    direction = st.get("pending_direction", "up")
+    ex = platform.store.get(DeployExecution, exid, scoped=False)
+    state = ex.state if ex is not None else ExecutionState.FAILURE
+    if state in (ExecutionState.PENDING, ExecutionState.STARTED):
+        return True
+    if state == ExecutionState.SUCCESS:
+        outcome = ("rolled_back" if st.get("rolling_back") else "converged")
+        tm.AUTOSCALE_ACTIONS.inc(cluster=cluster.name, direction=direction,
+                                 outcome=outcome)
+        st.update(pending=None, rolling_back=False, prior_sizing=None)
+        return False
+    # FAILURE: the scale's own post-checks refused the new size
+    if st.get("rolling_back"):
+        tm.AUTOSCALE_ACTIONS.inc(cluster=cluster.name, direction=direction,
+                                 outcome="rollback_failed")
+        platform.notify(
+            title=f"cluster {cluster.name}: autoscale rollback FAILED — "
+                  f"desired state needs operator attention",
+            level="ERROR", project=cluster.name,
+            content={"execution": exid, "direction": direction})
+        st.update(pending=None, rolling_back=False, prior_sizing=None)
+        return False
+    prior = st.get("prior_sizing") or {}
+    ex2 = _emit_scale(platform, cluster, prior, direction)
+    if ex2 is None:
+        return True                      # slot busy — retry the rollback
+    log.warning("[%s] autoscale %s failed post-checks; rolling back to %s",
+                cluster.name, direction, prior)
+    platform.notify(
+        title=f"cluster {cluster.name}: autoscale {direction} rolled back",
+        level="WARNING", project=cluster.name,
+        content={"failed_execution": exid, "rollback_execution": ex2.id,
+                 "restored": prior})
+    if prior.get("worker_size") is not None:
+        tm.AUTOSCALE_DESIRED_WORKERS.set(float(prior["worker_size"]),
+                                         cluster=cluster.name)
+    st.update(pending=ex2.id, rolling_back=True, last_action_at=now)
+    return True
+
+
+def autoscale_tick(platform, now: float | None = None) -> list[str]:
+    """Returns ``"<cluster>:<direction>"`` for every action scheduled this
+    tick (tests/observability)."""
+    if platform.setting("autoscale", "false").lower() != "true":
+        return []
+    now = time.time() if now is None else now
+    cfg = platform.config
+    cooldown = float(cfg.get("autoscale_cooldown_s", 1800.0))
+    down_after = int(cfg.get("autoscale_down_after", 6))
+    actions: list[str] = []
+    for cluster in platform.store.find(Cluster, scoped=False):
+        if (cluster.deploy_type != DeployType.AUTOMATIC
+                or cluster.status not in (ClusterStatus.RUNNING,
+                                          ClusterStatus.WARNING)):
+            continue
+        rec = _load_state(platform, cluster)
+        st = rec.data
+        if _resolve_pending(platform, cluster, st, now):
+            _save_state(platform, rec)
+            continue
+        verdict, _block = _slo_verdict(platform, cluster)
+        st["ok_streak"] = (st.get("ok_streak", 0) + 1 if verdict == "ok"
+                           else 0)
+        direction = ("up" if verdict == "breach"
+                     else "down" if st["ok_streak"] >= down_after
+                     else None)
+        last = float(st.get("last_action_at") or 0.0)
+        # cooldown only counts from a real action — a fresh state has none
+        remaining = max(0.0, last + cooldown - now) if last else 0.0
+        tm.AUTOSCALE_COOLDOWN.set(round(remaining, 1), cluster=cluster.name)
+        if direction is None:
+            _save_state(platform, rec)
+            continue
+        if remaining > 0:
+            tm.AUTOSCALE_SKIPS.inc(cluster=cluster.name, reason="cooldown")
+            _save_state(platform, rec)
+            continue
+        if execution_busy(platform, cluster):
+            tm.AUTOSCALE_SKIPS.inc(cluster=cluster.name, reason="busy")
+            _save_state(platform, rec)
+            continue
+        sizing = _effective_sizing(platform, cluster)
+        scaled = _scale_params(sizing, direction, cfg)
+        if scaled is None:
+            tm.AUTOSCALE_SKIPS.inc(cluster=cluster.name, reason="bounds")
+            _save_state(platform, rec)
+            continue
+        params, size = scaled
+        prior = dict(sizing)
+        prior.setdefault("worker_size",
+                         _current_workers(platform, cluster, sizing))
+        ex = _emit_scale(platform, cluster, params, direction)
+        if ex is None:
+            _save_state(platform, rec)
+            continue
+        tm.AUTOSCALE_ACTIONS.inc(cluster=cluster.name, direction=direction,
+                                 outcome="scheduled")
+        tm.AUTOSCALE_DESIRED_WORKERS.set(float(size), cluster=cluster.name)
+        st.update(pending=ex.id, pending_direction=direction,
+                  prior_sizing=prior, rolling_back=False,
+                  last_action_at=now, desired=size, ok_streak=0)
+        platform.notify(
+            title=f"cluster {cluster.name}: autoscale {direction} -> {size}",
+            level="WARNING", project=cluster.name,
+            content={"execution": ex.id, "direction": direction,
+                     "params": params})
+        log.warning("[%s] autoscale %s -> %s (execution %s)",
+                    cluster.name, direction, size, ex.id)
+        actions.append(f"{cluster.name}:{direction}")
+        _save_state(platform, rec)
+    return actions
+
+
+def autoscale_status(platform) -> list[dict[str, Any]]:
+    """Read path for ``ko autoscale status`` / the API: one row per
+    AUTOMATIC cluster with the latest verdict and the beat's own state."""
+    enabled = platform.setting("autoscale", "false").lower() == "true"
+    cooldown = float(platform.config.get("autoscale_cooldown_s", 1800.0))
+    now = time.time()
+    rows: list[dict[str, Any]] = []
+    for cluster in platform.store.find(Cluster, scoped=False):
+        if cluster.deploy_type != DeployType.AUTOMATIC:
+            continue
+        st = _load_state(platform, cluster).data
+        verdict, block = _slo_verdict(platform, cluster)
+        last = float(st.get("last_action_at") or 0.0)
+        remaining = max(0.0, last + cooldown - now) if last else 0.0
+        rows.append({
+            "cluster": cluster.name,
+            "enabled": enabled,
+            "verdict": verdict,
+            "slos": {name: s.get("state")
+                     for name, s in (block.get("slos") or {}).items()},
+            "desired": st.get("desired"),
+            "ok_streak": st.get("ok_streak", 0),
+            "pending_execution": st.get("pending"),
+            "rolling_back": bool(st.get("rolling_back")),
+            "cooldown_remaining_s": round(remaining, 1),
+        })
+    return rows
+
+
+def schedule(platform) -> None:
+    platform.tasks.every(platform.config.get("autoscale_interval", 300),
+                         "autoscale", lambda: autoscale_tick(platform))

@@ -29,6 +29,7 @@ from kubeoperator_tpu.resources.entities import (
     HealthRecord, Host, Node,
 )
 from kubeoperator_tpu.providers.base import remove_auto_host
+from kubeoperator_tpu.services.mutation import execution_busy, mutation_slot
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -46,20 +47,6 @@ def _consistently_down(platform, cluster: Cluster, host: Host) -> bool:
     recs = sorted(recs, key=lambda r: r.hour, reverse=True)[:CONSECUTIVE_BAD_HOURS]
     return (len(recs) == CONSECUTIVE_BAD_HOURS
             and all(not r.healthy for r in recs))
-
-
-def _busy(platform, cluster: Cluster) -> bool:
-    """A STARTED row only counts as busy while its task is actually live —
-    an orphaned row from a controller restart must not disable healing
-    forever (create_execution applies the same stale test)."""
-    for e in platform.store.find(DeployExecution, scoped=False,
-                                 project=cluster.name):
-        if e.state not in (ExecutionState.PENDING, ExecutionState.STARTED):
-            continue
-        rec = platform.tasks.tasks.get(e.id)
-        if rec is not None and rec.state in ("PENDING", "STARTED"):
-            return True
-    return False
 
 
 def _current_sizing(platform, cluster: Cluster) -> dict:
@@ -170,7 +157,7 @@ def heal_tick(platform) -> list[str]:
         if (cluster.deploy_type != DeployType.AUTOMATIC
                 or cluster.status not in (ClusterStatus.RUNNING,
                                           ClusterStatus.WARNING)
-                or _busy(platform, cluster)):
+                or execution_busy(platform, cluster)):
             continue
         for node in platform.store.find(Node, scoped=False, project=cluster.name):
             host = platform.store.get(Host, node.host_id, scoped=False)
@@ -183,7 +170,11 @@ def heal_tick(platform) -> list[str]:
                 if ("master" not in node.roles and host.tpu_slice_id
                         and platform.setting("auto_heal_slices",
                                              "false").lower() == "true"):
-                    replaced = _heal_slice(platform, cluster, host)
+                    with mutation_slot(platform, cluster) as claimed:
+                        # losing the slot reads as "could not schedule
+                        # this tick" — the retry-next-tick path below
+                        replaced = (_heal_slice(platform, cluster, host)
+                                    if claimed else [])
                     if replaced:
                         healed += replaced
                         break        # one heal per cluster per tick
@@ -210,18 +201,22 @@ def heal_tick(platform) -> list[str]:
             # successful install/scale, else an operator's earlier
             # `scale worker_size=3` would shrink back to the plan default,
             # draining healthy workers.
-            try:
-                ex = platform.create_execution(cluster.name, "scale",
-                                               _current_sizing(platform, cluster))
-            except Exception as e:  # noqa: BLE001 — per-cluster boundary
-                log.warning("[%s] auto-heal for %s could not schedule: %s",
-                            cluster.name, host.name, e)
-                continue
-            log.warning("[%s] auto-heal: replacing dead worker %s",
-                        cluster.name, host.name)
-            remove_auto_host(platform.store, node, host)
-            _drop_health_history(platform, cluster, host.name)
-            platform.start_execution(ex)
+            with mutation_slot(platform, cluster) as claimed:
+                if not claimed:      # another beat got there first: retry
+                    continue         # next tick if the host is still down
+                try:
+                    ex = platform.create_execution(
+                        cluster.name, "scale",
+                        _current_sizing(platform, cluster))
+                except Exception as e:  # noqa: BLE001 — per-cluster boundary
+                    log.warning("[%s] auto-heal for %s could not schedule: %s",
+                                cluster.name, host.name, e)
+                    continue
+                log.warning("[%s] auto-heal: replacing dead worker %s",
+                            cluster.name, host.name)
+                remove_auto_host(platform.store, node, host)
+                _drop_health_history(platform, cluster, host.name)
+                platform.start_execution(ex)
             platform.notify(
                 title=f"cluster {cluster.name}: auto-heal replacing {host.name}",
                 level="WARNING", project=cluster.name,

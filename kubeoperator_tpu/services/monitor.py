@@ -53,6 +53,8 @@ QUERIED_METRICS = {
     # paged KV cache (round 8): page-pool pressure + prefix-cache payoff
     "ko_serve_kv_pages_used": "jax-serve",
     "ko_serve_prefix_hits_total": "jax-serve",
+    # autoscaler (round 11): in-flight requests requeued by drain/preemption
+    "ko_serve_requests_requeued_total": "jax-serve",
     # multi-chip training (round 10): step time, MFU, and the collective
     # attribution the train jobs publish on --metrics-port
     "ko_train_step_seconds_bucket": "jax-train",
@@ -87,6 +89,10 @@ PROMQL = {
     # the prefix cache's hit rate (skipped prefills per second)
     "serve_kv_pages_used": "sum(ko_serve_kv_pages_used)",
     "serve_prefix_hit_rate": "sum(rate(ko_serve_prefix_hits_total[5m]))",
+    # autoscaler (round 11): drain/preemption requeue pressure — a sustained
+    # nonzero rate means topology churn is recycling in-flight decodes
+    "serve_requeued_rate":
+        "sum(rate(ko_serve_requests_requeued_total[5m]))",
     # training plane (round 10): the fsdp/pipeline jobs' step-time p95,
     # fleet MFU, and where the collective seconds go by family — the same
     # split bench_multichip attributes per config
@@ -133,10 +139,18 @@ def _slo_series(points: list[dict], key: str, scale: float) -> list[float | None
 
 
 def _burn(vals: list[float | None], target: float,
-          budget: float) -> float | None:
+          budget: float, window: int | None = None) -> float | None:
     """Error-budget burn over one window: the fraction of known points
     breaching the target, divided by the budget (1 - objective). 1.0 burns
-    exactly the whole budget within the window; None = no data at all."""
+    exactly the whole budget within the window; None = no data at all.
+
+    With ``window`` set, a history shorter than the window is unjudgeable
+    (None): one bad first beat would otherwise read as 100% of the budget
+    burned and fire a spurious breach edge before any trend exists."""
+    if window is not None:
+        if len(vals) < window:
+            return None
+        vals = vals[-window:]
     known = [v for v in vals if v is not None]
     if not known:
         return None
@@ -154,7 +168,9 @@ def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
     ``state`` is ok | breach | no_data and each event is one breach-edge
     (ok→breach or breach→ok) introduced by the newest point — derived by
     re-judging the fast window without it, so the beat needs no cross-tick
-    state."""
+    state. A history shorter than a burn window leaves that window
+    ``no_data`` (no spurious breach edge on a cluster's first beats);
+    attainment is still reported over whatever known points exist."""
     slos: dict[str, dict] = {}
     events: list[dict] = []
     for name in sorted(spec):
@@ -172,8 +188,8 @@ def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
         key, scale = sig
         budget = max(1e-9, 1.0 - objective)
         vals = _slo_series(points, key, scale)
-        burn_fast = _burn(vals[-fast_window:], target, budget)
-        burn_slow = _burn(vals[-slow_window:], target, budget)
+        burn_fast = _burn(vals, target, budget, window=fast_window)
+        burn_slow = _burn(vals, target, budget, window=slow_window)
         known_slow = [v for v in vals[-slow_window:] if v is not None]
         attainment = (round(sum(1 for v in known_slow if v <= target)
                             / len(known_slow), 4) if known_slow else None)
@@ -184,7 +200,7 @@ def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
                 "breach" if b >= 1.0 else "ok"
 
         state = _state(burn_fast)
-        prev = _state(_burn(vals[:-1][-fast_window:], target, budget)
+        prev = _state(_burn(vals[:-1], target, budget, window=fast_window)
                       if len(vals) > 1 else None)
         if state != prev and "breach" in (state, prev):
             events.append({
@@ -418,6 +434,7 @@ class ClusterMonitor:
         serve_ttft = prom.scalar_or_none(PROMQL["serve_ttft_p95"])
         serve_pages = prom.scalar_or_none(PROMQL["serve_kv_pages_used"])
         serve_hit_rate = prom.scalar_or_none(PROMQL["serve_prefix_hit_rate"])
+        serve_requeued = prom.scalar_or_none(PROMQL["serve_requeued_rate"])
         # training plane: None marks "no train job publishing metrics"
         train_step_p95 = prom.scalar_or_none(PROMQL["train_step_p95"])
         train_mfu = prom.scalar_or_none(PROMQL["train_mfu"])
@@ -449,6 +466,7 @@ class ClusterMonitor:
             "serve_ttft_p95": serve_ttft,
             "serve_kv_pages_used": serve_pages,
             "serve_prefix_hit_rate": serve_hit_rate,
+            "serve_requeued_rate": serve_requeued,
             "train_step_p95": train_step_p95,
             "train_mfu": train_mfu,
             "train_collective_rate": train_coll_rate,
@@ -487,6 +505,7 @@ class ClusterMonitor:
                        "serve_ttft_p95": data["serve_ttft_p95"],
                        "serve_kv_pages_used": data["serve_kv_pages_used"],
                        "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
+                       "serve_requeued_rate": data["serve_requeued_rate"],
                        "train_step_p95": data["train_step_p95"],
                        "train_mfu": data["train_mfu"],
                        "pod_count": data["pod_count"]})

@@ -1,0 +1,85 @@
+"""Single-mutator guard for desired-state changes.
+
+Two beats can now mutate a cluster's desired state — the healing beat
+(replace a dead worker / slice) and the autoscaler (grow or shrink the
+pool). Each already refused to act while an execution was running, but
+each checked *independently*: healing's check and the autoscaler's check
+could both pass in the same instant, then both call
+``create_execution`` — two concurrent terraform converges against one
+state file. This module makes the check-and-claim atomic:
+
+* :func:`execution_busy` — the stale-row-tolerant "is an execution live
+  for this cluster" test (extracted from the healing beat, which grew it
+  first);
+* :func:`mutation_slot` — a context manager that atomically claims the
+  cluster for one desired-state mutation. At most one holder per
+  cluster per process, and the claim is refused while an execution
+  runs — so the window between ``create_execution`` and
+  ``start_execution`` (rows exist, task not yet submitted) is covered
+  too, which the busy test alone cannot see.
+
+The slot is process-local (a lock + set on the platform object). That is
+the right scope: beats run on this controller's TaskEngine, and the
+cross-process story is already handled by terraform's own state locking.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator
+
+from kubeoperator_tpu.resources.entities import (
+    Cluster, DeployExecution, ExecutionState,
+)
+
+# guards the lazy creation of the per-platform lock/set; never held while
+# user code runs
+_init_lock = threading.Lock()
+
+
+def _state(platform) -> tuple[threading.Lock, set]:
+    with _init_lock:
+        if not hasattr(platform, "_mutation_lock"):
+            platform._mutation_lock = threading.Lock()
+            platform._mutating = set()
+    return platform._mutation_lock, platform._mutating
+
+
+def execution_busy(platform, cluster: Cluster) -> bool:
+    """A STARTED row only counts as busy while its task is actually live —
+    an orphaned row from a controller restart must not disable healing
+    (or autoscaling) forever; ``create_execution`` applies the same
+    stale test."""
+    for e in platform.store.find(DeployExecution, scoped=False,
+                                 project=cluster.name):
+        if e.state not in (ExecutionState.PENDING, ExecutionState.STARTED):
+            continue
+        rec = platform.tasks.tasks.get(e.id)
+        if rec is not None and rec.state in ("PENDING", "STARTED"):
+            return True
+    return False
+
+
+@contextmanager
+def mutation_slot(platform, cluster: Cluster) -> Iterator[bool]:
+    """Atomically claim ``cluster`` for one desired-state mutation.
+
+    Yields True when the caller holds the slot (no other beat holds it
+    and no execution is live) — create and start the execution inside
+    the ``with`` block. Yields False when the cluster is already
+    claimed or busy: skip this tick and re-judge on the next one, the
+    signal will still be there if it's real.
+    """
+    lock, mutating = _state(platform)
+    with lock:
+        acquired = (cluster.name not in mutating
+                    and not execution_busy(platform, cluster))
+        if acquired:
+            mutating.add(cluster.name)
+    try:
+        yield acquired
+    finally:
+        if acquired:
+            with lock:
+                mutating.discard(cluster.name)

@@ -442,6 +442,12 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             "ko_serve_prefix_hits_total",
             "Admissions that reused cached prompt-prefix pages (their "
             "prefill was skipped; paged continuous engine)."),
+        "requeued": registry.counter(
+            "ko_serve_requests_requeued_total",
+            "In-flight requests snapshotted off drained slots and pushed "
+            "back to the queue head instead of dropped, by reason "
+            "(drain | slice_revoked | scale_down).",
+            labels=("reason",)),
         "segment_device": registry.histogram(
             "ko_serve_segment_device_seconds",
             "Device share of one decode segment: dispatch to the ready "
@@ -524,6 +530,31 @@ SLO_BURN_RATE = REGISTRY.gauge(
     "(fast | slow); 1.0 burns the whole budget within the objective "
     "period, sustained fast burn >1.0 is a page.",
     labels=("slo", "window"))
+
+# -- autoscaler families (services/autoscaler.py) ---------------------------
+# Set by the controller's autoscale beat: scale decisions judged from the
+# persisted SLO block, so they live on the process-global REGISTRY directly.
+AUTOSCALE_ACTIONS = REGISTRY.counter(
+    "ko_autoscale_actions_total",
+    "Scale actions emitted by the autoscaler beat, by cluster, direction "
+    "(up | down) and outcome (scheduled | converged | rolled_back | "
+    "rollback_failed).",
+    labels=("cluster", "direction", "outcome"))
+AUTOSCALE_DESIRED_WORKERS = REGISTRY.gauge(
+    "ko_autoscale_desired_workers",
+    "Desired worker count last emitted (or observed) by the autoscaler, "
+    "per cluster.",
+    labels=("cluster",))
+AUTOSCALE_COOLDOWN = REGISTRY.gauge(
+    "ko_autoscale_cooldown_seconds",
+    "Seconds of hysteresis cooldown remaining before the autoscaler may "
+    "emit another scale action, per cluster (0 = free to act).",
+    labels=("cluster",))
+AUTOSCALE_SKIPS = REGISTRY.counter(
+    "ko_autoscale_skips_total",
+    "Autoscaler beats that judged a scale-worthy signal but held fire, "
+    "by cluster and reason (cooldown | bounds | busy | guard).",
+    labels=("cluster", "reason"))
 
 
 declare_serve_metrics(REGISTRY)

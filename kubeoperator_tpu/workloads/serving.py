@@ -169,6 +169,12 @@ class BatcherStats:
     def prefix_hit(self, n: int = 1) -> None:
         self._m["prefix_hits"].inc(n)
 
+    def requeued(self, reason: str, n: int = 1) -> None:
+        """In-flight requests snapshotted off drained slots and pushed
+        back to the queue head instead of dropped (reason: drain |
+        slice_revoked | scale_down)."""
+        self._m["requeued"].inc(n, reason=reason)
+
     def ttft_mean(self) -> float:
         """Mean observed time-to-first-token in seconds (0.0 before any
         observation). The paged-vs-dense bench compares means; p95 lives
@@ -196,6 +202,9 @@ class BatcherStats:
             "kv_pages_used": int(sum(
                 self._m["kv_pages_used"].samples().values())),
             "prefix_hits_total": int(self._m["prefix_hits"].value()),
+            # summed over reasons: total in-flight requeues (drain/revoke)
+            "requests_requeued_total": int(sum(
+                self._m["requeued"].samples().values())),
             "batch_size_hist": batch_hist,
             "latency_p50_s": round(self._m["latency"].quantile(0.50), 4),
             "latency_p95_s": round(self._m["latency"].quantile(0.95), 4),
@@ -367,6 +376,18 @@ class ContinuousBatcher:
     times, the retirement fetch. No tracer (the default) means no ids
     resolve to trace handles and every hook is a single ``is None`` test:
     zero device work either way, near-zero host work when off.
+
+    Drain / readmit (round 11, the autoscaler's topology lever): ``drain
+    (shards)`` snapshots every in-flight request on the named dp shards
+    from host state (the prompt, per-slot position and page reservations
+    are all host-mirrored already), requeues them at the **head** of the
+    queue instead of dropping them, and fences the shards' slots off from
+    admission; ``readmit(shards)`` hands the slots back. A requeued
+    request re-prefills from scratch on whatever shard admits it next —
+    greedy decode is deterministic and sampling is (seed, position)-keyed,
+    so its tokens stay bit-identical to an undisturbed run. Both calls go
+    through a control handshake serviced by the worker thread between
+    steps, preserving the single-writer discipline on ``_track``.
     """
 
     def __init__(self, engine: Any, *, stats: BatcherStats | None = None,
@@ -382,6 +403,8 @@ class ContinuousBatcher:
         self._queue: deque[_Pending] = deque()
         self._track: dict[int, dict] = {}       # slot -> in-flight state
         self._free = list(range(engine.slots))
+        self._ctl: deque = deque()              # drain handshakes (worker-applied)
+        self._drained: set[int] = set()         # dp shards fenced off admission
         # slot s lives on dp shard s // (slots/dp): the engine shards the
         # slot axis over dp in contiguous blocks (decode_loop), so
         # occupancy can be reported per shard without device reads
@@ -491,13 +514,85 @@ class ContinuousBatcher:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._track:
-                    self._cond.wait()           # pool drained: idle
-                admit_now = self._admit_wave_locked()
+                while True:
+                    if self._ctl:
+                        self._apply_ctl_locked()
+                    admit_now = self._admit_wave_locked()
+                    if admit_now or self._track:
+                        break
+                    # idle: pool drained, or every admittable shard is
+                    # fenced off while requests wait for readmit()
+                    self._cond.wait()
             try:
                 self._step(admit_now)
             except Exception as e:  # noqa: BLE001 — engine boundary
                 self._fail_all(admit_now, e)
+
+    def _apply_ctl_locked(self) -> None:
+        """Service pending drain handshakes (worker thread, lock held):
+        pop every in-flight request off the drained shards, requeue them
+        at the queue head in submission order, release their page
+        reservations, and fence the shards' slots out of the free list."""
+        while self._ctl:
+            shard_set, reason, ev, out = self._ctl.popleft()
+            victims = sorted(s for s in self._track
+                             if s // self._shard_slots in shard_set)
+            reqs = [self._track.pop(s)["req"] for s in victims]
+            # appendleft newest-first so the queue head ends up oldest-first
+            for r in sorted(reqs, key=lambda r: r.submitted_at,
+                            reverse=True):
+                self._queue.appendleft(r)
+            for r in reqs:
+                self.stats.requeued(reason)
+            if self._paged and victims:
+                try:
+                    self.engine.release(victims)
+                except Exception:  # noqa: BLE001 — a revoked slice won't answer
+                    pass
+            # ko: lint-ok[KO201] caller holds _cond: _apply_ctl_locked runs inside the worker's lock scope
+            self._free = [s for s in self._free
+                          if s // self._shard_slots not in shard_set]
+            # ko: lint-ok[KO201] caller holds _cond: _apply_ctl_locked runs inside the worker's lock scope
+            self._drained |= shard_set
+            out["requeued"] = [r.id for r in reqs]
+            self._report_occupancy()
+            ev.set()
+
+    def drain(self, shards, reason: str = "drain",
+              timeout: float | None = 60.0) -> list[str]:
+        """Fence the given dp shards off from admission and requeue their
+        in-flight requests (head of the queue, submission order) instead
+        of dropping them. Blocks until the worker has applied the drain;
+        returns the requeued request ids. Safe to call for shards with no
+        in-flight work (the fence still applies — e.g. ahead of a
+        scale-down that will remove the shard's slice)."""
+        shard_set = {int(s) for s in shards}
+        bad = [s for s in shard_set if not 0 <= s < self._dp]
+        if bad:
+            raise ValueError(f"unknown dp shards {sorted(bad)} "
+                             f"(engine has {self._dp})")
+        ev = threading.Event()
+        out: dict = {}
+        with self._cond:
+            self._ctl.append((shard_set, reason, ev, out))
+            self._cond.notify()
+        if not ev.wait(timeout):
+            raise TimeoutError("drain timed out waiting for the worker")
+        return out["requeued"]
+
+    def readmit(self, shards=None) -> list[int]:
+        """Hand drained shards' slots back to the admission pool (all
+        drained shards when ``shards`` is None). Returns the shard ids
+        re-opened. Requeued requests then re-admit in FIFO order."""
+        with self._cond:
+            shard_set = (set(self._drained) if shards is None
+                         else {int(s) for s in shards} & self._drained)
+            for shard in sorted(shard_set):
+                self._drained.discard(shard)
+                lo = shard * self._shard_slots
+                self._free.extend(range(lo, lo + self._shard_slots))
+            self._cond.notify()
+            return sorted(shard_set)
 
     def _note_compiles(self) -> None:
         """Compile events for in-flight traces — meaningful only when a
@@ -622,7 +717,10 @@ class ContinuousBatcher:
             victims = [t["req"] for t in self._track.values()]
             victims += [r for _, r in admit_now if not r.done.is_set()]
             self._track.clear()
-            self._free = list(range(self.engine.slots))
+            # the reset pool keeps drained shards fenced: a revocation
+            # mid-step must not resurrect the dead shard's slots
+            self._free = [s for s in range(self.engine.slots)
+                          if s // self._shard_slots not in self._drained]
         if self._paged:
             try:
                 # drop every slot's page reservation so the reset pool

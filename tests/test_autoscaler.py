@@ -1,0 +1,274 @@
+"""SLO-driven autoscaler beat (services/autoscaler.py): sustained breach
+-> scale-up through the operation engine, hysteresis (cooldown + bounds),
+rollback on failed post-checks, and the single-mutator guard shared with
+auto-heal (services/mutation.py)."""
+
+import threading
+
+from kubeoperator_tpu.resources.entities import (
+    Cluster, DeployExecution, DeployType, ExecutionState, Host, Message,
+    Plan, Region, Setting, Zone,
+)
+from kubeoperator_tpu.services import autoscaler, monitor as mon
+from kubeoperator_tpu.services.mutation import execution_busy, mutation_slot
+from kubeoperator_tpu.telemetry import metrics as tm
+from test_monitor import ServeValueTransport
+
+
+def make_auto_cluster(platform, name, worker_size=2, pool_count=1,
+                      ip_count=30):
+    region = Region(name=f"r-{name}", provider="gce", vars={"project": "p"})
+    platform.store.save(region)
+    zone = Zone(name=f"z-{name}", region_id=region.id, vars={},
+                ip_pool=[f"10.6.{len(name)}.{i}"
+                         for i in range(10, 10 + ip_count)])
+    platform.store.save(zone)
+    plan = Plan(name=f"plan-{name}", region_id=region.id, zone_ids=[zone.id],
+                template="SINGLE", worker_size=worker_size,
+                tpu_pools=[{"slice_type": "v5e-8", "count": pool_count}])
+    platform.store.save(plan)
+    platform.create_cluster(name, deploy_type=DeployType.AUTOMATIC,
+                            plan_id=plan.id,
+                            configs={"registry": "reg.local:8082"})
+    ex = platform.run_operation(name, "install")
+    assert ex.state == ExecutionState.SUCCESS, ex.result
+    return platform.store.get_by_name(Cluster, name, scoped=False)
+
+
+def enable(platform, **extra):
+    platform.store.save(Setting(name="autoscale", value="true"))
+    platform.config["serve_slos"] = {"ttft_p95_ms": 500}
+    platform.config["slo_fast_window"] = 2
+    platform.config["slo_slow_window"] = 4
+    for k, v in extra.items():
+        platform.config[k] = v
+
+
+def breach_slos(platform, ticks=2, ttft_s=4.5):
+    """Walk the monitor beat until the fast window is full of bad points:
+    a sustained breach, the only thing allowed to trigger a scale-up."""
+    t = ServeValueTransport(ttft_s=ttft_s)
+    for _ in range(ticks):
+        mon.monitor_tick(platform, transport=t)
+
+
+def wait_scales(platform, name, n=1):
+    scales = sorted((e for e in platform.store.find(
+                        DeployExecution, scoped=False, project=name)
+                     if e.operation == "scale"),
+                    key=lambda e: e.created_at)
+    assert len(scales) >= n, [e.operation for e in scales]
+    for e in scales:
+        platform.tasks.wait(e.id, timeout=120)
+    return [platform.store.get(DeployExecution, e.id, scoped=False)
+            for e in scales]
+
+
+def test_autoscale_disabled_by_default(platform, fake_executor):
+    make_auto_cluster(platform, "asleep")
+    platform.config["serve_slos"] = {"ttft_p95_ms": 500}
+    platform.config["slo_fast_window"] = 2
+    breach_slos(platform)
+    assert autoscaler.autoscale_tick(platform) == []
+
+
+def test_breach_scales_up_then_cooldown_holds(platform, fake_executor):
+    """E2E acceptance: a sustained TTFT-SLO breach observed by the monitor
+    beat drives a scale-up through the ordinary operation engine — the
+    first TPU pool grows one slice — and the cooldown forbids a second
+    action right after, even though the breach persists."""
+    make_auto_cluster(platform, "grower")
+    enable(platform)
+    breach_slos(platform)
+
+    actions = autoscaler.autoscale_tick(platform, now=1000.0)
+    assert actions == ["grower:up"]
+    scales = wait_scales(platform, "grower", n=1)
+    assert scales[-1].state == ExecutionState.SUCCESS, scales[-1].result
+    assert scales[-1].params["tpu_pools"][0]["count"] == 2
+    # the converge actually provisioned the second v5e-8 slice (2 hosts)
+    tpu = [h for h in platform.store.find(Host, scoped=False,
+                                          project="grower") if h.has_tpu]
+    assert len(tpu) == 4
+    assert len({h.tpu_slice_id for h in tpu}) == 2
+    assert tm.AUTOSCALE_DESIRED_WORKERS.value(cluster="grower") == 2.0
+
+    # next beat: the pending action resolves as converged...
+    assert autoscaler.autoscale_tick(platform, now=1001.0) == []
+    assert tm.AUTOSCALE_ACTIONS.value(cluster="grower", direction="up",
+                                      outcome="converged") == 1.0
+    # ...and the still-breaching SLO cannot act inside the cooldown
+    assert tm.AUTOSCALE_SKIPS.value(cluster="grower",
+                                    reason="cooldown") >= 1.0
+    assert tm.AUTOSCALE_COOLDOWN.value(cluster="grower") > 0
+    # status surfaces all of it for `ko autoscale status` / the API
+    row = next(r for r in autoscaler.autoscale_status(platform)
+               if r["cluster"] == "grower")
+    assert row["enabled"] is True and row["verdict"] == "breach"
+    assert row["slos"] == {"ttft_p95_ms": "breach"}
+    assert row["desired"] == 2 and row["pending_execution"] is None
+
+
+def test_scale_down_needs_consecutive_ok_beats(platform, fake_executor):
+    """Hysteresis: one all-ok beat is not a scale-down; autoscale_down_after
+    consecutive ones shrink the pool one slice — and once at the floor,
+    further ok streaks are bounds-clamped no-ops."""
+    make_auto_cluster(platform, "calm", pool_count=2)
+    enable(platform, autoscale_down_after=3, autoscale_cooldown_s=0.0)
+    breach_slos(platform, ticks=4, ttft_s=0.1)     # healthy history
+
+    assert autoscaler.autoscale_tick(platform, now=100.0) == []  # streak 1
+    assert autoscaler.autoscale_tick(platform, now=200.0) == []  # streak 2
+    actions = autoscaler.autoscale_tick(platform, now=300.0)     # streak 3
+    assert actions == ["calm:down"]
+    scales = wait_scales(platform, "calm", n=1)
+    assert scales[-1].state == ExecutionState.SUCCESS, scales[-1].result
+    assert scales[-1].params["tpu_pools"][0]["count"] == 1
+    tpu = [h for h in platform.store.find(Host, scoped=False, project="calm")
+           if h.has_tpu]
+    assert len(tpu) == 2                           # one v5e-8 slice left
+    # resolve, rebuild the streak: at the floor, down is bounds-clamped
+    assert autoscaler.autoscale_tick(platform, now=400.0) == []
+    for now in (500.0, 600.0, 700.0):
+        autoscaler.autoscale_tick(platform, now=now)
+    assert tm.AUTOSCALE_SKIPS.value(cluster="calm", reason="bounds") >= 1.0
+
+
+def test_failed_post_check_rolls_back_desired_state(platform, fake_executor):
+    """A scale whose post-checks FAIL is rolled back: the beat re-emits
+    the prior sizing through the engine and records the outcome."""
+    cluster = make_auto_cluster(platform, "sorry")
+    enable(platform)
+    # a scale execution that failed its post-checks, tracked as pending
+    failed = DeployExecution(project="sorry", operation="scale",
+                             state=ExecutionState.FAILURE,
+                             params={"worker_size": 3})
+    platform.store.save(failed)
+    rec = autoscaler._load_state(platform, cluster)
+    rec.data.update(pending=failed.id, pending_direction="up",
+                    prior_sizing={"worker_size": 2,
+                                  "tpu_pools": [{"slice_type": "v5e-8",
+                                                 "count": 1}]},
+                    rolling_back=False, last_action_at=0.0, desired=3)
+    autoscaler._save_state(platform, rec)
+
+    assert autoscaler.autoscale_tick(platform, now=1000.0) == []
+    st = autoscaler._load_state(platform, cluster).data
+    assert st["rolling_back"] is True and st["pending"] != failed.id
+    rollback = platform.store.get(DeployExecution, st["pending"],
+                                  scoped=False)
+    assert rollback.params["worker_size"] == 2
+    platform.tasks.wait(rollback.id, timeout=120)
+    msgs = platform.store.find(Message, scoped=False, project="sorry")
+    assert any("rolled back" in m.title for m in msgs)
+
+    # next beat: the rollback converged; desired state is the prior one
+    assert autoscaler.autoscale_tick(platform, now=1001.0) == []
+    st = autoscaler._load_state(platform, cluster).data
+    assert st["pending"] is None and st["rolling_back"] is False
+    assert tm.AUTOSCALE_ACTIONS.value(cluster="sorry", direction="up",
+                                      outcome="rolled_back") == 1.0
+    workers = [h for h in platform.store.find(Host, scoped=False,
+                                              project="sorry")
+               if "worker" in h.name]
+    assert len(workers) == 2
+
+
+def test_rollback_failure_escalates(platform, fake_executor):
+    cluster = make_auto_cluster(platform, "stuck")
+    enable(platform)
+    failed = DeployExecution(project="stuck", operation="scale",
+                             state=ExecutionState.FAILURE,
+                             params={"worker_size": 2})
+    platform.store.save(failed)
+    rec = autoscaler._load_state(platform, cluster)
+    rec.data.update(pending=failed.id, pending_direction="up",
+                    prior_sizing={"worker_size": 2}, rolling_back=True,
+                    last_action_at=0.0)
+    autoscaler._save_state(platform, rec)
+    assert autoscaler.autoscale_tick(platform, now=1.0) == []
+    assert tm.AUTOSCALE_ACTIONS.value(cluster="stuck", direction="up",
+                                      outcome="rollback_failed") == 1.0
+    msgs = platform.store.find(Message, scoped=False, project="stuck")
+    assert any(m.level == "ERROR" and "rollback FAILED" in m.title
+               for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the single-mutator guard shared by healing + autoscaler
+# ---------------------------------------------------------------------------
+
+def test_mutation_slot_refuses_second_mutator(platform, fake_executor):
+    """While one beat holds a cluster's mutation slot, the other beat can
+    neither claim it nor emit a desired-state change: the autoscaler skips
+    with reason=guard even under a live breach."""
+    cluster = make_auto_cluster(platform, "contend")
+    enable(platform)
+    breach_slos(platform)
+
+    in_slot, release = threading.Event(), threading.Event()
+    claims = []
+
+    def rival():
+        with mutation_slot(platform, cluster) as claimed:
+            claims.append(claimed)
+            if claimed:
+                in_slot.set()
+                release.wait(30)
+
+    t = threading.Thread(target=rival)
+    t.start()
+    assert in_slot.wait(10)
+    # a second claimant (any beat) is refused while the slot is held
+    with mutation_slot(platform, cluster) as claimed:
+        assert claimed is False
+    before = platform.store.find(DeployExecution, scoped=False,
+                                 project="contend")
+    assert autoscaler.autoscale_tick(platform, now=50.0) == []
+    assert tm.AUTOSCALE_SKIPS.value(cluster="contend", reason="guard") == 1.0
+    after = platform.store.find(DeployExecution, scoped=False,
+                                project="contend")
+    assert len(after) == len(before)      # no execution was even created
+    release.set()
+    t.join(30)
+    assert claims == [True]
+    # slot released -> the next claim succeeds
+    with mutation_slot(platform, cluster) as claimed:
+        assert claimed is True
+
+
+def test_mutation_slot_single_winner_under_race(platform, fake_executor):
+    """N threads racing for one cluster's slot: at most one inside at any
+    moment (the two-beat terraform-concurrency hazard the guard closes)."""
+    cluster = make_auto_cluster(platform, "race")
+    start = threading.Barrier(8)
+    inside, peaks, wins = [], [], []
+
+    def worker():
+        start.wait(10)
+        with mutation_slot(platform, cluster) as claimed:
+            if claimed:
+                inside.append(1)
+                peaks.append(len(inside))
+                wins.append(1)
+                inside.pop()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert wins and max(peaks) == 1
+
+
+def test_execution_busy_ignores_stale_rows(platform, fake_executor):
+    """A PENDING row whose task is long gone (controller restart) must not
+    wedge the mutators forever."""
+    cluster = make_auto_cluster(platform, "stale")
+    assert execution_busy(platform, cluster) is False  # all SUCCESS
+    ghost = DeployExecution(project="stale", operation="scale",
+                            state=ExecutionState.PENDING)
+    platform.store.save(ghost)
+    assert execution_busy(platform, cluster) is False  # no live task
+    with mutation_slot(platform, cluster) as claimed:
+        assert claimed is True

@@ -10,15 +10,19 @@ runs in tier-1; this module exists to grind the same machinery much harder
 """
 
 import hashlib
+import json
 import os
 
 import pytest
 import yaml
 
 from kubeoperator_tpu.config.loader import load_config
-from kubeoperator_tpu.engine.executor import ChaosExecutor, FakeExecutor
+from kubeoperator_tpu.engine.executor import (
+    CHAOS_SEED_ENV, ChaosExecutor, FakeExecutor,
+)
 from kubeoperator_tpu.resources.entities import (
-    Cluster, ClusterStatus, ExecutionState, Host, Plan, Region, Zone,
+    Cluster, ClusterStatus, DeployExecution, ExecutionState, HealthRecord,
+    Host, Plan, Region, Setting, Zone,
 )
 from kubeoperator_tpu.resources.store import Store
 from kubeoperator_tpu.services.platform import Platform
@@ -49,9 +53,17 @@ def _k8s_package(platform, name, version):
     scan_packages(platform)
 
 
+def _seeded(chaos, detail):
+    """Failure message carrying the effective chaos seed: a red CI run is
+    replayed exactly with ``KO_CHAOS_SEED=<seed> pytest -m slow ...``."""
+    return f"{detail} [replay: {CHAOS_SEED_ENV}={chaos.seed}]"
+
+
 @pytest.fixture
 def soak(tmp_path):
-    chaos = ChaosExecutor(FakeExecutor(), seed=20260804, latency_s=0.001)
+    # the env override IS the replay knob — the soak honors it like prod
+    seed = int(os.environ.get(CHAOS_SEED_ENV, 20260804))
+    chaos = ChaosExecutor(FakeExecutor(), seed=seed, latency_s=0.001)
     cfg = load_config(overrides={
         "data_dir": str(tmp_path / "data"),
         "executor": "fake",
@@ -86,16 +98,27 @@ def soak(tmp_path):
                      plan_id=plan.id, package="k8s-v1",
                      configs={"registry": "reg.local:8082"})
     yield p, chaos
+    # the soak artifact records the effective seed + chaos volume even when
+    # an assertion above already failed (teardown runs either way), so the
+    # artifact of a red run names its exact replay
+    artifact = {"chaos_seed": chaos.seed, "seed_env": CHAOS_SEED_ENV,
+                "calls": chaos.calls, "injected": chaos.injected,
+                "revoked_slices": chaos.revoked_slices}
+    path = os.environ.get("KO_SOAK_ARTIFACT",
+                          str(tmp_path / "SOAK_chaos.json"))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
     p.shutdown()
 
 
-def _retry_budget_respected(ex, platform):
+def _retry_budget_respected(ex, platform, chaos):
     cat = platform.catalog
     for s in ex.steps:
         step_def = cat.steps.get(s["name"])
         budget = (step_def.retry if step_def and step_def.retry is not None
                   else int(platform.config["step_retry"]))
-        assert s["retries"] <= budget, (s["name"], s["retries"], budget)
+        assert s["retries"] <= budget, _seeded(
+            chaos, (s["name"], s["retries"], budget))
 
 
 def test_soak_install_scale_upgrade_under_chaos(soak):
@@ -104,18 +127,19 @@ def test_soak_install_scale_upgrade_under_chaos(soak):
 
     # -- Day 1: install converges despite constant transport flakes -------
     ex = platform.run_operation("soak", "install")
-    assert ex.state == ExecutionState.SUCCESS, ex.result
-    assert "quarantined" not in ex.result
-    assert chaos.injected > 20, "soak chaos barely fired; flake wiring broke"
-    _retry_budget_respected(ex, platform)
+    assert ex.state == ExecutionState.SUCCESS, _seeded(chaos, ex.result)
+    assert "quarantined" not in ex.result, _seeded(chaos, ex.result)
+    assert chaos.injected > 20, _seeded(
+        chaos, "soak chaos barely fired; flake wiring broke")
+    _retry_budget_respected(ex, platform, chaos)
 
     # -- Day 2: scale up under the same chaos ------------------------------
     ex = platform.run_operation("soak", "scale", {"worker_size": 4})
-    assert ex.state == ExecutionState.SUCCESS, ex.result
+    assert ex.state == ExecutionState.SUCCESS, _seeded(chaos, ex.result)
     workers = [h for h in platform.store.find(Host, scoped=False, project="soak")
                if "-worker-" in h.name]
-    assert len(workers) == 4
-    _retry_budget_respected(ex, platform)
+    assert len(workers) == 4, _seeded(chaos, [h.name for h in workers])
+    _retry_budget_respected(ex, platform, chaos)
 
     # -- mid-operation host death: a worker dies during the upgrade --------
     victim = sorted(workers, key=lambda h: h.name)[-1]
@@ -123,9 +147,10 @@ def test_soak_install_scale_upgrade_under_chaos(soak):
     # step now — die a few commands in so death lands mid-upgrade
     chaos.kill_after(victim.ip, 3)
     ex = platform.run_operation("soak", "upgrade", {"package": "k8s-v2"})
-    assert ex.state == ExecutionState.SUCCESS, ex.result
-    assert list(ex.result["quarantined"]) == [victim.name]
-    _retry_budget_respected(ex, platform)
+    assert ex.state == ExecutionState.SUCCESS, _seeded(chaos, ex.result)
+    assert list(ex.result["quarantined"]) == [victim.name], _seeded(
+        chaos, ex.result)
+    _retry_budget_respected(ex, platform, chaos)
 
     cluster = platform.store.get_by_name(Cluster, "soak", scoped=False)
     assert cluster.package == "k8s-v2"          # upgrade committed
@@ -135,9 +160,156 @@ def test_soak_install_scale_upgrade_under_chaos(soak):
     #    operation converges it again and the cluster leaves WARNING -------
     chaos.revive(victim.ip)
     ex = platform.run_operation("soak", "scale", {"worker_size": 4})
-    assert ex.state == ExecutionState.SUCCESS, ex.result
-    assert "quarantined" not in ex.result
+    assert ex.state == ExecutionState.SUCCESS, _seeded(chaos, ex.result)
+    assert "quarantined" not in ex.result, _seeded(chaos, ex.result)
     cluster = platform.store.get_by_name(Cluster, "soak", scoped=False)
     assert cluster.status == ClusterStatus.RUNNING
     total_injected = chaos.injected
-    assert total_injected < chaos.calls, "chaos must not dominate traffic"
+    assert total_injected < chaos.calls, _seeded(
+        chaos, "chaos must not dominate traffic")
+
+
+def test_autoscale_soak_closes_the_loop(soak):
+    """The round-11 control loop end to end, under chaos (ISSUE 11): a
+    sustained TTFT-SLO breach scales the TPU pool up through the engine;
+    the cloud revokes one slice mid-decode and the batcher requeues its
+    in-flight requests with zero loss; auto-heal replaces the revoked
+    slice while the shared mutation guard holds the autoscaler off; after
+    readmit every reply is bit-identical to an undisturbed run; recovery
+    scales the pool back down on consecutive all-ok beats. Every failure
+    message carries the replay seed."""
+    import threading
+
+    from kubeoperator_tpu.services import autoscaler, healing
+    from kubeoperator_tpu.services import monitor as mon
+    from kubeoperator_tpu.workloads.serving import ContinuousBatcher
+    from test_continuous import _bench_mod, _gated_paged_engine, _spin
+    from test_monitor import ServeValueTransport
+
+    platform, chaos = soak
+    chaos.flake(FLAKY, 0.15)
+    ex = platform.run_operation("soak", "install")
+    assert ex.state == ExecutionState.SUCCESS, _seeded(chaos, ex.result)
+
+    for name in ("autoscale", "auto_heal", "auto_heal_slices"):
+        platform.store.save(Setting(name=name, value="true"))
+    platform.config["serve_slos"] = {"ttft_p95_ms": 500}
+    platform.config["slo_fast_window"] = 2
+    platform.config["slo_slow_window"] = 4
+    platform.config["autoscale_cooldown_s"] = 0.0
+    platform.config["autoscale_down_after"] = 2
+    platform.config["autoscale_max_workers"] = 2
+
+    def newest_scale():
+        return sorted((e for e in platform.store.find(
+                           DeployExecution, scoped=False, project="soak")
+                       if e.operation == "scale"),
+                      key=lambda e: e.created_at)[-1]
+
+    def wait_scale(exid):
+        platform.tasks.wait(exid, timeout=300)
+        done = platform.store.get(DeployExecution, exid, scoped=False)
+        assert done.state == ExecutionState.SUCCESS, _seeded(
+            chaos, done.result)
+        return done
+
+    # -- 1. sustained breach -> scale-up: TPU pool 1 -> 2 slices -----------
+    t = ServeValueTransport(ttft_s=4.5)
+    mon.monitor_tick(platform, transport=t)
+    mon.monitor_tick(platform, transport=t)
+    acts = autoscaler.autoscale_tick(platform, now=1000.0)
+    assert acts == ["soak:up"], _seeded(chaos, acts)
+    up = wait_scale(newest_scale().id)
+    assert up.params["tpu_pools"][0]["count"] == 2, _seeded(chaos, up.params)
+    tpu = [h for h in platform.store.find(Host, scoped=False, project="soak")
+           if h.has_tpu]
+    assert len(tpu) == 4, _seeded(chaos, [h.name for h in tpu])
+    assert len({h.tpu_slice_id for h in tpu}) == 2
+    # resolves as converged; the breach persists but the ceiling clamps
+    assert autoscaler.autoscale_tick(platform, now=1001.0) == []
+
+    # -- 2. the cloud revokes one slice mid-decode: requeue, zero loss -----
+    bs = _bench_mod()
+    eng = _gated_paged_engine(bs, expect=4, slots=4, dp=2, segment=2,
+                              max_total=24, page=8, step_s=0.0,
+                              dispatch_s=0.0, prefill_s=0.0)
+    cb = ContinuousBatcher(eng)
+    reqs = [[1, 2, 3, 4, 5], [7, 8, 9], [2, 2, 2, 2], [11, 12, 13, 14, 15]]
+    results, errors = {}, []
+
+    def client(i):
+        try:
+            results[i] = cb.submit(reqs[i], 12, timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for th in threads:
+        th.start()
+    _spin(lambda: eng.admitted + len(cb._queue) >= 4, msg="4 enqueued")
+    eng.gate.release()
+    _spin(eng.all_admitted.is_set, msg="all 4 admitted")
+    s0 = eng.segs
+    eng.gate.release()
+    _spin(lambda: eng.segs > s0, msg="mid-decode segment")
+
+    victim_slice = sorted({h.tpu_slice_id for h in tpu})[-1]
+    victims = sorted((h for h in tpu if h.tpu_slice_id == victim_slice),
+                     key=lambda h: h.name)
+    chaos.revoke_slice(victim_slice, [h.ip for h in victims])
+    assert chaos.revoked_slices == [victim_slice]
+    got = {}
+    dt = threading.Thread(target=lambda: got.__setitem__(
+        "ids", cb.drain([1], reason="slice_revoked", timeout=60.0)))
+    dt.start()
+    _spin(lambda: cb._ctl or got, msg="drain handshake queued")
+    eng.gate.release()
+    dt.join(60)
+    assert "ids" in got and len(got["ids"]) == 2, _seeded(chaos, got)
+    # the cloud reclaims the preempted VMs; replacements provisioned at
+    # those addresses boot clean, so the revocation lifts before the heal
+    assert chaos.restore_slice(victim_slice) == sorted(h.ip for h in victims)
+    assert chaos.revoked_slices == []
+
+    # -- 3. auto-heal replaces the revoked slice; the shared guard holds
+    #       the autoscaler off while the heal's converge runs --------------
+    for h in victims:
+        for hour in ("2026-08-05T01", "2026-08-05T02"):
+            platform.store.save(HealthRecord(
+                project="soak", kind="host", target=h.name, healthy=False,
+                hour=hour, name=f"hr:{h.name}:{hour}"))
+    healed = healing.heal_tick(platform)
+    assert sorted(healed) == [h.name for h in victims], _seeded(chaos, healed)
+    assert autoscaler.autoscale_tick(platform, now=1002.0) == []
+    heal_ex = wait_scale(newest_scale().id)
+    assert heal_ex.params["tpu_pools"][0]["count"] == 2
+    new_tpu = [h for h in platform.store.find(Host, scoped=False,
+                                              project="soak") if h.has_tpu]
+    assert len(new_tpu) == 4, _seeded(chaos, [h.name for h in new_tpu])
+    assert {h.id for h in victims}.isdisjoint({h.id for h in new_tpu})
+
+    # -- 4. replacement up -> readmit: zero loss, bit-identical replies ----
+    assert cb.readmit([1]) == [1]
+    eng.hold = False
+    eng.gate.release()
+    for th in threads:
+        th.join(120)
+    assert not errors and len(results) == 4, _seeded(chaos, errors)
+    for i, prompt in enumerate(reqs):
+        want = [int(x) for x in bs.fake_row(prompt, len(prompt) + 12)]
+        assert results[i] == want, _seeded(chaos, f"request {i} corrupted")
+    assert cb.stats.snapshot()["requests_requeued_total"] == 2
+
+    # -- 5. recovery: consecutive all-ok beats scale back down -------------
+    t.ttft_s = 0.1
+    mon.monitor_tick(platform, transport=t)
+    mon.monitor_tick(platform, transport=t)
+    assert autoscaler.autoscale_tick(platform, now=2000.0) == []  # streak 1
+    acts = autoscaler.autoscale_tick(platform, now=2100.0)        # streak 2
+    assert acts == ["soak:down"], _seeded(chaos, acts)
+    down = wait_scale(newest_scale().id)
+    assert down.params["tpu_pools"][0]["count"] == 1
+    tpu = [h for h in platform.store.find(Host, scoped=False, project="soak")
+           if h.has_tpu]
+    assert len(tpu) == 2 and len({h.tpu_slice_id for h in tpu}) == 1
+    assert chaos.injected > 0, _seeded(chaos, "chaos never fired")

@@ -257,9 +257,11 @@ class ServeValueTransport(FakeTransport):
 
 
 def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
-    """A configured ttft_p95_ms SLO rides the monitor beat: a slow tick
-    flips it to breach (event + burn gauges), fast ticks age the breach
-    out of the window and the recovery edge lands in snapshot()["slo"]."""
+    """A configured ttft_p95_ms SLO rides the monitor beat: the first bad
+    tick is unjudgeable (shorter than the fast window — no spurious edge),
+    the second flips it to breach (event + burn gauges), fast ticks age
+    the breach out of the window and the recovery edge lands in
+    snapshot()["slo"]."""
     from kubeoperator_tpu.telemetry import metrics as tm
 
     platform.config["serve_slos"] = {"ttft_p95_ms": 500}
@@ -272,11 +274,18 @@ def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
         return platform.store.find(mon.MonitorSnapshot, scoped=False,
                                    name="demo")[0].data["slo"]
 
+    # first-ever point: one terrible beat is NOT a sustained breach —
+    # the window guard keeps it no_data, with no event
     block = slo_block()
     s = block["slos"]["ttft_p95_ms"]
-    assert s["state"] == "breach" and s["value"] == 4500.0
-    assert s["met"] is False and s["burn_rate"]["fast"] >= 1.0
-    # first-ever point: the edge comes from no_data, still worth an event
+    assert s["state"] == "no_data" and s["value"] == 4500.0
+    assert s["met"] is False and s["burn_rate"]["fast"] is None
+    assert block["events"] == []
+
+    mon.monitor_tick(platform, transport=t)  # window full: sustained breach
+    block = slo_block()
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
     assert [(e["from"], e["to"])
             for e in block["events"]] == [("no_data", "breach")]
     assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast") >= 1.0
@@ -295,4 +304,46 @@ def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
     # history carried the whole walk for the dashboard charts
     hist = platform.store.find(mon.MonitorSnapshot, scoped=False,
                                name="demo:history")[0]
-    assert [p["serve_ttft_p95"] for p in hist.data["points"]] == [4.5, 0.1, 0.1]
+    assert [p["serve_ttft_p95"]
+            for p in hist.data["points"]] == [4.5, 4.5, 0.1, 0.1]
+
+
+def _pts(*ttft_s):
+    return [{"time": f"t{i}", "serve_ttft_p95": v}
+            for i, v in enumerate(ttft_s)]
+
+
+def test_evaluate_slos_empty_history_is_no_data():
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, [],
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "no_data" and s["value"] is None
+    assert s["burn_rate"] == {"fast": None, "slow": None}
+    assert s["attainment"] is None
+    assert block["events"] == []
+
+
+def test_evaluate_slos_single_point_no_spurious_edge():
+    """One terrible first beat must not read as 100% of the budget burned:
+    shorter-than-window histories are unjudgeable."""
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, _pts(9.9),
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "no_data" and s["burn_rate"]["fast"] is None
+    assert block["events"] == []
+    # the raw reading and attainment still report over what exists
+    assert s["value"] == 9900.0 and s["met"] is False
+    assert s["attainment"] == 0.0
+
+
+def test_evaluate_slos_exactly_window_sized_history_judges():
+    """The verdict (and the breach edge) fires on exactly the tick that
+    fills the fast window — not one earlier, not one later."""
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, _pts(9.9, 9.9, 9.9),
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
+    assert [(e["from"], e["to"])
+            for e in block["events"]] == [("no_data", "breach")]
+    # the slow window (6) is still short of history → still unjudged
+    assert s["burn_rate"]["slow"] is None

@@ -345,7 +345,8 @@ def test_slo_burn_breach_then_recover():
     out = evaluate_slos(spec, _pts([good, good, good, bad]), **kw)
     s = out["slos"]["ttft_p95_ms"]
     assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
-    assert s["burn_rate"]["slow"] < s["burn_rate"]["fast"]
+    # 4 points cannot judge the 6-point slow window: guarded to no-data
+    assert s["burn_rate"]["slow"] is None
     assert out["events"] == [{
         "slo": "ttft_p95_ms", "from": "ok", "to": "breach",
         "burn_fast": s["burn_rate"]["fast"], "value": pytest.approx(900.0),
