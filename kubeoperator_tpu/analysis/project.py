@@ -33,9 +33,10 @@ _RULE_ROW = re.compile(r"^\| (KO\d{3}) ")
 _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
 #: README sections whose metric tables must equal the registry
-_TABLE_SECTIONS = ("## Observability", "## Serving")
+_TABLE_SECTIONS = ("## Observability", "## Serving", "## Scenario replay")
 #: README sections whose inline ko_* mentions must be registered
-_MENTION_SECTIONS = ("## Observability", "## Serving", "## Scheduling")
+_MENTION_SECTIONS = ("## Observability", "## Serving", "## Scheduling",
+                     "## Scenario replay")
 
 
 class ProjectRule(Rule):

@@ -336,6 +336,40 @@ def cmd_lint(args) -> int:
     return run_lint(argv)
 
 
+def cmd_scenario(args) -> int:
+    # local replay — no controller, no login (the harness drives the
+    # cost-model stack in-process, like `ko lint` runs the analyzer)
+    from kubeoperator_tpu.scenario import (
+        SCENARIOS, list_scenarios, load_spec, run_scenarios, validate_spec,
+    )
+    if args.action == "list":
+        table(list_scenarios(), ["name", "beats", "workloads", "chaos",
+                                 "description"])
+        return 0
+    sources = [args.spec] if args.spec else (args.names or sorted(SCENARIOS))
+    specs = [load_spec(s) for s in sources]
+    problems = [f"{s.get('name', '?')}: {p}"
+                for s in specs for p in validate_spec(s)]
+    if problems:
+        for p in problems:
+            print(f"error: {p}", file=sys.stderr)
+        return 1
+    artifact = run_scenarios(specs, out=args.out or None)
+    for r in artifact["scenarios"]:
+        breaches = sum(len([e for e in w["breach_events"]
+                            if e.get("to") == "breach"])
+                       for w in r["workloads"].values())
+        print(f"{r['scenario']}: {r['verdict']} · "
+              f"chaos {r['chaos']['injected_total']} · "
+              f"requeued {r['requeued_total']} · breaches {breaches} · "
+              f"bit_exact {r['bit_exact']}")
+    if args.out:
+        print(f"wrote {args.out}")
+    if args.check and not artifact["ok"]:
+        return 2            # CI gate: any breached SLO / lost token fails
+    return 0
+
+
 def build_parser(sub) -> None:
     """Register the ``ctl`` subcommands on the main argument parser."""
     login = sub.add_parser("login", help="authenticate against a controller")
@@ -411,6 +445,19 @@ def build_parser(sub) -> None:
                       help="skip README/catalog project checks")
     lint.add_argument("--list-rules", action="store_true")
     lint.set_defaults(fn=cmd_lint)
+
+    scen = sub.add_parser(
+        "scenario", help="trace-driven chaos replay judged by the SLO engine")
+    scen.add_argument("action", choices=("run", "list"))
+    scen.add_argument("names", nargs="*",
+                      help="catalog scenarios to run (default: all)")
+    scen.add_argument("--spec", default="",
+                      help="YAML scenario spec file (overrides names)")
+    scen.add_argument("--out", default="",
+                      help="write the replay artifact JSON here")
+    scen.add_argument("--check", action="store_true",
+                      help="exit 2 if any SLO breached or tokens lost")
+    scen.set_defaults(fn=cmd_scenario)
 
     logs = sub.add_parser("logs", help="search system logs")
     logs.add_argument("--query", default="")

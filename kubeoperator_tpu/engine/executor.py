@@ -566,6 +566,10 @@ class ChaosExecutor(Executor):
     * ``flake(pattern, rate)``   — each matching command fails with
       probability ``rate`` (seeded RNG → reproducible sequences);
     * ``latency_s``              — fixed injected delay per command;
+    * ``latency(pattern, base_s, jitter_s=)`` — pattern-scoped delay on
+      top of the global one: matching commands pay ``base_s`` plus a
+      uniform ``[0, jitter_s)`` draw from the seeded RNG, so a scenario
+      can model one slow host's tail and replay it bit-for-bit;
     * ``kill_after(ip, n)``      — the host dies mid-operation after ``n``
       more commands and stays dead (``revive`` brings it back);
     * ``revoke_slice(slice_id, ips)`` — preemptible-TPU revocation: every
@@ -589,6 +593,7 @@ class ChaosExecutor(Executor):
         self._lock = threading.Lock()
         self._fail_next: list[tuple[re.Pattern | None, int]] = []
         self._flakes: list[tuple[re.Pattern, float]] = []
+        self._latency: list[tuple[re.Pattern, float, float]] = []
         self._kill: dict[str, int] = {}      # ip -> commands until death
         self._dead: set[str] = set()
         self._revoked: dict[str, set[str]] = {}  # slice_id -> member ips
@@ -605,6 +610,19 @@ class ChaosExecutor(Executor):
         """Matching commands fail with probability ``rate``."""
         with self._lock:
             self._flakes.append((re.compile(pattern), rate))
+
+    def latency(self, pattern: str, base_s: float,
+                jitter_s: float = 0.0) -> None:
+        """Matching commands pay ``base_s + uniform(0, jitter_s)`` extra
+        delay (on top of the global ``latency_s``). The jitter draws come
+        from the seeded RNG under the same lock as every other fault
+        evaluation, so a replay with the same ``KO_CHAOS_SEED`` sleeps
+        the exact same sequence — slow-host tails stay reproducible."""
+        if base_s < 0 or jitter_s < 0:
+            raise ValueError("latency base_s/jitter_s must be >= 0")
+        with self._lock:
+            self._latency.append((re.compile(pattern), float(base_s),
+                                  float(jitter_s)))
 
     def kill_after(self, ip: str, commands: int = 0) -> None:
         """``ip`` dies after ``commands`` more commands and stays dead."""
@@ -625,10 +643,15 @@ class ChaosExecutor(Executor):
         member IP goes dead in the same instant, so an in-flight decode
         step fails on all of the slice's shards together. Recorded once
         as ``slice_revoked`` plus one ``host_dead``-style kill per member.
+
+        Only the members this call actually killed are recorded against
+        the slice: a host already dead for an unrelated reason (say a
+        pending ``kill_after``) is not the revocation's to revive, so a
+        later ``restore_slice`` must leave it dead.
         """
         with self._lock:
             members = {ip for ip in ips if ip not in self._dead}
-            self._revoked[slice_id] = set(ips)
+            self._revoked[slice_id] = members
             self._dead |= members
             self._record("slice_revoked", slice_id)
 
@@ -685,10 +708,26 @@ class ChaosExecutor(Executor):
                     return ExecResult(124, "", "chaos: injected timeout")
         return None
 
+    def _latency_for(self, ip: str, command: str) -> float:
+        """Total injected delay for one command: the global ``latency_s``
+        plus every matching pattern rule's ``base + uniform(0, jitter)``.
+        The jitter draw happens under ``_lock`` on the seeded RNG, so the
+        delay sequence is a pure function of the seed and the command
+        stream — fixed-seed replays sleep identically."""
+        delay = self.latency_s
+        with self._lock:
+            for pat, base, jitter in self._latency:
+                if pat.search(command):
+                    delay += base + (self.rng.uniform(0.0, jitter)
+                                     if jitter else 0.0)
+                    self._record("latency", ip)
+        return delay
+
     # -- interface ---------------------------------------------------------
     def run(self, conn: Conn, command: str, timeout: int = 300) -> ExecResult:
-        if self.latency_s:
-            time.sleep(self.latency_s)
+        delay = self._latency_for(conn.ip, command)
+        if delay:
+            time.sleep(delay)
         injected = self._chaos(conn.ip, command)
         if injected is not None:
             return injected

@@ -1,0 +1,39 @@
+"""Scenario replay: trace-driven multi-workload runs under scheduled
+chaos, judged by the SLO engine.
+
+The bench suite (scripts/bench_*.py) prices single workloads in tok/s;
+this package replays *production-shaped* traffic — diurnal curves,
+bursts, shared-prefix long tails, two-stage pipelines — against the
+continuous-batching stack while a ``ChaosExecutor`` injects scheduled
+faults, and the outcome of record is ``services.monitor.evaluate_slos``
+over the whole replay's history points, emitted as a
+``SCENARIO_r0N.json`` artifact next to BENCH_*.json.
+
+Layout:
+
+* ``engines``  — the injected-latency cost-model engines (moved here
+  from scripts/bench_serving.py; the bench imports them back);
+* ``driver``   — the shared client-thread replay driver (``run_load``),
+  used by both the bench and the harness;
+* ``traces``   — deterministic trace/arrival generators;
+* ``spec``     — declarative scenario specs: schema validation, YAML
+  loading, and the built-in catalog;
+* ``harness``  — the beat-loop replay executor and artifact writer.
+"""
+
+from kubeoperator_tpu.scenario.driver import run_load
+from kubeoperator_tpu.scenario.engines import (
+    VOCAB, FakePagedEngine, FakeRunFn, FakeSlotEngine, fake_row,
+)
+from kubeoperator_tpu.scenario.harness import run_scenario, run_scenarios
+from kubeoperator_tpu.scenario.spec import (
+    SCENARIOS, get_scenario, list_scenarios, load_spec, validate_spec,
+)
+from kubeoperator_tpu.scenario.traces import make_prefix_trace
+
+__all__ = [
+    "VOCAB", "FakePagedEngine", "FakeRunFn", "FakeSlotEngine", "fake_row",
+    "run_load", "run_scenario", "run_scenarios", "SCENARIOS",
+    "get_scenario", "list_scenarios", "load_spec", "validate_spec",
+    "make_prefix_trace",
+]

@@ -1,0 +1,73 @@
+"""The shared client-thread replay driver.
+
+``run_load`` is the one submit/join loop both scripts/bench_serving.py
+and the scenario harness replay through (it used to live in the bench;
+factored here so the harness doesn't duplicate it). Each request gets
+its own client thread that sleeps until its arrival offset, submits,
+and stores the reply — which is exactly how production load looks to a
+batcher: concurrent blocking clients, not a prepared batch.
+
+Extensions over the bench-era version, all backward compatible:
+
+* ``offsets`` — per-request arrival times in seconds (the harness maps
+  virtual-beat arrivals onto these); the default is the bench's uniform
+  ``i * stagger_s`` stagger;
+* ``on_result`` — a hook run in the client thread right after a reply
+  lands, used by the pipeline scenario to feed stage-1 outputs into the
+  stage-2 batcher with genuine overlap (a raising hook surfaces like a
+  submit error);
+* the returned dict carries ``results`` so callers can check replies
+  token-for-token (the replay's bit-exactness gate), not just count
+  throughput.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Sequence
+
+
+def run_load(batcher, trace: Sequence[tuple[list[int], int]],
+             stagger_s: float = 0.0, *,
+             offsets: Sequence[float] | None = None,
+             timeout: float = 120.0,
+             on_result: Callable[[int, list[int], int, list[int]], None]
+             | None = None) -> dict:
+    """Replay the trace with staggered client threads; aggregate tok/s
+    counts only the NEW tokens each request asked for."""
+    if offsets is not None and len(offsets) != len(trace):
+        raise ValueError(f"offsets ({len(offsets)}) must match the trace "
+                         f"({len(trace)})")
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def client(i, delay, prompt, max_tokens):
+        time.sleep(delay)
+        try:
+            got = batcher.submit(prompt, max_tokens, timeout=timeout)
+            results[i] = got
+            if on_result is not None:
+                on_result(i, prompt, max_tokens, got)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(
+            i, offsets[i] if offsets is not None else i * stagger_s, p, mt))
+        for i, (p, mt) in enumerate(trace)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    tokens = sum(mt for _, mt in trace)
+    for i, (prompt, mt) in enumerate(trace):
+        got = results[i]
+        assert got[:len(prompt)] == list(prompt), f"request {i} lost prompt"
+        assert len(got) == len(prompt) + mt, f"request {i} wrong length"
+    return {"wall_s": wall, "tokens": tokens, "tok_s": tokens / wall,
+            "results": results}

@@ -1,0 +1,210 @@
+"""Injected-latency cost-model engines for serving replay.
+
+These are the fakes scripts/bench_serving.py built its A/B on (moved
+here so the scenario harness can drive the same cost model without
+importing from scripts/): SlotPoolEngine's host protocol over numpy
+plus ``time.sleep`` latencies — no model, no device, pure batch-
+formation semantics. ``fake_row`` is the deterministic pseudo-decode
+both engines agree on, which is what lets replays assert bit-exactness
+without a model: any request's reply is a pure function of its prompt.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from kubeoperator_tpu.workloads.serving import _pow2_at_most
+
+VOCAB = 1000
+
+
+def fake_row(prompt: list[int], total: int) -> np.ndarray:
+    """Deterministic pseudo-tokens: position-keyed so both engines agree
+    and replies are checkable without a model."""
+    row = np.zeros((total,), np.int32)
+    row[:len(prompt)] = prompt
+    base = sum(prompt) % VOCAB
+    for p in range(len(prompt), total):
+        row[p] = (base + p) % VOCAB
+    return row
+
+
+class FakeSlotEngine:
+    """SlotPoolEngine's host protocol over numpy + injected latency —
+    the continuous side of the cost model (one ``dispatch + K * step``
+    sleep per segment, one ``dispatch + prefill`` sleep per admission
+    prefill bucket).
+
+    Mesh shapes (round 7): ``dp``/``tp`` mirror the sharded engine's cost
+    structure — the slot pool is ``slots`` TOTAL rows (the caller scales
+    it by dp, as `--mesh` users scale `--slots`), per-token work divides
+    by tp (heads shard), and every dispatch pays ``collective × log2(n)``
+    for the all-reduces GSPMD inserts (one hop per doubling). dp=tp=1
+    with collective 0 is exactly the r5/r6 single-chip model.
+    """
+
+    def __init__(self, *, slots: int = 16, segment: int = 8,
+                 max_total: int = 2048, step_s: float = 0.001,
+                 dispatch_s: float = 0.003, prefill_s: float = 0.002,
+                 dp: int = 1, tp: int = 1, collective_s: float = 0.0):
+        if slots % dp:
+            raise ValueError(f"slots ({slots}) must be divisible by dp ({dp})")
+        self.slots, self.segment, self.max_total = slots, segment, max_total
+        self.step_s, self.dispatch_s, self.prefill_s = (
+            step_s, dispatch_s, prefill_s)
+        self.dp, self.tp = dp, tp
+        # log2(n) all-reduce hops per dispatch; 0 when n_devices == 1
+        self._link_s = collective_s * (dp * tp - 1).bit_length()
+        self.buf = np.zeros((slots, max_total), np.int32)
+        self.pos = np.zeros((slots,), np.int32)
+        self.last = np.zeros((slots,), np.int32)
+        self.dispatches = 0
+        self.peak_concurrency = 0   # most rows mid-decode in one segment
+
+    def admit(self, entries):
+        by_c: dict[int, list] = {}
+        for slot, prompt_ids, max_tokens, _temp, _seed in entries:
+            prompt = list(map(int, prompt_ids))
+            by_c.setdefault(_pow2_at_most(len(prompt)), []).append(
+                (slot, prompt, int(max_tokens)))
+        out = {}
+        for c, group in by_c.items():
+            time.sleep(self.dispatch_s + self._link_s
+                       + self.prefill_s / self.tp)
+            self.dispatches += 1
+            for slot, prompt, max_tokens in group:
+                total = len(prompt) + max_tokens
+                self.buf[slot] = 0
+                self.buf[slot, :total] = fake_row(prompt, total)
+                self.pos[slot] = c
+                self.last[slot] = total - 1
+                out[slot] = c
+        return out
+
+    def run_segment(self):
+        time.sleep(self.dispatch_s + self._link_s
+                   + self.segment * self.step_s / self.tp)
+        self.dispatches += 1
+        active = self.pos < self.last
+        self.peak_concurrency = max(self.peak_concurrency, int(active.sum()))
+        self.pos = np.where(active,
+                            np.minimum(self.pos + self.segment, self.last),
+                            self.pos)
+
+    def poll(self):
+        return self.buf.copy(), self.pos.copy()
+
+
+class FakeRunFn:
+    """generate()-shaped callable for DynamicBatcher — the dynamic side
+    of the cost model. One fused batch costs ``dispatch + prefill +
+    (p_bucket - prefill_len + new_bucket) * step``: generate() scans
+    token-by-token from the prefill chunk (pow2 of the SHORTEST fused
+    prompt) through the pow2-padded decode length — run-to-completion at
+    the worst row's shape, which is exactly what the slot pool removes."""
+
+    def __init__(self, *, step_s: float = 0.001, dispatch_s: float = 0.003,
+                 prefill_s: float = 0.002):
+        self.step_s, self.dispatch_s, self.prefill_s = (
+            step_s, dispatch_s, prefill_s)
+        self.dispatches = 0
+
+    def __call__(self, prompts, lens, max_new, temp, prefill, seed):
+        steps = len(prompts[0]) - prefill + max_new
+        time.sleep(self.dispatch_s + self.prefill_s + steps * self.step_s)
+        self.dispatches += 1
+        width = len(prompts[0]) + max_new
+        out = np.zeros((len(prompts), width), np.int32)
+        for i, (row, n) in enumerate(zip(prompts, lens)):
+            out[i] = fake_row(list(row[:n]), width)
+        return out
+
+
+class FakePagedEngine(FakeSlotEngine):
+    """FakeSlotEngine plus the paged engine's host accounting protocol
+    (round 8): a pool of ``pages`` blocks of ``page`` token positions
+    split over dp shards (one reserved trash page each), a conservative
+    ``ceil((plen + max_tokens) / page)`` reservation per admitted slot,
+    and a capacity-free prefix cache keyed on page-aligned prompt
+    prefixes — a hit skips the cached share of the prefill sleep, which
+    is the TTFT win the tier-1 guard measures. ``ContinuousBatcher``
+    detects the protocol via ``pages_for`` and admits against free pages
+    instead of free slots, exactly as with the real ``SlotPoolEngine``."""
+
+    def __init__(self, *, page: int = 16, pages: int | None = None, **kw):
+        super().__init__(**kw)
+        if page <= 0 or page & (page - 1):
+            raise ValueError(f"page ({page}) must be a power of two")
+        self.page = page
+        self.pages = (self.slots * (self.max_total // page) + self.dp
+                      if pages is None else pages)
+        self._span = self.pages // self.dp
+        self._shard_slots = self.slots // self.dp
+        self._free_pg = [self._span - 1] * self.dp    # minus the trash page
+        self._held: dict[int, tuple[int, int]] = {}   # slot -> (shard, pages)
+        self._prefix: list[set[tuple[int, ...]]] = [
+            set() for _ in range(self.dp)]
+        self.prefix_hits = 0
+
+    @property
+    def max_request_pages(self) -> int:
+        return self._span - 1
+
+    def pages_for(self, prompt_len: int, max_tokens: int) -> int:
+        return -(-(prompt_len + max_tokens) // self.page)
+
+    def free_pages(self, shard: int = 0) -> int:
+        return self._free_pg[shard]
+
+    def evictable_pages(self, shard: int = 0) -> int:
+        return 0    # the cost model's prefix cache holds no pages itself
+
+    def pages_in_use(self, shard: int = 0) -> int:
+        return (self._span - 1) - self._free_pg[shard]
+
+    def _hit_pages(self, shard: int, prompt: list[int]) -> int:
+        for n in range(len(prompt) // self.page, 0, -1):
+            if tuple(prompt[:n * self.page]) in self._prefix[shard]:
+                return n
+        return 0
+
+    def admit(self, entries):
+        by_c: dict[int, list] = {}
+        for slot, prompt_ids, max_tokens, _temp, _seed in entries:
+            prompt = list(map(int, prompt_ids))
+            by_c.setdefault(_pow2_at_most(len(prompt)), []).append(
+                (slot, prompt, int(max_tokens)))
+        out = {}
+        for c, group in by_c.items():
+            uncached = 0.0   # the bucket prefills at its worst row's share
+            for slot, prompt, max_tokens in group:
+                shard = slot // self._shard_slots
+                hit = self._hit_pages(shard, prompt)
+                if hit:
+                    self.prefix_hits += 1
+                uncached = max(
+                    uncached, (len(prompt) - hit * self.page) / len(prompt))
+                need = self.pages_for(len(prompt), max_tokens)
+                self._free_pg[shard] -= need
+                assert self._free_pg[shard] >= 0, "batcher over-admitted"
+                self._held[slot] = (shard, need)
+                for n in range(1, len(prompt) // self.page + 1):
+                    self._prefix[shard].add(tuple(prompt[:n * self.page]))
+                total = len(prompt) + max_tokens
+                self.buf[slot] = 0
+                self.buf[slot, :total] = fake_row(prompt, total)
+                self.pos[slot] = c
+                self.last[slot] = total - 1
+                out[slot] = c
+            if uncached > 0:
+                time.sleep(self.dispatch_s + self._link_s
+                           + uncached * self.prefill_s / self.tp)
+                self.dispatches += 1
+        return out
+
+    def release(self, slots):
+        for s in slots:
+            shard, held = self._held.pop(int(s), (0, 0))
+            self._free_pg[shard] += held

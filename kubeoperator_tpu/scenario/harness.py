@@ -1,0 +1,407 @@
+"""The beat-loop replay executor: one scenario spec in, one judged
+report out.
+
+A replay composes three prior rounds' machinery into a single run:
+
+* the continuous-batching stack (``ContinuousBatcher`` over the paged
+  or dense cost-model engine, optionally colocated with a cost-model
+  train loop probing the same hosts);
+* the ``ChaosExecutor`` transport, firing the spec's scheduled faults —
+  on a ``revoke_slice`` the harness drains the backing dp shard (the
+  autoscaler's reaction) and on ``restore_slice`` it readmits;
+* the SLO engine: every beat the harness samples each serving stage's
+  ``BatcherStats`` into a monitor-history point stamped with *virtual*
+  time (``beat × beat_s``), re-judges ``evaluate_slos`` over the
+  history so far (exactly the monitor beat's stateless discipline,
+  which is what accumulates breach *edges*), and the final verdict is
+  the outcome of record.
+
+The clock is two-layered: the virtual clock (``beat_s`` per beat) is
+what the SLO windows see, and ``beat_wall_s`` is how long the harness
+actually lets the stack run per beat — the trace generators, chaos
+schedule, and history spacing are all deterministic in beats, so the
+only randomness in a replay is the chaos seed. The replay keeps beating
+past the scheduled window until every client thread has its reply (the
+verdict covers the whole run, not a truncation), then checks every
+reply token-for-token against ``fake_row`` — the cost-model analog of
+"greedy tokens bit-identical to solo generate()".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+from kubeoperator_tpu.engine.executor import ChaosExecutor, Conn, FakeExecutor
+from kubeoperator_tpu.scenario.driver import run_load
+from kubeoperator_tpu.scenario.engines import (
+    VOCAB, FakePagedEngine, FakeSlotEngine, fake_row,
+)
+from kubeoperator_tpu.scenario.spec import validate_spec
+from kubeoperator_tpu.scenario.traces import build_trace
+from kubeoperator_tpu.services.monitor import (
+    evaluate_slos, serve_history_point,
+)
+from kubeoperator_tpu.telemetry import metrics
+from kubeoperator_tpu.workloads.serving import BatcherStats, ContinuousBatcher
+
+#: cap on overtime beats (drivers still draining after the scheduled
+#: window) so a wedged replay fails loudly instead of spinning forever
+OVERTIME_FACTOR = 8
+
+
+def _build_engine(espec: dict):
+    kw = {k: espec[k] for k in ("slots", "segment", "max_total", "dp", "tp",
+                                "step_s", "dispatch_s", "prefill_s",
+                                "collective_s") if k in espec}
+    if espec.get("kind", "paged") == "dense":
+        return FakeSlotEngine(**kw)
+    if "page" in espec:
+        kw["page"] = espec["page"]
+    return FakePagedEngine(**kw)
+
+
+class _Stage:
+    """One judged serving stream: a batcher over its own cost-model
+    engine, the trace driving it, per-beat history points, and the
+    accumulated breach edges."""
+
+    def __init__(self, name: str, espec: dict, slos: dict | None,
+                 trace=None, offsets=None):
+        self.name = name
+        self.engine = _build_engine(espec)
+        self.stats = BatcherStats()
+        self.batcher = ContinuousBatcher(self.engine, stats=self.stats)
+        self.slos = dict(slos or {})
+        self.trace = trace
+        self.offsets = offsets
+        self.points: list[dict] = []
+        self.breach_events: list[dict] = []
+        self.records: list[tuple[list[int], int, list[int]]] = []
+        self.out: dict = {}
+        self.error: str | None = None
+        self._lock = threading.Lock()
+
+    @property
+    def dp(self) -> int:
+        return getattr(self.engine, "dp", 1)
+
+    def record(self, prompt: list[int], max_tokens: int,
+               result: list[int]) -> None:
+        with self._lock:
+            self.records.append((list(prompt), int(max_tokens),
+                                 list(result)))
+
+    def sample(self, vt: float, fast: int, slow: int) -> None:
+        """One history point at virtual time ``vt`` plus a stateless
+        re-judge over the history so far — the monitor beat in
+        miniature, which is what turns per-point verdicts into breach
+        edges the artifact can list."""
+        snap = self.stats.snapshot()
+        paged = hasattr(self.engine, "pages_for")
+        self.points.append(serve_history_point(
+            vt,
+            ttft_p95_s=self.stats.ttft_quantile(0.95),
+            latency_p95_s=(snap["latency_p95_s"]
+                           if snap["requests_total"] else None),
+            queue_depth=snap["queue_depth"],
+            slot_occupancy=snap["slot_occupancy"],
+            kv_pages_used=snap["kv_pages_used"] if paged else None))
+        block = evaluate_slos(self.slos, self.points,
+                              fast_window=fast, slow_window=slow)
+        self.breach_events.extend(block["events"])
+
+    def verdict(self, fast: int, slow: int) -> dict:
+        return evaluate_slos(self.slos, self.points,
+                             fast_window=fast, slow_window=slow)
+
+    def bit_exact(self) -> bool:
+        with self._lock:
+            records = list(self.records)
+        for prompt, mt, result in records:
+            want = [int(x) for x in fake_row(prompt, len(prompt) + mt)]
+            if result != want:
+                return False
+        return bool(records)
+
+    def report(self, fast: int, slow: int) -> dict:
+        block = self.verdict(fast, slow)
+        slo_ok = (not any(s.get("state") == "breach"
+                          for s in block["slos"].values())
+                  and not any(e.get("to") == "breach"
+                              for e in self.breach_events))
+        snap = self.stats.snapshot()
+        with self._lock:
+            n_records = len(self.records)
+        return {
+            "requests": len(self.trace) if self.trace else n_records,
+            "wall_s": round(self.out.get("wall_s", 0.0), 3),
+            "tok_s": round(self.out.get("tok_s", 0.0), 1),
+            "requeued_total": snap["requests_requeued_total"],
+            "errors_total": snap["errors_total"],
+            "error": self.error,
+            "bit_exact": self.bit_exact(),
+            "slo_ok": slo_ok,
+            "slos": block["slos"],
+            "breach_events": self.breach_events,
+        }
+
+
+class _TrainLoop(threading.Thread):
+    """Colocated cost-model train job: each step sleeps ``step_s`` then
+    issues one collective-shaped command per member host through the
+    chaos transport — so a revoked or killed host surfaces as transient
+    step failures for exactly the beats the fault is live, the way a
+    real gang-scheduled job sees a preemption."""
+
+    def __init__(self, name: str, step_s: float, chaos: ChaosExecutor,
+                 hosts: list[str]):
+        super().__init__(daemon=True, name=f"ko-scenario-train-{name}")
+        self.train_name = name
+        self.step_s = step_s
+        self.chaos = chaos
+        self.hosts = hosts
+        self.steps = 0
+        self.transient_failures = 0
+        self.durations: list[float] = []
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            t0 = time.perf_counter()
+            time.sleep(self.step_s)
+            for ip in self.hosts:
+                r = self.chaos.run(Conn(ip=ip),
+                                   f"train allreduce step={self.steps}")
+                if r.rc != 0:
+                    self.transient_failures += 1
+            self.durations.append(time.perf_counter() - t0)
+            self.steps += 1
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def report(self) -> dict:
+        durs = sorted(self.durations)
+        p95 = durs[min(len(durs) - 1, int(0.95 * len(durs)))] if durs else 0.0
+        return {"steps": self.steps,
+                "transient_failures": self.transient_failures,
+                "step_p95_s": round(p95, 4)}
+
+
+def _slice_of(ev: dict, spec: dict) -> dict:
+    sl = ev.get("slice") if isinstance(ev.get("slice"), dict) \
+        else spec.get("slice")
+    if not sl:
+        raise ValueError(f"chaos event {ev.get('kind')} needs a slice block")
+    return sl
+
+
+def _apply_chaos(ev: dict, chaos: ChaosExecutor, spec: dict,
+                 stages: list[_Stage], beat: int) -> dict:
+    """Fire one scheduled fault; returns the injection-log entry."""
+    kind = ev["kind"]
+    entry: dict[str, Any] = {"beat": beat, "kind": kind}
+    if kind == "flake":
+        chaos.flake(ev["pattern"], float(ev["rate"]))
+        entry["target"] = ev["pattern"]
+    elif kind == "latency":
+        chaos.latency(ev["pattern"], float(ev.get("base_s", 0.0)),
+                      float(ev.get("jitter_s", 0.0)))
+        entry["target"] = ev["pattern"]
+    elif kind == "fail_next":
+        chaos.fail_next(int(ev.get("n", 1)), ev.get("pattern"))
+        entry["target"] = ev.get("pattern") or "*"
+    elif kind == "kill_host":
+        chaos.kill_after(ev["ip"], 0)
+        entry["target"] = ev["ip"]
+    elif kind == "revive":
+        chaos.revive(ev["ip"])
+        entry["target"] = ev["ip"]
+    elif kind == "revoke_slice":
+        sl = _slice_of(ev, spec)
+        chaos.revoke_slice(sl["id"], list(sl["ips"]))
+        shard = int(sl.get("shard", 0))
+        requeued = 0
+        for st in stages:
+            if shard < st.dp:
+                requeued += len(st.batcher.drain(
+                    [shard], reason="slice_revoked", timeout=60.0))
+        entry["target"] = sl["id"]
+        entry["requeued"] = requeued
+    elif kind == "restore_slice":
+        sl = _slice_of(ev, spec)
+        entry["target"] = sl["id"]
+        entry["restored"] = chaos.restore_slice(sl["id"])
+        shard = int(sl.get("shard", 0))
+        for st in stages:
+            if shard < st.dp:
+                st.batcher.readmit([shard])
+    else:  # validate_spec rejects these before run_scenario gets here
+        raise ValueError(f"unknown chaos kind {kind!r}")
+    return entry
+
+
+def _stage2_prompt(prompt: list[int], result: list[int],
+                   s2spec: dict) -> list[int]:
+    """Stage-2 prompt from a stage-1 reply: the pipeline's own system
+    prefix plus the tail of the generated tokens — the ASR transcript
+    feeding the summarizer."""
+    prefix_len = int(s2spec.get("prefix_len", 8))
+    keep_tail = int(s2spec.get("keep_tail", 8))
+    prefix = [(13 * j) % VOCAB + 1 for j in range(prefix_len)]
+    tail = [int(t) for t in result[len(prompt):][-keep_tail:]]
+    return prefix + tail
+
+
+def run_scenario(spec: dict) -> dict:
+    """Execute one validated scenario spec; returns the judged report
+    (see the artifact schema in README "Scenario replay")."""
+    problems = validate_spec(spec)
+    if problems:
+        raise ValueError("invalid scenario spec:\n  " + "\n  ".join(problems))
+
+    name = spec["name"]
+    beats = int(spec["beats"])
+    beat_s = float(spec.get("beat_s", 30.0))
+    beat_wall_s = float(spec.get("beat_wall_s", 0.05))
+    seed = int(spec.get("seed", 1337))
+    timeout = float(spec.get("timeout_s", 60.0))
+    sw = spec.get("slo_windows", {})
+    fast = int(sw.get("fast", 4))
+    slow = int(sw.get("slow", 8))
+    hosts = list(spec.get("hosts", ()))
+    espec = spec.get("engine", {})
+
+    chaos = ChaosExecutor(FakeExecutor(), seed=seed)
+    by_beat: dict[int, list[dict]] = {}
+    for ev in spec.get("chaos", ()):
+        by_beat.setdefault(int(ev["beat"]), []).append(ev)
+
+    stages: list[_Stage] = []
+    trains: list[_TrainLoop] = []
+    drivers: list[threading.Thread] = []
+
+    for w in spec["workloads"]:
+        kind = w["kind"]
+        wname = w.get("name", kind)
+        if kind == "train":
+            trains.append(_TrainLoop(wname, float(w.get("step_s", 0.005)),
+                                     chaos, hosts))
+            continue
+        trace, arrivals = build_trace(w.get("trace", {}), beats)
+        offsets = [b * beat_wall_s for b in arrivals]
+        st = _Stage(wname, espec, w.get("serve_slos"), trace, offsets)
+        stages.append(st)
+        if kind == "pipeline":
+            st2 = _Stage(f"{wname}:stage2", espec, w.get("stage2_slos"))
+            st2.trace = []          # populated by the chain as replies land
+            stages.append(st2)
+            s2spec = w.get("stage2", {})
+            mt2 = int(s2spec.get("max_tokens", 8))
+
+            def chain(i, prompt, mt, result, st=st, st2=st2, s2spec=s2spec,
+                      mt2=mt2):
+                st.record(prompt, mt, result)
+                p2 = _stage2_prompt(prompt, result, s2spec)
+                got2 = st2.batcher.submit(p2, mt2, timeout=timeout)
+                st2.record(p2, mt2, got2)
+        else:
+            def chain(i, prompt, mt, result, st=st):
+                st.record(prompt, mt, result)
+
+        def drive(st=st, chain=chain):
+            try:
+                st.out = run_load(st.batcher, st.trace, offsets=st.offsets,
+                                  timeout=timeout, on_result=chain)
+            except Exception as e:  # noqa: BLE001 — judged in the report
+                st.error = repr(e)
+
+        drivers.append(threading.Thread(target=drive, daemon=True,
+                                        name=f"ko-scenario-{wname}"))
+
+    injections: list[dict] = []
+    probe_failures = 0
+    for tr in trains:
+        tr.start()
+    for d in drivers:
+        d.start()
+    t0 = time.perf_counter()
+    beat = 0
+    # scheduled beats first, then overtime beats (no chaos left) until
+    # every driver thread has delivered its replies
+    while beat < beats or (any(d.is_alive() for d in drivers)
+                           and beat < beats * OVERTIME_FACTOR):
+        for ev in by_beat.get(beat, ()):
+            injections.append(_apply_chaos(ev, chaos, spec, stages, beat))
+        for ip in hosts:
+            if chaos.run(Conn(ip=ip), f"healthz beat={beat}").rc != 0:
+                probe_failures += 1
+        dt = t0 + (beat + 1) * beat_wall_s - time.perf_counter()
+        if dt > 0:
+            time.sleep(dt)
+        vt = round((beat + 1) * beat_s, 3)
+        for st in stages:
+            st.sample(vt, fast, slow)
+        beat += 1
+    for d in drivers:
+        d.join(timeout)
+    for tr in trains:
+        tr.stop()
+        tr.join(5.0)
+
+    workloads = {st.name: st.report(fast, slow) for st in stages}
+    bit_exact = all(w["bit_exact"] for w in workloads.values())
+    slo_ok = all(w["slo_ok"] for w in workloads.values())
+    errors = [w["error"] for w in workloads.values() if w["error"]] + \
+        [f"driver still alive after {timeout}s"
+         for d in drivers if d.is_alive()]
+    ok = slo_ok and bit_exact and not errors
+    verdict = "error" if errors else ("ok" if slo_ok else "breach")
+    metrics.SCENARIO_RUNS.inc(scenario=name, verdict=verdict)
+    for st in stages:
+        for e in st.breach_events:
+            if e.get("to") == "breach":
+                metrics.SCENARIO_BREACHES.inc(scenario=name, slo=e["slo"])
+
+    return {
+        "scenario": name,
+        "ok": ok,
+        "verdict": verdict,
+        "seed": seed,
+        "beats": beats,
+        "beat_s": beat_s,
+        "beat_wall_s": beat_wall_s,
+        "slo_windows": {"fast": fast, "slow": slow},
+        "workloads": workloads,
+        "train": {tr.train_name: tr.report() for tr in trains},
+        "chaos": {
+            "injections": injections,
+            "injected_total": chaos.injected,
+            "probe_failures": probe_failures,
+        },
+        "requeued_total": sum(w["requeued_total"]
+                              for w in workloads.values()),
+        "bit_exact": bit_exact,
+        "errors": errors,
+    }
+
+
+def run_scenarios(specs: list[dict], out: str | None = None,
+                  run: str = "r01") -> dict:
+    """Run every spec and assemble the SCENARIO artifact (written to
+    ``out`` when given) — the robustness number of record next to the
+    BENCH_*.json throughput artifacts."""
+    reports = [run_scenario(s) for s in specs]
+    artifact = {
+        "run": run,
+        "ok": all(r["ok"] for r in reports),
+        "scenarios": reports,
+    }
+    if out:
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump(artifact, fh, indent=1)
+            fh.write("\n")
+    return artifact

@@ -1,0 +1,300 @@
+"""Declarative scenario specs: schema, validation, YAML loading, and
+the built-in catalog.
+
+A scenario is one dict (YAML on disk, plain dict in tests)::
+
+    name: burst_preemption          # artifact + metric label
+    description: ...
+    beats: 12                       # virtual clock length
+    beat_s: 30.0                    # virtual seconds per beat (history
+                                    #   point spacing evaluate_slos sees)
+    beat_wall_s: 0.05               # real seconds the harness lets the
+                                    #   stack run per beat
+    seed: 1337                      # ChaosExecutor seed (the replay's
+                                    #   ONLY randomness)
+    engine:                         # cost-model engine under the batcher
+      kind: paged | dense
+      slots: 8
+      dp: 2
+      tp: 1
+      segment: 4
+      max_total: 256
+      page: 16
+      step_s / dispatch_s / prefill_s: injected latencies
+    hosts: [10.0.0.1, 10.0.0.2, 10.0.0.3]   # probed through the chaos
+                                            #   transport every beat
+    slice: {id: tpu-a, ips: [10.0.0.2, 10.0.0.3], shard: 1}
+    workloads:
+      - kind: serving               # one ContinuousBatcher + trace
+        name: chat
+        trace: {shape: uniform|diurnal|burst, requests: N,
+                prefix_len: 64, peak: .5, trough: .1,
+                bursts: [4], share: .7}
+        serve_slos: {ttft_p95_ms: 2000, queue_depth_max: 64, ...}
+      - kind: pipeline              # two batchers, stage-1 feeds stage-2
+        name: asr-llm
+        trace: {...}                # stage-1 stream
+        stage2: {max_tokens: 8, prefix_len: 8, keep_tail: 8}
+        serve_slos: {...}           # stage-1 verdict
+        stage2_slos: {...}          # distinct stage-2 verdict
+      - kind: train                 # colocated cost-model train loop
+        name: colo-train
+        step_s: 0.005
+    chaos:                          # scheduled injections, by beat
+      - {beat: 2, kind: latency, pattern: healthz, base_s: 0, jitter_s: 0.001}
+      - {beat: 3, kind: flake, pattern: healthz, rate: 0.3}
+      - {beat: 4, kind: revoke_slice}       # uses the spec's slice block
+      - {beat: 7, kind: restore_slice}
+      - {beat: 5, kind: kill_host, ip: 10.0.0.2}
+      - {beat: 6, kind: revive, ip: 10.0.0.2}
+      - {beat: 1, kind: fail_next, n: 2, pattern: healthz}
+    slo_windows: {fast: 4, slow: 8} # evaluate_slos windows, in beats
+
+``validate_spec`` returns human-readable problems instead of raising so
+``ko scenario run`` can print all of them at once; ``load_spec`` takes a
+dict, a YAML path, or a catalog name.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from kubeoperator_tpu.scenario.traces import TRACE_SHAPES
+
+CHAOS_KINDS = ("flake", "latency", "fail_next", "kill_host", "revive",
+               "revoke_slice", "restore_slice")
+WORKLOAD_KINDS = ("serving", "pipeline", "train")
+ENGINE_KINDS = ("paged", "dense")
+
+
+def _slo_errors(where: str, slos: Any) -> list[str]:
+    from kubeoperator_tpu.services.monitor import SLO_SIGNALS
+    if slos is None:
+        return []
+    if not isinstance(slos, dict):
+        return [f"{where}: serve_slos must be a mapping"]
+    errs = []
+    for k, v in slos.items():
+        if k not in SLO_SIGNALS:
+            errs.append(f"{where}: unknown SLO {k!r} "
+                        f"(supported: {sorted(SLO_SIGNALS)})")
+        target = v.get("target") if isinstance(v, dict) else v
+        if not isinstance(target, (int, float)) or isinstance(target, bool):
+            errs.append(f"{where}: SLO {k!r} target must be a number")
+    return errs
+
+
+def validate_spec(spec: Any) -> list[str]:
+    """Every problem in the spec, as ``where: what`` strings; empty
+    means runnable."""
+    if not isinstance(spec, dict):
+        return ["spec must be a mapping"]
+    errs: list[str] = []
+    name = spec.get("name")
+    if not isinstance(name, str) or not name:
+        errs.append("name: required, must be a non-empty string")
+    beats = spec.get("beats", 0)
+    if not isinstance(beats, int) or isinstance(beats, bool) or beats <= 0:
+        errs.append(f"beats: must be a positive integer, got {beats!r}")
+        beats = 1
+    for key in ("beat_s", "beat_wall_s"):
+        v = spec.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v <= 0):
+            errs.append(f"{key}: must be a positive number, got {v!r}")
+
+    eng = spec.get("engine", {})
+    if not isinstance(eng, dict):
+        errs.append("engine: must be a mapping")
+    elif eng.get("kind", "paged") not in ENGINE_KINDS:
+        errs.append(f"engine.kind: must be one of {ENGINE_KINDS}, "
+                    f"got {eng.get('kind')!r}")
+
+    workloads = spec.get("workloads")
+    if not isinstance(workloads, list) or not workloads:
+        errs.append("workloads: at least one workload is required")
+        workloads = []
+    serving = 0
+    for i, w in enumerate(workloads):
+        where = f"workloads[{i}]"
+        if not isinstance(w, dict):
+            errs.append(f"{where}: must be a mapping")
+            continue
+        kind = w.get("kind")
+        if kind not in WORKLOAD_KINDS:
+            errs.append(f"{where}.kind: must be one of {WORKLOAD_KINDS}, "
+                        f"got {kind!r}")
+            continue
+        if kind == "train":
+            continue
+        serving += 1
+        tspec = w.get("trace", {})
+        if not isinstance(tspec, dict):
+            errs.append(f"{where}.trace: must be a mapping")
+        elif tspec.get("shape", "uniform") not in TRACE_SHAPES:
+            errs.append(f"{where}.trace.shape: must be one of "
+                        f"{TRACE_SHAPES}, got {tspec.get('shape')!r}")
+        errs += _slo_errors(f"{where}.serve_slos", w.get("serve_slos"))
+        if kind == "pipeline":
+            errs += _slo_errors(f"{where}.stage2_slos", w.get("stage2_slos"))
+    if workloads and not serving:
+        errs.append("workloads: at least one serving/pipeline workload is "
+                    "required (the SLO verdict is the outcome of record)")
+
+    sl = spec.get("slice")
+    if sl is not None:
+        if not isinstance(sl, dict) or not sl.get("id") \
+                or not isinstance(sl.get("ips"), list):
+            errs.append("slice: needs {id, ips: [...], shard}")
+    for i, ev in enumerate(spec.get("chaos", ())):
+        where = f"chaos[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: must be a mapping")
+            continue
+        kind = ev.get("kind")
+        if kind not in CHAOS_KINDS:
+            errs.append(f"{where}.kind: must be one of {CHAOS_KINDS}, "
+                        f"got {kind!r}")
+            continue
+        beat = ev.get("beat")
+        if not isinstance(beat, int) or isinstance(beat, bool) \
+                or not 0 <= beat < beats:
+            errs.append(f"{where}.beat: must be an integer in "
+                        f"[0, {beats}), got {beat!r}")
+        if kind in ("flake", "latency") and not ev.get("pattern"):
+            errs.append(f"{where}: {kind} needs a command pattern")
+        if kind == "flake" and not isinstance(ev.get("rate"), (int, float)):
+            errs.append(f"{where}: flake needs a numeric rate")
+        if kind == "latency" and not isinstance(ev.get("base_s", 0.0),
+                                                (int, float)):
+            errs.append(f"{where}: latency base_s must be a number")
+        if kind in ("kill_host", "revive") and not ev.get("ip"):
+            errs.append(f"{where}: {kind} needs an ip")
+        if kind in ("revoke_slice", "restore_slice") and sl is None \
+                and not ev.get("slice"):
+            errs.append(f"{where}: {kind} needs a slice block (spec-level "
+                        f"'slice' or per-event {{slice, ips, shard}})")
+    sw = spec.get("slo_windows", {})
+    if not isinstance(sw, dict):
+        errs.append("slo_windows: must be a mapping of {fast, slow}")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# the built-in catalog — the three production shapes the ROADMAP names,
+# sized so `ko scenario run` finishes in seconds on the cost model
+# ---------------------------------------------------------------------------
+
+_ENGINE = {"kind": "paged", "slots": 8, "dp": 2, "tp": 1, "segment": 4,
+           "max_total": 256, "page": 16,
+           "step_s": 0.0004, "dispatch_s": 0.001, "prefill_s": 0.001}
+_HOSTS = ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+_SLICE = {"id": "tpu-a", "ips": ["10.0.0.2", "10.0.0.3"], "shard": 1}
+
+SCENARIOS: dict[str, dict] = {
+    "diurnal_slowhost": {
+        "name": "diurnal_slowhost",
+        "description": "diurnal serving load with a colocated train job; "
+                       "one host grows a seeded-jitter latency tail and "
+                       "flaky control-plane probes at peak",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "trace": {"shape": "diurnal", "requests": 32, "peak": 0.4,
+                       "prefix_len": 32},
+             "serve_slos": {"ttft_p95_ms": 2000, "queue_depth_max": 48,
+                            "latency_p95_ms": 5000}},
+            {"kind": "train", "name": "colo-train", "step_s": 0.004},
+        ],
+        "chaos": [
+            {"beat": 3, "kind": "latency", "pattern": "healthz",
+             "base_s": 0.0005, "jitter_s": 0.001},
+            {"beat": 5, "kind": "flake", "pattern": "healthz", "rate": 0.3},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "burst_preemption": {
+        "name": "burst_preemption",
+        "description": "burst arrivals over a shared-prefix long tail; "
+                       "the cloud revokes the preemptible slice backing "
+                       "dp shard 1 mid-decode, the batcher drains and "
+                       "requeues, the replacement slice restores",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "slice": dict(_SLICE),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "trace": {"shape": "burst", "requests": 32, "bursts": [1, 2],
+                       "share": 0.7, "prefix_len": 32},
+             "serve_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 48}},
+            {"kind": "train", "name": "colo-train", "step_s": 0.004},
+        ],
+        "chaos": [
+            {"beat": 3, "kind": "revoke_slice"},
+            {"beat": 7, "kind": "restore_slice"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "pipeline_two_stage": {
+        "name": "pipeline_two_stage",
+        "description": "two-stage pipeline (ASR-shaped stage 1 feeds an "
+                       "LLM-shaped stage 2) with distinct per-stage SLOs "
+                       "and a mid-replay host death",
+        "beats": 10, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "pipeline", "name": "asr-llm",
+             "trace": {"shape": "uniform", "requests": 16, "prefix_len": 16},
+             "stage2": {"max_tokens": 8, "prefix_len": 16, "keep_tail": 8},
+             "serve_slos": {"ttft_p95_ms": 2000},
+             "stage2_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 32}},
+        ],
+        "chaos": [
+            {"beat": 4, "kind": "kill_host", "ip": "10.0.0.2"},
+            {"beat": 6, "kind": "revive", "ip": "10.0.0.2"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+}
+
+
+def list_scenarios() -> list[dict]:
+    """Catalog rows for ``ko scenario list``."""
+    return [{"name": s["name"], "beats": s["beats"],
+             "workloads": "+".join(w["kind"] for w in s["workloads"]),
+             "chaos": ",".join(sorted({e["kind"] for e in s.get("chaos", ())}))
+             or "(none)",
+             "description": s["description"]}
+            for s in SCENARIOS.values()]
+
+
+def get_scenario(name: str) -> dict:
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r} "
+                       f"(catalog: {sorted(SCENARIOS)})")
+    return SCENARIOS[name]
+
+
+def load_spec(source: Any) -> dict:
+    """A runnable spec from a dict (validated verbatim), a catalog name,
+    or a YAML file path."""
+    if isinstance(source, dict):
+        return source
+    if not isinstance(source, str):
+        raise TypeError(f"spec source must be a dict, catalog name, or "
+                        f"path, got {type(source).__name__}")
+    if source in SCENARIOS:
+        return SCENARIOS[source]
+    if os.path.exists(source):
+        import yaml
+        with open(source, encoding="utf-8") as fh:
+            loaded = yaml.safe_load(fh)
+        if not isinstance(loaded, dict):
+            raise ValueError(f"{source}: spec must be a YAML mapping")
+        return loaded
+    raise FileNotFoundError(f"no catalog scenario or spec file {source!r}")

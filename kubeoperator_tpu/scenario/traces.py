@@ -1,0 +1,154 @@
+"""Deterministic trace and arrival-shape generators for scenario replay.
+
+A *trace* is what the bench always replayed — ``[(prompt_ids,
+max_tokens), ...]`` — and an *arrival shape* is the new axis: which
+virtual beat each request lands on. Both are pure functions of their
+parameters (no RNG), so a scenario's request stream is identical on
+every run and the chaos seed is the only source of randomness in a
+replay.
+
+``make_prefix_trace`` is the round-8 shared-prefix long-tail generator,
+moved here from scripts/bench_serving.py (the bench imports it back)
+and generalized with a pluggable tail mix so pipeline stages can use
+shorter shapes.
+"""
+
+from __future__ import annotations
+
+import math
+
+VOCAB = 1000
+
+#: default request mix: (prompt_len, max_tokens) cycled — three short
+#: decodes and one long straggler per four, the bench's r5 shape.
+REQUEST_MIX: tuple[tuple[int, int], ...] = ((8, 8), (16, 8), (32, 8), (64, 128))
+
+#: shared-prefix long-tail mix: (tail_len, max_tokens) cycled. Three
+#: short decodes and one 96-token straggler per four requests — the
+#: straggler is what pins a dense row at worst-case length while paged
+#: rows only reserve the pages they asked for.
+PREFIX_TAIL: tuple[tuple[int, int], ...] = ((4, 8), (8, 8), (6, 16), (12, 96))
+
+
+def make_trace(n: int,
+               mix: tuple[tuple[int, int], ...] = REQUEST_MIX
+               ) -> list[tuple[list[int], int]]:
+    """Mixed prompt-length / max-token trace: ``mix`` cycled over ``n``
+    requests, prompts position-keyed so every run replays identically."""
+    out = []
+    for i in range(n):
+        plen, mt = mix[i % len(mix)]
+        out.append(([(i + j) % VOCAB + 1 for j in range(plen)], mt))
+    return out
+
+
+def make_prefix_trace(n: int, prefix_len: int = 64,
+                      mix: tuple[tuple[int, int], ...] = PREFIX_TAIL
+                      ) -> list[tuple[list[int], int]]:
+    """Shared-prefix long-tail trace: every request opens with the same
+    ``prefix_len``-token system prompt (page-aligned when prefix_len is a
+    multiple of the page size), then a short unique tail. The first
+    request through each shard publishes the prefix pages; everyone after
+    hits the cache and skips that share of prefill."""
+    system = [(7 * j) % VOCAB + 1 for j in range(prefix_len)]
+    out = []
+    for i in range(n):
+        tail_len, mt = mix[i % len(mix)]
+        tail = [(i + 11 * j) % VOCAB + 1 for j in range(tail_len)]
+        out.append((system + tail, mt))
+    return out
+
+
+def _apportion(requests: int, weights: list[float]) -> list[int]:
+    """Largest-remainder apportionment of ``requests`` over per-beat
+    ``weights`` — deterministic (ties break toward the earlier beat), and
+    the counts always sum to exactly ``requests``."""
+    total = sum(weights)
+    if total <= 0:
+        raise ValueError("arrival weights must sum > 0")
+    exact = [requests * w / total for w in weights]
+    counts = [int(e) for e in exact]
+    short = requests - sum(counts)
+    order = sorted(range(len(weights)),
+                   key=lambda b: (-(exact[b] - counts[b]), b))
+    for b in order[:short]:
+        counts[b] += 1
+    return counts
+
+
+def _beats_from_counts(counts: list[int]) -> list[int]:
+    out: list[int] = []
+    for beat, c in enumerate(counts):
+        out.extend([beat] * c)
+    return out
+
+
+def uniform_arrivals(requests: int, beats: int) -> list[int]:
+    """One arrival beat per request, spread evenly across the replay."""
+    return _beats_from_counts(_apportion(requests, [1.0] * beats))
+
+
+def diurnal_arrivals(requests: int, beats: int, peak: float = 0.5,
+                     trough: float = 0.1) -> list[int]:
+    """Diurnal load curve compressed into the replay window: a raised
+    cosine peaking at fraction ``peak`` of the run, with the off-peak
+    floor at ``trough`` of the peak rate (a real fleet never goes to
+    zero). Returns the arrival beat of each request, oldest first."""
+    if not 0.0 <= peak <= 1.0:
+        raise ValueError(f"peak ({peak}) must be in [0, 1]")
+    weights = [trough + (1.0 - trough)
+               * 0.5 * (1.0 + math.cos(2.0 * math.pi * (b / beats - peak)))
+               for b in range(beats)]
+    return _beats_from_counts(_apportion(requests, weights))
+
+
+def burst_arrivals(requests: int, beats: int,
+                   bursts: tuple[int, ...] = (), share: float = 0.7
+                   ) -> list[int]:
+    """Bursty arrivals: fraction ``share`` of the requests land on the
+    ``bursts`` beats (evenly among them), the rest spread uniformly —
+    the thundering-herd shape that tests queue-depth and TTFT SLOs."""
+    if not bursts:
+        bursts = (beats // 3,)
+    bad = [b for b in bursts if not 0 <= b < beats]
+    if bad:
+        raise ValueError(f"burst beats {bad} outside [0, {beats})")
+    if not 0.0 <= share <= 1.0:
+        raise ValueError(f"share ({share}) must be in [0, 1]")
+    base = 1.0 - share
+    weights = [base / beats] * beats
+    for b in bursts:
+        weights[b] += share / len(bursts)
+    return _beats_from_counts(_apportion(requests, weights))
+
+
+#: trace-spec ``shape`` -> builder. Each builder takes the trace spec
+#: dict plus the scenario's beat count and returns ``(trace,
+#: arrival_beats)`` with one beat per request.
+def build_trace(tspec: dict, beats: int
+                ) -> tuple[list[tuple[list[int], int]], list[int]]:
+    """Materialize one workload's request stream from its declarative
+    trace spec: ``{"shape": ..., "requests": N, ...shape params}``."""
+    shape = tspec.get("shape", "uniform")
+    n = int(tspec.get("requests", 16))
+    prefix_len = int(tspec.get("prefix_len", 0))
+    if prefix_len:
+        trace = make_prefix_trace(n, prefix_len)
+    else:
+        trace = make_trace(n)
+    if shape == "uniform":
+        arrivals = uniform_arrivals(n, beats)
+    elif shape == "diurnal":
+        arrivals = diurnal_arrivals(n, beats,
+                                    peak=float(tspec.get("peak", 0.5)),
+                                    trough=float(tspec.get("trough", 0.1)))
+    elif shape == "burst":
+        arrivals = burst_arrivals(
+            n, beats, bursts=tuple(tspec.get("bursts", ())),
+            share=float(tspec.get("share", 0.7)))
+    else:
+        raise ValueError(f"unknown trace shape {shape!r}")
+    return trace, arrivals
+
+
+TRACE_SHAPES = ("uniform", "diurnal", "burst")

@@ -158,6 +158,25 @@ def _burn(vals: list[float | None], target: float,
     return round(breach / budget, 3)
 
 
+def serve_history_point(time: Any, *, ttft_p95_s: float | None = None,
+                        latency_p95_s: float | None = None,
+                        queue_depth: float | None = None,
+                        slot_occupancy: float | None = None,
+                        kv_pages_used: float | None = None) -> dict:
+    """One monitor-history point built by an *external* producer (the
+    scenario replay harness) using exactly the keys ``SLO_SIGNALS`` maps,
+    so ``evaluate_slos`` judges a replay the same way it judges the live
+    beat's persisted history. ``None`` means "no data this tick" — the
+    monitor's own convention for a cluster without jax-serve, which the
+    burn-rate math already skips instead of counting as a breach."""
+    return {"time": time,
+            "serve_ttft_p95": ttft_p95_s,
+            "serve_latency_p95": latency_p95_s,
+            "serve_queue_depth": queue_depth,
+            "serve_slot_occupancy": slot_occupancy,
+            "serve_kv_pages_used": kv_pages_used}
+
+
 def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
                   slow_window: int = 72) -> dict:
     """Judge every configured SLO over the history ``points`` (oldest

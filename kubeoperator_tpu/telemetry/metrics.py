@@ -531,6 +531,21 @@ SLO_BURN_RATE = REGISTRY.gauge(
     "period, sustained fast burn >1.0 is a page.",
     labels=("slo", "window"))
 
+# -- scenario-replay families (scenario/harness.py) -------------------------
+# Set by the replay harness when a scenario finishes: the verdict of
+# record for robustness runs, on the process-global REGISTRY so a CI
+# gate's scrape sees the same vocabulary as a controller's.
+SCENARIO_RUNS = REGISTRY.counter(
+    "ko_scenario_runs_total",
+    "Scenario replays finished, by scenario and verdict (ok | breach | "
+    "error).",
+    labels=("scenario", "verdict"))
+SCENARIO_BREACHES = REGISTRY.counter(
+    "ko_scenario_slo_breaches_total",
+    "SLO breach edges accumulated over a scenario replay's history, by "
+    "scenario and slo.",
+    labels=("scenario", "slo"))
+
 # -- autoscaler families (services/autoscaler.py) ---------------------------
 # Set by the controller's autoscale beat: scale decisions judged from the
 # persisted SLO block, so they live on the process-global REGISTRY directly.

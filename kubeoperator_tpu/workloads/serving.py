@@ -183,6 +183,26 @@ class BatcherStats:
         n = h.count()
         return h.sum() / n if n else 0.0
 
+    def ttft_quantile(self, q: float = 0.95) -> float | None:
+        """Upper-bound quantile over the TTFT histogram buckets — the
+        in-process analog of the PromQL ``histogram_quantile`` the
+        monitor scrapes, sampled per virtual beat by the scenario replay
+        harness. Returns the smallest bucket bound covering fraction
+        ``q`` of observations (the largest finite bound when the
+        quantile lands in +Inf), or ``None`` before any observation so
+        callers can record "no data" instead of a fake zero."""
+        h = self._m["ttft"]
+        slot = h.samples().get(())
+        if not slot or not slot["count"]:
+            return None
+        need = q * slot["count"]
+        cum = 0
+        for bound, n in zip(h.buckets, slot["counts"]):
+            cum += n
+            if cum >= need and bound != float("inf"):
+                return bound
+        return h.buckets[-2]
+
     def snapshot(self) -> dict:
         hist = self._m["batch_size"]
         slot = hist.samples().get(())

@@ -51,277 +51,25 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np                                              # noqa: E402
-
-from kubeoperator_tpu.workloads.serving import (                # noqa: E402
-    BatcherStats, ContinuousBatcher, DynamicBatcher, _pow2_at_most,
+# The cost-model engines, the deterministic pseudo-decode, the traces,
+# and the client-thread replay driver all moved to the scenario package
+# (round 12) so the replay harness and this bench share one copy;
+# re-imported here so `bench_serving.FakePagedEngine` etc. keep working
+# for the tier-1 tests that load this script as a module.
+from kubeoperator_tpu.scenario.driver import run_load           # noqa: E402,F401
+from kubeoperator_tpu.scenario.engines import (                 # noqa: E402,F401
+    VOCAB, FakePagedEngine, FakeRunFn, FakeSlotEngine, fake_row,
 )
-
-# the replayed trace: (prompt_len, max_tokens) cycled over --requests.
-# One long-decode request per four keeps dynamic's new_bucket pinned at
-# 128 (any fused group containing it decodes 128 for EVERY row) and its
-# prefill pinned at 8 (fusion prefills at the SHORTEST prompt, so long
-# prompts re-decode their own tail token by token), while the continuous
-# engine prefills each row at its own length and retires the three short
-# rows at 8 — the two r5 defects, in miniature.
-TRACE = ((8, 8), (16, 8), (32, 8), (64, 128))
-VOCAB = 1000
-
-
-def make_trace(n: int) -> list[tuple[list[int], int]]:
-    out = []
-    for i in range(n):
-        plen, mt = TRACE[i % len(TRACE)]
-        out.append(([(i + j) % VOCAB + 1 for j in range(plen)], mt))
-    return out
-
-
-# the round-8 shared-prefix long-tail mix: (tail_len, max_tokens) cycled.
-# Three short decodes and one 96-token straggler per four requests — the
-# straggler is what pins a dense row at worst-case length while paged
-# rows only reserve the pages they asked for.
-PREFIX_TAIL = ((4, 8), (8, 8), (6, 16), (12, 96))
-
-
-def make_prefix_trace(n: int, prefix_len: int = 64) -> list[tuple[list[int], int]]:
-    """Shared-prefix long-tail trace: every request opens with the same
-    ``prefix_len``-token system prompt (page-aligned when prefix_len is a
-    multiple of the page size), then a short unique tail. The first
-    request through each shard publishes the prefix pages; everyone after
-    hits the cache and skips that share of prefill."""
-    system = [(7 * j) % VOCAB + 1 for j in range(prefix_len)]
-    out = []
-    for i in range(n):
-        tail_len, mt = PREFIX_TAIL[i % len(PREFIX_TAIL)]
-        tail = [(i + 11 * j) % VOCAB + 1 for j in range(tail_len)]
-        out.append((system + tail, mt))
-    return out
-
-
-def fake_row(prompt: list[int], total: int) -> np.ndarray:
-    """Deterministic pseudo-tokens: position-keyed so both engines agree
-    and replies are checkable without a model."""
-    row = np.zeros((total,), np.int32)
-    row[:len(prompt)] = prompt
-    base = sum(prompt) % VOCAB
-    for p in range(len(prompt), total):
-        row[p] = (base + p) % VOCAB
-    return row
-
-
-class FakeSlotEngine:
-    """SlotPoolEngine's host protocol over numpy + injected latency —
-    the continuous side of the cost model (one ``dispatch + K * step``
-    sleep per segment, one ``dispatch + prefill`` sleep per admission
-    prefill bucket).
-
-    Mesh shapes (round 7): ``dp``/``tp`` mirror the sharded engine's cost
-    structure — the slot pool is ``slots`` TOTAL rows (the caller scales
-    it by dp, as `--mesh` users scale `--slots`), per-token work divides
-    by tp (heads shard), and every dispatch pays ``collective × log2(n)``
-    for the all-reduces GSPMD inserts (one hop per doubling). dp=tp=1
-    with collective 0 is exactly the r5/r6 single-chip model.
-    """
-
-    def __init__(self, *, slots: int = 16, segment: int = 8,
-                 max_total: int = 2048, step_s: float = 0.001,
-                 dispatch_s: float = 0.003, prefill_s: float = 0.002,
-                 dp: int = 1, tp: int = 1, collective_s: float = 0.0):
-        if slots % dp:
-            raise ValueError(f"slots ({slots}) must be divisible by dp ({dp})")
-        self.slots, self.segment, self.max_total = slots, segment, max_total
-        self.step_s, self.dispatch_s, self.prefill_s = (
-            step_s, dispatch_s, prefill_s)
-        self.dp, self.tp = dp, tp
-        # log2(n) all-reduce hops per dispatch; 0 when n_devices == 1
-        self._link_s = collective_s * (dp * tp - 1).bit_length()
-        self.buf = np.zeros((slots, max_total), np.int32)
-        self.pos = np.zeros((slots,), np.int32)
-        self.last = np.zeros((slots,), np.int32)
-        self.dispatches = 0
-        self.peak_concurrency = 0   # most rows mid-decode in one segment
-
-    def admit(self, entries):
-        by_c: dict[int, list] = {}
-        for slot, prompt_ids, max_tokens, _temp, _seed in entries:
-            prompt = list(map(int, prompt_ids))
-            by_c.setdefault(_pow2_at_most(len(prompt)), []).append(
-                (slot, prompt, int(max_tokens)))
-        out = {}
-        for c, group in by_c.items():
-            time.sleep(self.dispatch_s + self._link_s
-                       + self.prefill_s / self.tp)
-            self.dispatches += 1
-            for slot, prompt, max_tokens in group:
-                total = len(prompt) + max_tokens
-                self.buf[slot] = 0
-                self.buf[slot, :total] = fake_row(prompt, total)
-                self.pos[slot] = c
-                self.last[slot] = total - 1
-                out[slot] = c
-        return out
-
-    def run_segment(self):
-        time.sleep(self.dispatch_s + self._link_s
-                   + self.segment * self.step_s / self.tp)
-        self.dispatches += 1
-        active = self.pos < self.last
-        self.peak_concurrency = max(self.peak_concurrency, int(active.sum()))
-        self.pos = np.where(active,
-                            np.minimum(self.pos + self.segment, self.last),
-                            self.pos)
-
-    def poll(self):
-        return self.buf.copy(), self.pos.copy()
-
-
-class FakeRunFn:
-    """generate()-shaped callable for DynamicBatcher — the dynamic side
-    of the cost model. One fused batch costs ``dispatch + prefill +
-    (p_bucket - prefill_len + new_bucket) * step``: generate() scans
-    token-by-token from the prefill chunk (pow2 of the SHORTEST fused
-    prompt) through the pow2-padded decode length — run-to-completion at
-    the worst row's shape, which is exactly what the slot pool removes."""
-
-    def __init__(self, *, step_s: float = 0.001, dispatch_s: float = 0.003,
-                 prefill_s: float = 0.002):
-        self.step_s, self.dispatch_s, self.prefill_s = (
-            step_s, dispatch_s, prefill_s)
-        self.dispatches = 0
-
-    def __call__(self, prompts, lens, max_new, temp, prefill, seed):
-        steps = len(prompts[0]) - prefill + max_new
-        time.sleep(self.dispatch_s + self.prefill_s + steps * self.step_s)
-        self.dispatches += 1
-        width = len(prompts[0]) + max_new
-        out = np.zeros((len(prompts), width), np.int32)
-        for i, (row, n) in enumerate(zip(prompts, lens)):
-            out[i] = fake_row(list(row[:n]), width)
-        return out
-
-
-class FakePagedEngine(FakeSlotEngine):
-    """FakeSlotEngine plus the paged engine's host accounting protocol
-    (round 8): a pool of ``pages`` blocks of ``page`` token positions
-    split over dp shards (one reserved trash page each), a conservative
-    ``ceil((plen + max_tokens) / page)`` reservation per admitted slot,
-    and a capacity-free prefix cache keyed on page-aligned prompt
-    prefixes — a hit skips the cached share of the prefill sleep, which
-    is the TTFT win the tier-1 guard measures. ``ContinuousBatcher``
-    detects the protocol via ``pages_for`` and admits against free pages
-    instead of free slots, exactly as with the real ``SlotPoolEngine``."""
-
-    def __init__(self, *, page: int = 16, pages: int | None = None, **kw):
-        super().__init__(**kw)
-        if page <= 0 or page & (page - 1):
-            raise ValueError(f"page ({page}) must be a power of two")
-        self.page = page
-        self.pages = (self.slots * (self.max_total // page) + self.dp
-                      if pages is None else pages)
-        self._span = self.pages // self.dp
-        self._shard_slots = self.slots // self.dp
-        self._free_pg = [self._span - 1] * self.dp    # minus the trash page
-        self._held: dict[int, tuple[int, int]] = {}   # slot -> (shard, pages)
-        self._prefix: list[set[tuple[int, ...]]] = [
-            set() for _ in range(self.dp)]
-        self.prefix_hits = 0
-
-    @property
-    def max_request_pages(self) -> int:
-        return self._span - 1
-
-    def pages_for(self, prompt_len: int, max_tokens: int) -> int:
-        return -(-(prompt_len + max_tokens) // self.page)
-
-    def free_pages(self, shard: int = 0) -> int:
-        return self._free_pg[shard]
-
-    def evictable_pages(self, shard: int = 0) -> int:
-        return 0    # the cost model's prefix cache holds no pages itself
-
-    def pages_in_use(self, shard: int = 0) -> int:
-        return (self._span - 1) - self._free_pg[shard]
-
-    def _hit_pages(self, shard: int, prompt: list[int]) -> int:
-        for n in range(len(prompt) // self.page, 0, -1):
-            if tuple(prompt[:n * self.page]) in self._prefix[shard]:
-                return n
-        return 0
-
-    def admit(self, entries):
-        by_c: dict[int, list] = {}
-        for slot, prompt_ids, max_tokens, _temp, _seed in entries:
-            prompt = list(map(int, prompt_ids))
-            by_c.setdefault(_pow2_at_most(len(prompt)), []).append(
-                (slot, prompt, int(max_tokens)))
-        out = {}
-        for c, group in by_c.items():
-            uncached = 0.0   # the bucket prefills at its worst row's share
-            for slot, prompt, max_tokens in group:
-                shard = slot // self._shard_slots
-                hit = self._hit_pages(shard, prompt)
-                if hit:
-                    self.prefix_hits += 1
-                uncached = max(
-                    uncached, (len(prompt) - hit * self.page) / len(prompt))
-                need = self.pages_for(len(prompt), max_tokens)
-                self._free_pg[shard] -= need
-                assert self._free_pg[shard] >= 0, "batcher over-admitted"
-                self._held[slot] = (shard, need)
-                for n in range(1, len(prompt) // self.page + 1):
-                    self._prefix[shard].add(tuple(prompt[:n * self.page]))
-                total = len(prompt) + max_tokens
-                self.buf[slot] = 0
-                self.buf[slot, :total] = fake_row(prompt, total)
-                self.pos[slot] = c
-                self.last[slot] = total - 1
-                out[slot] = c
-            if uncached > 0:
-                time.sleep(self.dispatch_s + self._link_s
-                           + uncached * self.prefill_s / self.tp)
-                self.dispatches += 1
-        return out
-
-    def release(self, slots):
-        for s in slots:
-            shard, held = self._held.pop(int(s), (0, 0))
-            self._free_pg[shard] += held
-
-
-def run_load(batcher, trace, stagger_s: float) -> dict:
-    """Replay the trace with staggered client threads; aggregate tok/s
-    counts only the NEW tokens each request asked for."""
-    results: dict[int, list[int]] = {}
-    errors: list[Exception] = []
-
-    def client(i, prompt, max_tokens):
-        time.sleep(i * stagger_s)
-        try:
-            results[i] = batcher.submit(prompt, max_tokens, timeout=120.0)
-        except Exception as e:  # noqa: BLE001 — surfaced below
-            errors.append(e)
-
-    threads = [threading.Thread(target=client, args=(i, p, mt))
-               for i, (p, mt) in enumerate(trace)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
-    tokens = sum(mt for _, mt in trace)
-    for i, (prompt, mt) in enumerate(trace):
-        got = results[i]
-        assert got[:len(prompt)] == list(prompt), f"request {i} lost prompt"
-        assert len(got) == len(prompt) + mt, f"request {i} wrong length"
-    return {"wall_s": wall, "tokens": tokens, "tok_s": tokens / wall}
+from kubeoperator_tpu.scenario.traces import (                  # noqa: E402,F401
+    PREFIX_TAIL, REQUEST_MIX as TRACE, make_prefix_trace, make_trace,
+)
+from kubeoperator_tpu.workloads.serving import (                # noqa: E402
+    BatcherStats, ContinuousBatcher, DynamicBatcher,
+)
 
 
 def bench(requests: int, slots: int, segment: int, max_batch: int,

@@ -210,6 +210,54 @@ def test_chaos_kill_after_and_revive():
     assert chaos.run(conn, "true").ok
 
 
+def test_restore_slice_revives_only_hosts_the_revocation_killed():
+    # 10.0.0.7 was already dead (unrelated kill) when the slice containing
+    # it got revoked; restoring the slice must not resurrect it.
+    chaos = ChaosExecutor(FakeExecutor(), seed=0)
+    chaos.kill_after("10.0.0.7", 0)
+    assert chaos.run(Conn(ip="10.0.0.7"), "true").rc == 255  # now dead
+
+    chaos.revoke_slice("tpu-x", ["10.0.0.6", "10.0.0.7", "10.0.0.8"])
+    for ip in ("10.0.0.6", "10.0.0.7", "10.0.0.8"):
+        assert chaos.run(Conn(ip=ip), "true").rc == 255
+
+    restored = chaos.restore_slice("tpu-x")
+    assert restored == ["10.0.0.6", "10.0.0.8"]  # not the pre-dead host
+    assert chaos.run(Conn(ip="10.0.0.6"), "true").ok
+    assert chaos.run(Conn(ip="10.0.0.8"), "true").ok
+    assert chaos.run(Conn(ip="10.0.0.7"), "true").rc == 255  # stays dead
+    chaos.revive("10.0.0.7")
+    assert chaos.run(Conn(ip="10.0.0.7"), "true").ok
+
+
+def test_chaos_latency_jitter_replays_exactly_under_fixed_seed(monkeypatch):
+    def delay_sequence(seed):
+        chaos = ChaosExecutor(FakeExecutor(), seed=seed)
+        chaos.latency(r"decode", 0.005, jitter_s=0.01)
+        slept = []
+        monkeypatch.setattr("kubeoperator_tpu.engine.executor.time.sleep",
+                            slept.append)
+        for i in range(16):
+            chaos.run(Conn(ip="10.0.0.1"), f"decode step={i}")
+        return slept
+
+    a, b = delay_sequence(9), delay_sequence(9)
+    assert a == b and len(a) == 16              # exact fixed-seed replay
+    assert all(0.005 <= d < 0.015 for d in a)   # base + uniform[0, jitter)
+    assert len(set(a)) > 1                      # jitter actually varies
+    assert delay_sequence(10) != a              # and is seed-driven
+
+
+def test_chaos_latency_is_pattern_scoped_and_stacks_with_global():
+    chaos = ChaosExecutor(FakeExecutor(), seed=0)
+    chaos.latency_s = 0.001
+    chaos.latency(r"decode", 0.004)             # no jitter: deterministic
+    assert chaos._latency_for("10.0.0.1", "healthz") == 0.001
+    assert chaos._latency_for("10.0.0.1", "decode x") == 0.005
+    with pytest.raises(ValueError):
+        chaos.latency(r"x", -1.0)
+
+
 # ---------------------------------------------------------------------------
 # platform fixtures: a chaos-wrapped fake behind a real Platform
 # ---------------------------------------------------------------------------

@@ -347,3 +347,70 @@ def test_evaluate_slos_exactly_window_sized_history_judges():
             for e in block["events"]] == [("no_data", "breach")]
     # the slow window (6) is still short of history → still unjudged
     assert s["burn_rate"]["slow"] is None
+
+
+def test_evaluate_slos_gapped_history_skips_missing_points():
+    """Bursty replays leave quiet beats with no serving data (the harness
+    stamps None). Burn math judges only the beats that measured: a gap
+    is neither a breach nor a pass, it simply isn't evidence."""
+    def gapped(v):
+        return [{"time": f"t{i}", "serve_ttft_p95": x}
+                for i, x in enumerate((v, None, v, None, v))]
+
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, gapped(0.1),
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    # the None beats inside the fast window are skipped, not counted as
+    # breaches: burn stays zero and no spurious breach edge fires
+    assert s["state"] == "ok" and s["burn_rate"]["fast"] == 0.0
+    assert block["events"] == []
+    assert s["value"] == 100.0 and s["met"] is True
+
+    # ...and symmetrically they must not dilute a real breach: the two
+    # known points in the window both breach, so the budget is gone even
+    # though a third of the window's beats were idle
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, gapped(9.9),
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
+
+
+def test_evaluate_slos_burst_then_idle_tail_holds_last_verdict():
+    """A breach verdict reached during the burst must not silently decay
+    to 'ok' as idle (None) beats stream in afterwards: with fewer than
+    fast_window known points in the tail the SLO goes unjudged, never
+    green, and no recovery edge is emitted."""
+    burst = _pts(9.9, 9.9, 9.9)
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, burst,
+                              fast_window=3, slow_window=6)
+    assert block["slos"]["ttft_p95_ms"]["state"] == "breach"
+
+    idle = burst + [{"time": f"q{i}", "serve_ttft_p95": None}
+                    for i in range(4)]
+    block = mon.evaluate_slos({"ttft_p95_ms": 500}, idle,
+                              fast_window=3, slow_window=6)
+    s = block["slos"]["ttft_p95_ms"]
+    assert s["state"] == "no_data"            # unjudged, not green
+    assert s["burn_rate"]["fast"] is None
+    assert block["events"] == []              # no spurious ok/recovery edge
+
+
+def test_evaluate_slos_uneven_spacing_burn_is_per_point_not_per_time():
+    """History points from a bursty replay are unevenly spaced in time.
+    Burn rates are defined over the last-N *points*, so stretching or
+    compressing the timestamps must not change any number or verdict."""
+    def stamped(times):
+        vals = (9.9, 0.1, 9.9, 0.1, 9.9, 0.1)
+        return [{"time": t, "serve_ttft_p95": v} for t, v in zip(times, vals)]
+
+    dense = stamped(["00:00", "00:01", "00:02", "00:03", "00:04", "00:05"])
+    sparse = stamped(["00:00", "00:01", "00:02", "09:00", "11:30", "23:59"])
+    a = mon.evaluate_slos({"ttft_p95_ms": 500}, dense,
+                          fast_window=3, slow_window=6)
+    b = mon.evaluate_slos({"ttft_p95_ms": 500}, sparse,
+                          fast_window=3, slow_window=6)
+    sa, sb = a["slos"]["ttft_p95_ms"], b["slos"]["ttft_p95_ms"]
+    assert sa == sb                           # timestamps are labels only
+    assert sa["burn_rate"]["fast"] == sb["burn_rate"]["fast"]
+    assert [(e["from"], e["to"]) for e in a["events"]] == \
+        [(e["from"], e["to"]) for e in b["events"]]

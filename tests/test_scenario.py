@@ -1,0 +1,335 @@
+"""Scenario replay harness: declarative specs, deterministic traces, the
+shared load driver, chaos-under-SLO replays, and the checked-in
+SCENARIO artifact.
+
+The acceptance replay here is the robustness gate of record: a
+truncated burst scenario drives two concurrent workloads (a serving
+trace plus a colocated train job) through a scheduled mid-decode
+``revoke_slice``, and the run only passes if the final SLO verdict is
+clean AND every requeued request's reply is token-for-token what a solo
+``generate()`` would have produced.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubeoperator_tpu import ctl
+from kubeoperator_tpu.scenario import (
+    SCENARIOS, get_scenario, list_scenarios, load_spec, run_load,
+    run_scenario, run_scenarios, validate_spec,
+)
+from kubeoperator_tpu.scenario.traces import (
+    _apportion, burst_arrivals, build_trace, diurnal_arrivals, make_trace,
+    uniform_arrivals,
+)
+from kubeoperator_tpu.telemetry import metrics as tm
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENGINE = {"kind": "paged", "slots": 8, "dp": 2, "tp": 1, "segment": 4,
+           "max_total": 128, "page": 16,
+           "step_s": 0.0004, "dispatch_s": 0.001, "prefill_s": 0.001}
+
+
+def _quick_spec(name="quick", slos=None, chaos=(), **over):
+    """A seconds-scale spec for exit-code / breach-path tests."""
+    spec = {
+        "name": name, "beats": 6, "beat_s": 30.0, "beat_wall_s": 0.03,
+        "engine": dict(_ENGINE),
+        "hosts": ["10.0.0.1", "10.0.0.2", "10.0.0.3"],
+        "slice": {"id": "tpu-a", "ips": ["10.0.0.2", "10.0.0.3"],
+                  "shard": 1},
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "trace": {"shape": "burst", "requests": 12, "bursts": [0],
+                       "share": 0.9, "prefix_len": 16},
+             "serve_slos": slos or {"ttft_p95_ms": 8000}},
+        ],
+        "chaos": list(chaos),
+        "slo_windows": {"fast": 2, "slow": 4},
+    }
+    spec.update(over)
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# spec schema + catalog
+# ---------------------------------------------------------------------------
+
+def test_validate_spec_reports_every_problem_at_once():
+    errs = validate_spec({
+        "name": "", "beats": -3,
+        "engine": {"kind": "warp"},
+        "workloads": [
+            {"kind": "serving", "trace": {"shape": "sawtooth"},
+             "serve_slos": {"made_up_slo": 1, "ttft_p95_ms": "fast"}},
+            {"kind": "blob"},
+        ],
+        "chaos": [
+            {"beat": 99, "kind": "flake"},              # out of range, no
+            {"beat": 0, "kind": "revoke_slice"},        #   pattern/rate;
+            {"beat": 0, "kind": "meteor"},              #   no slice; bogus
+        ],
+    })
+    text = "\n".join(errs)
+    for frag in ("name:", "beats:", "engine.kind", "trace.shape",
+                 "made_up_slo", "target must be a number",
+                 "workloads[1].kind", "chaos[0].beat", "pattern",
+                 "revoke_slice needs a slice block", "chaos[2].kind"):
+        assert frag in text, f"missing {frag!r} in:\n{text}"
+    assert validate_spec("nope") == ["spec must be a mapping"]
+    assert validate_spec({"name": "x", "beats": 2, "workloads": [
+        {"kind": "train", "name": "t"}]}) \
+        == ["workloads: at least one serving/pipeline workload is required "
+            "(the SLO verdict is the outcome of record)"]
+
+
+def test_run_scenario_rejects_invalid_spec():
+    with pytest.raises(ValueError, match="invalid scenario spec"):
+        run_scenario({"name": "bad", "beats": 0, "workloads": []})
+
+
+def test_catalog_specs_validate_and_list():
+    for name, spec in SCENARIOS.items():
+        assert validate_spec(spec) == [], name
+        assert get_scenario(name) is spec
+    rows = list_scenarios()
+    assert {r["name"] for r in rows} == set(SCENARIOS)
+    assert all(r["chaos"] and r["description"] for r in rows)
+    with pytest.raises(KeyError, match="unknown scenario"):
+        get_scenario("nope")
+
+
+def test_load_spec_dict_catalog_name_and_yaml(tmp_path):
+    import yaml
+    d = {"name": "inline"}
+    assert load_spec(d) is d
+    assert load_spec("burst_preemption") is SCENARIOS["burst_preemption"]
+    p = tmp_path / "s.yaml"
+    p.write_text(yaml.safe_dump(_quick_spec(name="from-yaml")))
+    assert load_spec(str(p))["name"] == "from-yaml"
+    with pytest.raises(FileNotFoundError):
+        load_spec("no_such_scenario")
+
+
+# ---------------------------------------------------------------------------
+# trace + arrival generators: pure functions of their parameters
+# ---------------------------------------------------------------------------
+
+def test_arrival_shapes_are_deterministic_and_conserve_requests():
+    for arrivals in (uniform_arrivals(33, 7),
+                     diurnal_arrivals(33, 7, peak=0.4),
+                     burst_arrivals(33, 7, bursts=(1, 2), share=0.7)):
+        assert len(arrivals) == 33                    # every request lands
+        assert arrivals == sorted(arrivals)           # oldest first
+        assert all(0 <= b < 7 for b in arrivals)
+    assert diurnal_arrivals(33, 7, peak=0.4) == \
+        diurnal_arrivals(33, 7, peak=0.4)             # no hidden RNG
+
+
+def test_diurnal_peaks_where_asked_and_keeps_trough_floor():
+    arrivals = diurnal_arrivals(120, 10, peak=0.5, trough=0.1)
+    counts = [arrivals.count(b) for b in range(10)]
+    assert counts.index(max(counts)) == 5             # peak at 50% of run
+    assert min(counts) >= 1                           # floor: never zero
+
+
+def test_burst_concentrates_share_on_burst_beats():
+    arrivals = burst_arrivals(40, 10, bursts=(2,), share=0.7)
+    assert arrivals.count(2) >= 28                    # ~70% on the burst
+    with pytest.raises(ValueError, match="outside"):
+        burst_arrivals(10, 5, bursts=(9,))
+
+
+def test_apportion_largest_remainder_sums_exactly():
+    assert sum(_apportion(17, [0.2, 0.5, 0.3])) == 17
+    assert _apportion(3, [1.0, 1.0, 1.0]) == [1, 1, 1]
+    with pytest.raises(ValueError):
+        _apportion(5, [0.0, 0.0])
+
+
+def test_build_trace_dispatches_shape_and_prefix():
+    tspec = {"shape": "burst", "requests": 8, "bursts": [1], "share": 0.5,
+             "prefix_len": 16}
+    trace, arrivals = build_trace(tspec, 4)
+    assert len(trace) == len(arrivals) == 8
+    shared = trace[0][0][:16]
+    assert all(p[:16] == shared for p, _ in trace)    # shared system prefix
+    plain, _ = build_trace({"shape": "uniform", "requests": 4}, 4)
+    assert plain == make_trace(4)
+
+
+# ---------------------------------------------------------------------------
+# the shared driver (bench + harness replay through the same loop)
+# ---------------------------------------------------------------------------
+
+class _EchoBatcher:
+    def submit(self, prompt, max_tokens, timeout=None):
+        return list(prompt) + [0] * max_tokens
+
+
+def test_run_load_offsets_and_on_result_hook():
+    trace = make_trace(4)
+    seen = []
+    out = run_load(_EchoBatcher(), trace, offsets=[0.0] * 4,
+                   on_result=lambda i, p, mt, got: seen.append((i, len(got))))
+    assert sorted(out["results"]) == [0, 1, 2, 3]
+    assert sorted(seen) == [(i, len(p) + mt)
+                            for i, (p, mt) in enumerate(trace)]
+    assert out["tokens"] == sum(mt for _, mt in trace)
+    with pytest.raises(ValueError, match="offsets"):
+        run_load(_EchoBatcher(), trace, offsets=[0.0])
+
+
+def test_bench_imports_driver_and_engines_from_scenario_package():
+    """scripts/bench_serving.py replays through the factored package —
+    same objects, not copies."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bs_reexport", os.path.join(ROOT, "scripts", "bench_serving.py"))
+    bs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bs)
+    import kubeoperator_tpu.scenario as sc
+    assert bs.run_load is sc.run_load
+    assert bs.FakePagedEngine is sc.FakePagedEngine
+    assert bs.make_prefix_trace is sc.make_prefix_trace
+
+
+# ---------------------------------------------------------------------------
+# the acceptance replay: burst + colocated train + mid-decode revocation
+# ---------------------------------------------------------------------------
+
+def test_replay_survives_slice_revocation_with_clean_slo_verdict(tmp_path):
+    """ISSUE-12 acceptance: two concurrent workloads (serving + train)
+    through a scheduled single-fault ``revoke_slice`` mid-decode. The
+    drain requeues in-flight work, the restore readmits, and the run
+    must end with zero SLO breach, every reply bit-identical to solo
+    generate(), and the artifact carrying the full injection log."""
+    runs0 = tm.SCENARIO_RUNS.value(scenario="burst_preemption", verdict="ok")
+    out = str(tmp_path / "SCENARIO_test.json")
+    art = run_scenarios([SCENARIOS["burst_preemption"]], out=out)
+    assert art["ok"] is True
+    r = art["scenarios"][0]
+
+    # the scheduled fault actually fired, mid-decode work got requeued...
+    kinds = [e["kind"] for e in r["chaos"]["injections"]]
+    assert kinds == ["revoke_slice", "restore_slice"]
+    rev = r["chaos"]["injections"][0]
+    assert rev["target"] == "tpu-a" and rev["requeued"] >= 1
+    assert r["requeued_total"] >= 1
+    assert r["chaos"]["injections"][1]["restored"] == \
+        ["10.0.0.2", "10.0.0.3"]
+
+    # ...every reply (requeued ones included) matches solo generate()...
+    assert r["bit_exact"] is True
+    chat = r["workloads"]["chat"]
+    assert chat["requests"] == 32 and chat["errors_total"] == 0
+    assert chat["requeued_total"] >= 1 and chat["bit_exact"] is True
+
+    # ...the final SLO verdict over the whole history is clean...
+    assert r["verdict"] == "ok" and chat["slo_ok"] is True
+    assert not [e for e in chat["breach_events"] if e["to"] == "breach"]
+    assert {"ttft_p95_ms", "queue_depth_max"} <= set(chat["slos"])
+    assert all(s["state"] in ("ok", "no_data")
+               for s in chat["slos"].values())
+
+    # ...the colocated train job saw the preemption as transient steps...
+    train = r["train"]["colo-train"]
+    assert train["steps"] > 0 and train["transient_failures"] >= 1
+
+    # ...and the artifact on disk round-trips with the full schema.
+    disk = json.load(open(out))
+    assert disk["ok"] is True and disk["scenarios"][0]["scenario"] == \
+        "burst_preemption"
+    assert tm.SCENARIO_RUNS.value(scenario="burst_preemption",
+                                  verdict="ok") == runs0 + 1
+
+
+def test_pipeline_scenario_judges_each_stage_separately():
+    r = run_scenario(SCENARIOS["pipeline_two_stage"])
+    assert set(r["workloads"]) == {"asr-llm", "asr-llm:stage2"}
+    s1, s2 = r["workloads"]["asr-llm"], r["workloads"]["asr-llm:stage2"]
+    assert s1["requests"] == s2["requests"] == 16   # every reply chained
+    assert set(s1["slos"]) == {"ttft_p95_ms"}       # distinct per-stage SLOs
+    assert set(s2["slos"]) == {"ttft_p95_ms", "queue_depth_max"}
+    assert s1["bit_exact"] and s2["bit_exact"]
+    assert r["verdict"] == "ok"
+
+
+def test_impossible_slo_target_yields_breach_verdict():
+    runs0 = tm.SCENARIO_RUNS.value(scenario="doomed", verdict="breach")
+    r = run_scenario(_quick_spec(name="doomed",
+                                 slos={"ttft_p95_ms": 0.0001}))
+    assert r["verdict"] == "breach" and r["ok"] is False
+    chat = r["workloads"]["chat"]
+    assert chat["slo_ok"] is False
+    assert any(e["to"] == "breach" for e in chat["breach_events"])
+    assert chat["bit_exact"] is True      # tokens still correct — only
+    assert chat["errors_total"] == 0      #   the SLO was unachievable
+    assert tm.SCENARIO_RUNS.value(scenario="doomed",
+                                  verdict="breach") == runs0 + 1
+    assert tm.SCENARIO_BREACHES.value(scenario="doomed",
+                                      slo="ttft_p95_ms") >= 1
+
+
+# ---------------------------------------------------------------------------
+# ko scenario CLI + the checked-in artifact
+# ---------------------------------------------------------------------------
+
+def test_ctl_scenario_check_exit_semantics(tmp_path, capsys):
+    import yaml
+    ok = tmp_path / "ok.yaml"
+    ok.write_text(yaml.safe_dump(_quick_spec(name="cli-ok")))
+    assert ctl.main(["scenario", "run", "--spec", str(ok), "--check"]) == 0
+    assert "cli-ok: ok" in capsys.readouterr().out
+
+    doomed = tmp_path / "doomed.yaml"
+    doomed.write_text(yaml.safe_dump(
+        _quick_spec(name="cli-doomed", slos={"ttft_p95_ms": 0.0001})))
+    assert ctl.main(["scenario", "run", "--spec", str(doomed),
+                     "--check"]) == 2
+    assert "cli-doomed: breach" in capsys.readouterr().out
+    # without --check a breach still reports, but exits clean (report mode)
+    assert ctl.main(["scenario", "run", "--spec", str(doomed)]) == 0
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({"name": "bad", "beats": 0}))
+    assert ctl.main(["scenario", "run", "--spec", str(bad)]) == 1
+    assert "beats" in capsys.readouterr().err
+
+
+def test_ctl_scenario_list_prints_catalog(capsys):
+    assert ctl.main(["scenario", "list"]) == 0
+    out = capsys.readouterr().out
+    for name in SCENARIOS:
+        assert name in out
+
+
+def test_checked_in_scenario_artifact_schema():
+    art = json.load(open(os.path.join(ROOT, "SCENARIO_r01.json")))
+    assert art["run"] == "r01" and art["ok"] is True
+    assert {r["scenario"] for r in art["scenarios"]} == set(SCENARIOS)
+    for r in art["scenarios"]:
+        assert {"scenario", "ok", "verdict", "seed", "beats", "beat_s",
+                "beat_wall_s", "slo_windows", "workloads", "train", "chaos",
+                "requeued_total", "bit_exact", "errors"} <= set(r)
+        assert r["verdict"] == "ok" and r["bit_exact"] is True
+        assert r["errors"] == []
+        assert {"injections", "injected_total",
+                "probe_failures"} <= set(r["chaos"])
+        for w in r["workloads"].values():
+            assert {"requests", "wall_s", "tok_s", "requeued_total",
+                    "errors_total", "error", "bit_exact", "slo_ok", "slos",
+                    "breach_events"} <= set(w)
+            assert w["slo_ok"] is True and w["bit_exact"] is True
+    bp = next(r for r in art["scenarios"]
+              if r["scenario"] == "burst_preemption")
+    assert [e["kind"] for e in bp["chaos"]["injections"]] == \
+        ["revoke_slice", "restore_slice"]
+    assert bp["chaos"]["injections"][0]["requeued"] >= 1
+    assert bp["requeued_total"] >= 1, "preemption never hit in-flight work"
+    pipe = next(r for r in art["scenarios"]
+                if r["scenario"] == "pipeline_two_stage")
+    assert set(pipe["workloads"]) == {"asr-llm", "asr-llm:stage2"}
