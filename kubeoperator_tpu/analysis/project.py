@@ -33,10 +33,11 @@ _RULE_ROW = re.compile(r"^\| (KO\d{3}) ")
 _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
 #: README sections whose metric tables must equal the registry
-_TABLE_SECTIONS = ("## Observability", "## Serving", "## Scenario replay")
+_TABLE_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
+                   "## Scenario replay")
 #: README sections whose inline ko_* mentions must be registered
-_MENTION_SECTIONS = ("## Observability", "## Serving", "## Scheduling",
-                     "## Scenario replay")
+_MENTION_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
+                     "## Scheduling", "## Scenario replay")
 
 
 class ProjectRule(Rule):

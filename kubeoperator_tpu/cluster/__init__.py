@@ -1,0 +1,12 @@
+"""Cluster-tier serving: the ``ServeGateway`` routing tier over N
+batcher replicas (sticky prefix hashing + load spill-over + gateway-
+level requeue on replica loss) and the disaggregated prefill→decode
+page-handoff workers."""
+
+from kubeoperator_tpu.cluster.disagg import PrefillWorker, aligned_prefix
+from kubeoperator_tpu.cluster.gateway import (
+    POLICIES, AggregateStats, ServeGateway,
+)
+
+__all__ = ["POLICIES", "AggregateStats", "PrefillWorker", "ServeGateway",
+           "aligned_prefix"]

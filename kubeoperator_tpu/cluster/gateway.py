@@ -1,0 +1,365 @@
+"""Cluster-tier serving: one gateway fronting N batcher replicas.
+
+One paged ``SlotPoolEngine`` — however well it batches and pages — is a
+single-replica ceiling. ``ServeGateway`` is the next scale axis: N
+independent ``ContinuousBatcher`` + engine replicas (cost-model or real
+mesh each) behind one ``submit`` with the batcher's own signature, so
+every existing driver (``run_load``, the serve job, the scenario
+harness) drives a cluster exactly like it drives one replica.
+
+The router reads two signals:
+
+* **prefix affinity** — the hashed first ``affinity_pages`` pages of the
+  prompt pick a *home* replica (``hash % N`` over all replicas, draining
+  or not, so the mapping is stable across drains). Requests sharing a
+  page-aligned prefix keep landing where their pages already sit, which
+  turns the per-shard LRU prefix cache into a cluster-wide cache with no
+  coherence protocol — just sticky hashing. Hashing only the leading
+  page(s) matters: a full-prefix hash would fold each request's unique
+  tail in and spray one tenant's traffic across every replica.
+* **load** — queued + in-flight requests per replica (``backlog``), with
+  free + evictable KV pages as the tiebreak. When the home replica is
+  saturated (backlog at ``spill_after``) or draining, the request
+  spills to the least-loaded healthy replica: worse for affinity,
+  necessary for tail latency. ``round_robin`` and ``least_loaded``
+  policies skip the affinity signal entirely (the A/B baselines).
+
+Replica loss rides the batcher's drain protocol: ``drain_replica`` wires
+every batcher's ``requeue_sink`` back here, so mid-decode victims (and,
+once every shard is fenced, the stranded queue) re-enter the *gateway*
+queue in submission order and a dispatcher thread re-routes them to
+healthy replicas — their ``done`` events travel with them, so blocked
+clients never notice the migration. Greedy decode is deterministic and
+sampling is (seed, position)-keyed, so tokens through any routing
+policy, spill-over, or mid-trace replica loss stay bit-identical to a
+solo ``generate()`` (pinned by tests/test_cluster.py).
+
+With a ``disagg.PrefillWorker`` attached, long prompts additionally
+prefill on a dedicated worker and the finished pages ship to the routed
+replica as block-table page lists (``engine.import_prefix``) before the
+request is submitted — so the decode replica's admission sees a prefix
+hit and its in-flight decodes stop losing segment time to other
+tenants' prefills.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Sequence
+
+from kubeoperator_tpu.telemetry import metrics as tm
+
+POLICIES = ("sticky_prefix", "round_robin", "least_loaded")
+
+
+class AggregateStats:
+    """Read-only cluster view over N replicas' ``BatcherStats`` with the
+    per-replica API the monitor/harness sampling already speaks —
+    counters sum, gauges sum (they are pool sizes), latency quantiles
+    take the worst replica (conservative for SLOs), and TTFT quantiles
+    merge the underlying histogram counts (a p95 of p95s is not a p95)."""
+
+    _SUMMED = ("requests_total", "errors_total", "batches_total",
+               "tokens_generated_total", "queue_depth", "slot_occupancy",
+               "kv_pages_used", "prefix_hits_total",
+               "requests_requeued_total")
+
+    def __init__(self, stats: Sequence[Any]):
+        if not stats:
+            raise ValueError("AggregateStats needs at least one BatcherStats")
+        self._stats = list(stats)
+
+    def snapshot(self) -> dict:
+        snaps = [s.snapshot() for s in self._stats]
+        out: dict = {k: sum(s[k] for s in snaps) for k in self._SUMMED}
+        hist: dict = {}
+        for s in snaps:
+            for k, v in s["batch_size_hist"].items():
+                hist[k] = hist.get(k, 0) + v
+        out["batch_size_hist"] = hist
+        for k in ("latency_p50_s", "latency_p95_s"):
+            out[k] = max(s[k] for s in snaps)
+        return out
+
+    def ttft_histogram(self) -> tuple[tuple[float, ...], list[int], int,
+                                      float]:
+        buckets, counts, n, total = self._stats[0].ttft_histogram()
+        counts = list(counts)
+        for s in self._stats[1:]:
+            b2, c2, n2, t2 = s.ttft_histogram()
+            if b2 != buckets:
+                raise ValueError("replicas disagree on TTFT buckets")
+            counts = [a + b for a, b in zip(counts, c2)]
+            n += n2
+            total += t2
+        return buckets, counts, n, total
+
+    def ttft_mean(self) -> float:
+        _, _, n, total = self.ttft_histogram()
+        return total / n if n else 0.0
+
+    def ttft_quantile(self, q: float = 0.95) -> float | None:
+        buckets, counts, n, _ = self.ttft_histogram()
+        if not n:
+            return None
+        need = q * n
+        cum = 0
+        for bound, c in zip(buckets, counts):
+            cum += c
+            if cum >= need and bound != float("inf"):
+                return bound
+        return buckets[-2]
+
+
+class _Replica:
+    """One routing target: index is the sticky hash's stable identity."""
+
+    __slots__ = ("index", "batcher", "draining")
+
+    def __init__(self, index: int, batcher: Any):
+        self.index = index
+        self.batcher = batcher
+        self.draining = False
+
+
+class ServeGateway:
+    """Two-signal router over N ``ContinuousBatcher`` replicas; see the
+    module docstring for the routing discipline. ``submit`` has the
+    batcher's signature, so the gateway drops into any existing driver.
+
+    Construction wires each batcher's ``requeue_sink`` and ``replica``
+    stamp — the batchers must not already belong to another gateway."""
+
+    def __init__(self, batchers: Sequence[Any], *,
+                 policy: str = "sticky_prefix", affinity_pages: int = 1,
+                 spill_after: int | None = None, prefill_worker: Any = None,
+                 handoff_min_pages: int = 1):
+        if not batchers:
+            raise ValueError("ServeGateway needs at least one batcher")
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        if affinity_pages < 1:
+            raise ValueError(f"affinity_pages must be >= 1, "
+                             f"got {affinity_pages}")
+        self.policy = policy
+        self.affinity_pages = int(affinity_pages)
+        self._page = int(getattr(batchers[0].engine, "page", 16))
+        # saturation threshold: twice the pool depth tolerates a burst's
+        # queueing (affinity survives) but sheds a truly hot replica
+        self._spill_after = (int(spill_after) if spill_after is not None
+                             else 2 * int(batchers[0].engine.slots))
+        self._prefill = prefill_worker
+        self._handoff_min_pages = int(handoff_min_pages)
+        self.replicas = [_Replica(i, b) for i, b in enumerate(batchers)]
+        self.stats = AggregateStats([b.stats for b in batchers])
+        self._lock = threading.Lock()
+        self._gcond = threading.Condition(self._lock)
+        self._gq: deque = deque()           # gateway requeue queue
+        self._rr = 0
+        self._routed: dict[tuple[int, str], int] = {}
+        self._sticky_hits = 0               # landed on the hashed home
+        self._sticky_total = 0              # had a sticky-eligible prefix
+        self._handoff_pages = 0
+        self._requeued_total = 0
+        self._handed: list[set[tuple[int, ...]]] = [set() for _ in batchers]
+        for r in self.replicas:
+            r.batcher.requeue_sink = self._sink
+            r.batcher.replica = r.index
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="ko-gateway")
+        self._dispatcher.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int], max_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               timeout: float | None = 300.0) -> list[int]:
+        prompt = list(prompt_ids)
+        idx, decision = self._route(prompt)
+        tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+        if self._prefill is not None:
+            self._maybe_handoff(idx, prompt)
+        return self.replicas[idx].batcher.submit(
+            prompt, max_tokens, temperature, seed, timeout=timeout)
+
+    # -- routing ------------------------------------------------------------
+    def _sticky_key(self, prompt: list[int]) -> int | None:
+        span = self.affinity_pages * self._page
+        if len(prompt) < span:
+            return None      # no page-aligned prefix to be sticky about
+        # tuples of ints hash deterministically (PYTHONHASHSEED only
+        # perturbs str/bytes), so the home mapping is reproducible
+        return hash(tuple(prompt[:span]))
+
+    def _load_key(self, r: _Replica) -> tuple[int, int, int]:
+        eng = r.batcher.engine
+        cap = 0
+        if hasattr(eng, "pages_for"):
+            cap = sum(eng.free_pages(s) + eng.evictable_pages(s)
+                      for s in range(max(1, int(getattr(eng, "dp", 1)))))
+        return (r.batcher.backlog(), -cap, r.index)
+
+    def _saturated(self, r: _Replica) -> bool:
+        return r.batcher.backlog() >= self._spill_after
+
+    def _route(self, prompt: list[int], requeue: bool = False
+               ) -> tuple[int, str]:
+        with self._lock:
+            healthy = [r for r in self.replicas if not r.draining]
+            if not healthy:
+                raise RuntimeError(
+                    "no healthy replicas: every gateway replica is draining")
+            if self.policy == "round_robin":
+                r = healthy[self._rr % len(healthy)]
+                self._rr += 1
+                return self._picked(r.index, "round_robin", requeue)
+            if self.policy == "least_loaded":
+                r = min(healthy, key=self._load_key)
+                return self._picked(r.index, "least_loaded", requeue)
+            key = self._sticky_key(prompt)
+            if key is None:
+                r = min(healthy, key=self._load_key)
+                return self._picked(r.index, "least_loaded", requeue)
+            home = self.replicas[key % len(self.replicas)]
+            others = [r for r in healthy if r is not home]
+            if not home.draining and (not self._saturated(home)
+                                      or not others):
+                if not requeue:
+                    self._sticky_total += 1
+                    self._sticky_hits += 1
+                    self._set_affinity_locked()
+                return self._picked(home.index, "sticky", requeue)
+            if not requeue:
+                self._sticky_total += 1
+                self._set_affinity_locked()
+            r = min(others, key=self._load_key)
+            return self._picked(r.index, "spill", requeue)
+
+    def _picked(self, idx: int, decision: str, requeue: bool
+                ) -> tuple[int, str]:
+        decision = "requeue" if requeue else decision
+        # ko: lint-ok[KO201] caller holds _lock: _picked only runs inside _route's lock scope
+        self._routed[(idx, decision)] = self._routed.get((idx, decision),
+                                                         0) + 1
+        return idx, decision
+
+    def _set_affinity_locked(self) -> None:
+        if self._sticky_total:
+            tm.GATEWAY_AFFINITY.set(self._sticky_hits / self._sticky_total)
+
+    def affinity_ratio(self) -> float | None:
+        """Fraction of sticky-eligible requests that landed on their
+        hashed home replica (None before any eligible request)."""
+        with self._lock:
+            if not self._sticky_total:
+                return None
+            return self._sticky_hits / self._sticky_total
+
+    # -- disaggregated prefill handoff --------------------------------------
+    def _maybe_handoff(self, idx: int, prompt: list[int]) -> None:
+        n = len(prompt) // self._page
+        if n < self._handoff_min_pages:
+            return
+        aligned = tuple(prompt[:n * self._page])
+        with self._lock:
+            if aligned in self._handed[idx]:
+                return
+            self._handed[idx].add(aligned)   # claim before the slow part
+        try:
+            payload = self._prefill.prefill(list(aligned))
+            pages = self.replicas[idx].batcher.handoff(
+                payload["tokens"], payload.get("layers"))
+        except Exception:
+            with self._lock:
+                self._handed[idx].discard(aligned)
+            raise
+        if pages:
+            tm.GATEWAY_HANDOFF_PAGES.inc(pages)
+            with self._lock:
+                self._handoff_pages += pages
+
+    # -- replica lifecycle --------------------------------------------------
+    def drain_replica(self, index: int, reason: str = "replica_drain",
+                      timeout: float | None = 60.0) -> list[str]:
+        """Take one replica out of rotation: mark it draining (routing
+        stops immediately), then drain every dp shard — its in-flight
+        requests and stranded queue flow through the requeue sink into
+        the gateway queue and re-route to healthy replicas. Returns the
+        requeued request ids."""
+        r = self.replicas[index]
+        with self._gcond:
+            r.draining = True
+        dp = max(1, int(getattr(r.batcher.engine, "dp", 1)))
+        ids = r.batcher.drain(range(dp), reason=reason, timeout=timeout)
+        with self._lock:
+            self._requeued_total += len(ids)
+        return ids
+
+    def readmit_replica(self, index: int) -> None:
+        """Hand a drained replica back to the router (and wake the
+        dispatcher in case requeued work was waiting for ANY healthy
+        replica)."""
+        r = self.replicas[index]
+        r.batcher.readmit()
+        with self._gcond:
+            r.draining = False
+            self._gcond.notify()
+
+    # -- gateway requeue path -----------------------------------------------
+    def _sink(self, reqs: list) -> None:
+        """A batcher's drain hand-off (called on ITS worker thread, its
+        lock held): park the victims in the gateway queue. The dispatcher
+        re-routes outside every batcher lock, so two replicas draining
+        into each other can never deadlock."""
+        with self._gcond:
+            self._gq.extend(reqs)
+            self._gcond.notify()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._gcond:
+                while not self._gq or all(r.draining for r in self.replicas):
+                    self._gcond.wait()
+                batch = sorted(self._gq, key=lambda r: r.submitted_at)
+                self._gq.clear()
+            groups: dict[int, list] = {}
+            for i, req in enumerate(batch):
+                try:
+                    idx, decision = self._route(req.prompt_ids, requeue=True)
+                except RuntimeError:
+                    # lost the race with a concurrent drain_replica — park
+                    # the rest and wait for a readmit to wake us
+                    with self._gcond:
+                        self._gq.extend(batch[i:])
+                    break
+                tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+                groups.setdefault(idx, []).append(req)
+            for idx, rs in groups.items():
+                # front=True: drained victims are the oldest requests in
+                # the cluster and re-enter ahead of fresh arrivals
+                self.replicas[idx].batcher.inject(rs, front=True)
+
+    # -- observability -------------------------------------------------------
+    def backlog(self) -> int:
+        """Cluster-wide queued + in-flight requests (gateway queue
+        included), same contract as ``ContinuousBatcher.backlog``."""
+        return (len(self._gq)
+                + sum(r.batcher.backlog() for r in self.replicas))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            routed: dict[str, dict[str, int]] = {}
+            for (idx, decision), n in sorted(self._routed.items()):
+                routed.setdefault(str(idx), {})[decision] = n
+            return {
+                "replicas": len(self.replicas),
+                "policy": self.policy,
+                "draining": [r.index for r in self.replicas if r.draining],
+                "routed": routed,
+                "affinity_ratio": (self._sticky_hits / self._sticky_total
+                                   if self._sticky_total else None),
+                "handoff_pages": self._handoff_pages,
+                "requeued_total": self._requeued_total,
+                "gateway_queue_depth": len(self._gq),
+            }

@@ -12,6 +12,7 @@ without a model: any request's reply is a pure function of its prompt.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -127,13 +128,28 @@ class FakePagedEngine(FakeSlotEngine):
     (round 8): a pool of ``pages`` blocks of ``page`` token positions
     split over dp shards (one reserved trash page each), a conservative
     ``ceil((plen + max_tokens) / page)`` reservation per admitted slot,
-    and a capacity-free prefix cache keyed on page-aligned prompt
-    prefixes — a hit skips the cached share of the prefill sleep, which
-    is the TTFT win the tier-1 guard measures. ``ContinuousBatcher``
-    detects the protocol via ``pages_for`` and admits against free pages
-    instead of free slots, exactly as with the real ``SlotPoolEngine``."""
+    and an LRU prefix cache keyed on page-aligned prompt prefixes — a
+    hit skips the cached share of the prefill sleep, which is the TTFT
+    win the tier-1 guard measures. ``ContinuousBatcher`` detects the
+    protocol via ``pages_for`` and admits against free pages instead of
+    free slots, exactly as with the real ``SlotPoolEngine``.
 
-    def __init__(self, *, page: int = 16, pages: int | None = None, **kw):
+    ``prefix_capacity`` bounds the per-shard cache to N entries (LRU
+    eviction, mirroring the real pool where prefix pages compete with
+    live slots for HBM); the default ``None`` keeps it unbounded, which
+    preserves every pre-cluster bench number. The cluster A/B leans on
+    the bound: at equal aggregate capacity, sticky-prefix routing keeps
+    each replica's share of the working set resident while round-robin
+    makes every replica thrash the full set.
+
+    ``import_prefix`` is the cost-model half of the disaggregated
+    handoff: a prefill worker's finished prefix enters the cache
+    directly, so the next admission of a matching prompt skips the
+    prefill sleep on the *decode* worker thread — which is exactly the
+    segment-time interference disaggregation removes."""
+
+    def __init__(self, *, page: int = 16, pages: int | None = None,
+                 prefix_capacity: int | None = None, **kw):
         super().__init__(**kw)
         if page <= 0 or page & (page - 1):
             raise ValueError(f"page ({page}) must be a power of two")
@@ -144,8 +160,9 @@ class FakePagedEngine(FakeSlotEngine):
         self._shard_slots = self.slots // self.dp
         self._free_pg = [self._span - 1] * self.dp    # minus the trash page
         self._held: dict[int, tuple[int, int]] = {}   # slot -> (shard, pages)
-        self._prefix: list[set[tuple[int, ...]]] = [
-            set() for _ in range(self.dp)]
+        self.prefix_capacity = prefix_capacity
+        self._prefix: list[OrderedDict[tuple[int, ...], None]] = [
+            OrderedDict() for _ in range(self.dp)]
         self.prefix_hits = 0
 
     @property
@@ -165,10 +182,44 @@ class FakePagedEngine(FakeSlotEngine):
         return (self._span - 1) - self._free_pg[shard]
 
     def _hit_pages(self, shard: int, prompt: list[int]) -> int:
+        cache = self._prefix[shard]
         for n in range(len(prompt) // self.page, 0, -1):
-            if tuple(prompt[:n * self.page]) in self._prefix[shard]:
+            key = tuple(prompt[:n * self.page])
+            if key in cache:
+                cache.move_to_end(key)      # LRU touch
                 return n
         return 0
+
+    def _remember(self, shard: int, prompt: list[int]) -> None:
+        """Publish every page-aligned prefix of ``prompt`` to the shard's
+        cache, evicting LRU entries past ``prefix_capacity``."""
+        cache = self._prefix[shard]
+        for n in range(1, len(prompt) // self.page + 1):
+            key = tuple(prompt[:n * self.page])
+            if key in cache:
+                cache.move_to_end(key)
+            else:
+                cache[key] = None
+        if self.prefix_capacity is not None:
+            while len(cache) > self.prefix_capacity:
+                cache.popitem(last=False)
+
+    def import_prefix(self, tokens, layers=None, shard: int = 0) -> int:
+        """Cost-model disaggregated handoff: a prefill worker's finished
+        page-aligned prefix enters the cache (no KV payload — the fake
+        holds no pages), so matching admissions skip the prefill sleep on
+        the decode worker thread. Returns whole pages handed off, 0 when
+        already cached — the same contract as ``SlotPoolEngine``."""
+        toks = [int(t) for t in tokens]
+        if not toks or len(toks) % self.page:
+            raise ValueError(
+                f"imported prefix must be a non-empty multiple of the "
+                f"page size ({self.page}), got {len(toks)} tokens")
+        n = len(toks) // self.page
+        if self._hit_pages(shard, toks) >= n:
+            return 0
+        self._remember(shard, toks)
+        return n
 
     def admit(self, entries):
         by_c: dict[int, list] = {}
@@ -190,8 +241,7 @@ class FakePagedEngine(FakeSlotEngine):
                 self._free_pg[shard] -= need
                 assert self._free_pg[shard] >= 0, "batcher over-admitted"
                 self._held[slot] = (shard, need)
-                for n in range(1, len(prompt) // self.page + 1):
-                    self._prefix[shard].add(tuple(prompt[:n * self.page]))
+                self._remember(shard, prompt)
                 total = len(prompt) + max_tokens
                 self.buf[slot] = 0
                 self.buf[slot, :total] = fake_row(prompt, total)

@@ -66,14 +66,31 @@ def _build_engine(espec: dict):
 class _Stage:
     """One judged serving stream: a batcher over its own cost-model
     engine, the trace driving it, per-beat history points, and the
-    accumulated breach edges."""
+    accumulated breach edges. With ``replicas > 1`` the stream runs
+    through a ``ServeGateway`` over that many batcher+engine replicas
+    (``router`` picks the policy) — same driver, same sampling, same
+    verdict, because the gateway speaks the batcher's submit/stats
+    protocol."""
 
     def __init__(self, name: str, espec: dict, slos: dict | None,
-                 trace=None, offsets=None):
+                 trace=None, offsets=None, replicas: int = 1,
+                 router: str = "sticky_prefix"):
         self.name = name
-        self.engine = _build_engine(espec)
-        self.stats = BatcherStats()
-        self.batcher = ContinuousBatcher(self.engine, stats=self.stats)
+        self.replicas = int(replicas)
+        self.gateway = None
+        if self.replicas > 1:
+            from kubeoperator_tpu.cluster import ServeGateway
+            engines = [_build_engine(espec) for _ in range(self.replicas)]
+            batchers = [ContinuousBatcher(e, stats=BatcherStats())
+                        for e in engines]
+            self.gateway = ServeGateway(batchers, policy=router)
+            self.engine = engines[0]        # paged-protocol sniffing only
+            self.stats = self.gateway.stats
+            self.batcher = self.gateway
+        else:
+            self.engine = _build_engine(espec)
+            self.stats = BatcherStats()
+            self.batcher = ContinuousBatcher(self.engine, stats=self.stats)
         self.slos = dict(slos or {})
         self.trace = trace
         self.offsets = offsets
@@ -226,7 +243,13 @@ def _apply_chaos(ev: dict, chaos: ChaosExecutor, spec: dict,
         shard = int(sl.get("shard", 0))
         requeued = 0
         for st in stages:
-            if shard < st.dp:
+            # clustered stage: the slice backs a whole replica — victims
+            # re-enter the GATEWAY queue and re-route to healthy replicas
+            if st.gateway is not None:
+                if shard < st.replicas:
+                    requeued += len(st.gateway.drain_replica(
+                        shard, reason="slice_revoked", timeout=60.0))
+            elif shard < st.dp:
                 requeued += len(st.batcher.drain(
                     [shard], reason="slice_revoked", timeout=60.0))
         entry["target"] = sl["id"]
@@ -237,7 +260,10 @@ def _apply_chaos(ev: dict, chaos: ChaosExecutor, spec: dict,
         entry["restored"] = chaos.restore_slice(sl["id"])
         shard = int(sl.get("shard", 0))
         for st in stages:
-            if shard < st.dp:
+            if st.gateway is not None:
+                if shard < st.replicas:
+                    st.gateway.readmit_replica(shard)
+            elif shard < st.dp:
                 st.batcher.readmit([shard])
     else:  # validate_spec rejects these before run_scenario gets here
         raise ValueError(f"unknown chaos kind {kind!r}")
@@ -293,7 +319,9 @@ def run_scenario(spec: dict) -> dict:
             continue
         trace, arrivals = build_trace(w.get("trace", {}), beats)
         offsets = [b * beat_wall_s for b in arrivals]
-        st = _Stage(wname, espec, w.get("serve_slos"), trace, offsets)
+        st = _Stage(wname, espec, w.get("serve_slos"), trace, offsets,
+                    replicas=int(w.get("replicas", 1)),
+                    router=w.get("router", "sticky_prefix"))
         stages.append(st)
         if kind == "pipeline":
             st2 = _Stage(f"{wname}:stage2", espec, w.get("stage2_slos"))

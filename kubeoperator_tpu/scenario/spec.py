@@ -24,11 +24,18 @@ A scenario is one dict (YAML on disk, plain dict in tests)::
     hosts: [10.0.0.1, 10.0.0.2, 10.0.0.3]   # probed through the chaos
                                             #   transport every beat
     slice: {id: tpu-a, ips: [10.0.0.2, 10.0.0.3], shard: 1}
+                                    # shard backs a dp shard (solo
+                                    #   serving) or a replica index
+                                    #   (replicas > 1): revocation drains
+                                    #   through the gateway
     workloads:
       - kind: serving               # one ContinuousBatcher + trace
         name: chat
+        replicas: 3                 # >1 fronts the batcher replicas with
+                                    #   a ServeGateway (cluster tier)
+        router: sticky_prefix       # gateway policy (cluster.POLICIES)
         trace: {shape: uniform|diurnal|burst, requests: N,
-                prefix_len: 64, peak: .5, trough: .1,
+                prefix_len: 64, prefix_groups: 6, peak: .5, trough: .1,
                 bursts: [4], share: .7}
         serve_slos: {ttft_p95_ms: 2000, queue_depth_max: 64, ...}
       - kind: pipeline              # two batchers, stage-1 feeds stage-2
@@ -129,6 +136,19 @@ def validate_spec(spec: Any) -> list[str]:
         if kind == "train":
             continue
         serving += 1
+        reps = w.get("replicas", 1)
+        if not isinstance(reps, int) or isinstance(reps, bool) or reps < 1:
+            errs.append(f"{where}.replicas: must be a positive integer, "
+                        f"got {reps!r}")
+            reps = 1
+        router = w.get("router", "sticky_prefix")
+        from kubeoperator_tpu.cluster.gateway import POLICIES
+        if router not in POLICIES:
+            errs.append(f"{where}.router: must be one of {POLICIES}, "
+                        f"got {router!r}")
+        if kind == "pipeline" and reps > 1:
+            errs.append(f"{where}.replicas: only serving workloads route "
+                        f"through the gateway")
         tspec = w.get("trace", {})
         if not isinstance(tspec, dict):
             errs.append(f"{where}.trace: must be a mapping")
@@ -232,6 +252,29 @@ SCENARIOS: dict[str, dict] = {
                        "share": 0.7, "prefix_len": 32},
              "serve_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 48}},
             {"kind": "train", "name": "colo-train", "step_s": 0.004},
+        ],
+        "chaos": [
+            {"beat": 3, "kind": "revoke_slice"},
+            {"beat": 7, "kind": "restore_slice"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "cluster_prefix_burst": {
+        "name": "cluster_prefix_burst",
+        "description": "shared-prefix burst over three gateway replicas "
+                       "with sticky-prefix routing; the cloud revokes the "
+                       "slice backing replica 1 mid-replay — victims "
+                       "re-enter the gateway queue and finish elsewhere",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "slice": dict(_SLICE),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "replicas": 3, "router": "sticky_prefix",
+             "trace": {"shape": "burst", "requests": 36, "bursts": [2, 3],
+                       "share": 0.6, "prefix_len": 32, "prefix_groups": 6},
+             "serve_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 64}},
         ],
         "chaos": [
             {"beat": 3, "kind": "revoke_slice"},

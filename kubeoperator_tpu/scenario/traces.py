@@ -43,19 +43,28 @@ def make_trace(n: int,
 
 
 def make_prefix_trace(n: int, prefix_len: int = 64,
-                      mix: tuple[tuple[int, int], ...] = PREFIX_TAIL
-                      ) -> list[tuple[list[int], int]]:
+                      mix: tuple[tuple[int, int], ...] = PREFIX_TAIL,
+                      groups: int = 1) -> list[tuple[list[int], int]]:
     """Shared-prefix long-tail trace: every request opens with the same
     ``prefix_len``-token system prompt (page-aligned when prefix_len is a
     multiple of the page size), then a short unique tail. The first
     request through each shard publishes the prefix pages; everyone after
-    hits the cache and skips that share of prefill."""
-    system = [(7 * j) % VOCAB + 1 for j in range(prefix_len)]
+    hits the cache and skips that share of prefill.
+
+    ``groups`` > 1 interleaves that many *distinct* system prompts
+    (request ``i`` belongs to group ``i % groups``) — the multi-tenant
+    working set the cluster gateway's sticky-prefix router partitions
+    across replicas. groups=1 is exactly the round-8 single-tenant
+    trace."""
+    if groups < 1:
+        raise ValueError(f"groups ({groups}) must be >= 1")
+    systems = [[(7 * j + 131 * g) % VOCAB + 1 for j in range(prefix_len)]
+               for g in range(groups)]
     out = []
     for i in range(n):
         tail_len, mt = mix[i % len(mix)]
         tail = [(i + 11 * j) % VOCAB + 1 for j in range(tail_len)]
-        out.append((system + tail, mt))
+        out.append((systems[i % groups] + tail, mt))
     return out
 
 
@@ -133,7 +142,8 @@ def build_trace(tspec: dict, beats: int
     n = int(tspec.get("requests", 16))
     prefix_len = int(tspec.get("prefix_len", 0))
     if prefix_len:
-        trace = make_prefix_trace(n, prefix_len)
+        trace = make_prefix_trace(n, prefix_len,
+                                  groups=int(tspec.get("prefix_groups", 1)))
     else:
         trace = make_trace(n)
     if shape == "uniform":

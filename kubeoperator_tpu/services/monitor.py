@@ -55,6 +55,12 @@ QUERIED_METRICS = {
     "ko_serve_prefix_hits_total": "jax-serve",
     # autoscaler (round 11): in-flight requests requeued by drain/preemption
     "ko_serve_requests_requeued_total": "jax-serve",
+    # cluster gateway (round 13): routing volume per replica/decision,
+    # sticky-prefix affinity quality, and disaggregated page handoffs —
+    # served off the gateway process's /metrics like the batcher families
+    "ko_gateway_requests_routed_total": "jax-serve",
+    "ko_gateway_prefix_affinity_ratio": "jax-serve",
+    "ko_gateway_handoff_pages_total": "jax-serve",
     # multi-chip training (round 10): step time, MFU, and the collective
     # attribution the train jobs publish on --metrics-port
     "ko_train_step_seconds_bucket": "jax-train",
@@ -93,6 +99,16 @@ PROMQL = {
     # nonzero rate means topology churn is recycling in-flight decodes
     "serve_requeued_rate":
         "sum(rate(ko_serve_requests_requeued_total[5m]))",
+    # cluster gateway (round 13): routing throughput split by decision
+    # (sticky vs spill vs requeue is the router's health at a glance),
+    # prefix-affinity quality (eroding ratio = spill-over or drains are
+    # defeating the cluster-wide cache), and prefill→decode page handoffs
+    "gateway_routed_rate":
+        "sum(rate(ko_gateway_requests_routed_total[5m]))",
+    "gateway_routed_by_policy":
+        "sum(rate(ko_gateway_requests_routed_total[5m])) by (policy)",
+    "gateway_affinity_ratio": "avg(ko_gateway_prefix_affinity_ratio)",
+    "gateway_handoff_rate": "sum(rate(ko_gateway_handoff_pages_total[5m]))",
     # training plane (round 10): the fsdp/pipeline jobs' step-time p95,
     # fleet MFU, and where the collective seconds go by family — the same
     # split bench_multichip attributes per config
@@ -454,6 +470,17 @@ class ClusterMonitor:
         serve_pages = prom.scalar_or_none(PROMQL["serve_kv_pages_used"])
         serve_hit_rate = prom.scalar_or_none(PROMQL["serve_prefix_hit_rate"])
         serve_requeued = prom.scalar_or_none(PROMQL["serve_requeued_rate"])
+        # cluster gateway: None marks "no gateway tier deployed"
+        gateway_rate = prom.scalar_or_none(PROMQL["gateway_routed_rate"])
+        gateway_affinity = prom.scalar_or_none(
+            PROMQL["gateway_affinity_ratio"])
+        gateway_handoff = prom.scalar_or_none(PROMQL["gateway_handoff_rate"])
+        try:
+            gateway_by_policy = {
+                r.get("metric", {}).get("policy", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["gateway_routed_by_policy"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            gateway_by_policy = {}
         # training plane: None marks "no train job publishing metrics"
         train_step_p95 = prom.scalar_or_none(PROMQL["train_step_p95"])
         train_mfu = prom.scalar_or_none(PROMQL["train_mfu"])
@@ -486,6 +513,10 @@ class ClusterMonitor:
             "serve_kv_pages_used": serve_pages,
             "serve_prefix_hit_rate": serve_hit_rate,
             "serve_requeued_rate": serve_requeued,
+            "gateway_routed_rate": gateway_rate,
+            "gateway_routed_by_policy": gateway_by_policy,
+            "gateway_affinity_ratio": gateway_affinity,
+            "gateway_handoff_rate": gateway_handoff,
             "train_step_p95": train_step_p95,
             "train_mfu": train_mfu,
             "train_collective_rate": train_coll_rate,
@@ -525,6 +556,10 @@ class ClusterMonitor:
                        "serve_kv_pages_used": data["serve_kv_pages_used"],
                        "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
                        "serve_requeued_rate": data["serve_requeued_rate"],
+                       "gateway_routed_rate": data["gateway_routed_rate"],
+                       "gateway_affinity_ratio":
+                           data["gateway_affinity_ratio"],
+                       "gateway_handoff_rate": data["gateway_handoff_rate"],
                        "train_step_p95": data["train_step_p95"],
                        "train_mfu": data["train_mfu"],
                        "pod_count": data["pod_count"]})

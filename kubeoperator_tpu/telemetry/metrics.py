@@ -571,6 +571,24 @@ AUTOSCALE_SKIPS = REGISTRY.counter(
     "by cluster and reason (cooldown | bounds | busy | guard).",
     labels=("cluster", "reason"))
 
+# -- cluster gateway families (cluster/gateway.py) --------------------------
+# Set by the ServeGateway fronting N batcher replicas: routing decisions,
+# prefix-affinity quality and disaggregated page handoffs, on the
+# process-global REGISTRY so one scrape covers the whole cluster tier.
+GATEWAY_ROUTED = REGISTRY.counter(
+    "ko_gateway_requests_routed_total",
+    "Requests the cluster gateway routed, by target replica and routing "
+    "decision (sticky | spill | requeue | round_robin | least_loaded).",
+    labels=("replica", "policy"))
+GATEWAY_AFFINITY = REGISTRY.gauge(
+    "ko_gateway_prefix_affinity_ratio",
+    "Fraction of sticky-eligible requests that landed on their hashed "
+    "prefix's home replica (spill-over and drains erode it).")
+GATEWAY_HANDOFF_PAGES = REGISTRY.counter(
+    "ko_gateway_handoff_pages_total",
+    "Whole KV pages shipped from disaggregated prefill workers into "
+    "decode replicas' prefix caches as block-table page lists.")
+
 
 declare_serve_metrics(REGISTRY)
 declare_train_metrics(REGISTRY)

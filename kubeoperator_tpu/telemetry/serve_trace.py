@@ -131,11 +131,18 @@ class RequestTrace:
 
     # -- batcher edges -------------------------------------------------------
     def admitted(self, *, slot: int, shard: int, wave_s: float,
-                 plan: dict | None) -> None:
+                 plan: dict | None,
+                 replica: int | str | None = None) -> None:
         if self.queue_span is not None:
             self.queue_span.finish()
             self.queue_span = None
         attrs: dict[str, Any] = {"slot": slot, "shard": shard}
+        if replica is not None:
+            # which gateway replica admitted this request — a re-routed
+            # request grows a second admit span stamped with its new home,
+            # so the TTFT decomposition can split gateway-level queueing
+            # (between stamps) from replica-level queueing
+            attrs["replica"] = replica
         prefilled = True
         if plan:
             attrs.update(

@@ -388,10 +388,13 @@ class SlotPoolEngine:
         that page."""
         return pool.at[pages, offsets].set(vals)
 
-    def _page_copy(self, pool, dst, src):
-        """Copy-on-write: duplicate whole pages (gather + scatter) when a
-        prefix-sharing slot is about to diverge from its cached pages."""
-        return pool.at[dst].set(pool[src])
+    def _page_copy(self, pool, dst, src, src_pool=None):
+        """Whole-page duplication (gather + scatter): copy-on-write when a
+        prefix-sharing slot is about to diverge from its cached pages, and
+        — with ``src_pool`` — the disaggregated import path, landing a
+        prefill worker's exported pages (``src`` indexes ``src_pool``)
+        into this pool's freshly allocated ``dst`` pages."""
+        return pool.at[dst].set((pool if src_pool is None else src_pool)[src])
 
     # -- device math --------------------------------------------------------
     def _micro_step(self, buf, pos, last, plen, temp, seeds, pools, bt):
@@ -834,6 +837,78 @@ class SlotPoolEngine:
                 for pg in pgs:
                     sh.ref[pg] += 1
                     sh.cache_ref[pg] = sh.cache_ref.get(pg, 0) + 1
+
+    # -- disaggregated prefill→decode handoff (round 13) --------------------
+    def export_prefix(self, slot: int, n_pages: int) -> list[tuple[Any, Any]]:
+        """Whole-page gather of ``slot``'s first ``n_pages`` KV pages —
+        the prefill worker's half of a disaggregated handoff. The caller
+        (``cluster.disagg.PrefillWorker``) guarantees the pages are final:
+        the slot's position has passed ``n_pages * page``, so the write
+        frontier is strictly above every exported position. Returns one
+        ``(k_pages, v_pages)`` pair per layer, each ``[n, page, H, D]`` —
+        page lists, never a dense ``[T]`` row copy."""
+        pages = self._slot_pages.get(int(slot), [])
+        if n_pages > len(pages):
+            raise ValueError(
+                f"slot {slot} holds {len(pages)} pages, cannot export "
+                f"{n_pages}")
+        idx = jnp.asarray(pages[:n_pages], jnp.int32)
+        return [(kp[idx], vp[idx]) for kp, vp in self._pools]
+
+    def import_prefix(self, tokens: Sequence[int], layers: Any,
+                      shard: int = 0) -> int:
+        """Decode-side half of the handoff: land exported KV pages in this
+        pool and publish them to ``shard``'s prefix cache, so the next
+        ``admit`` of a prompt opening with ``tokens`` gets a full/cover
+        hit and skips that share of prefill — long prompts stop stealing
+        segment time from in-flight decodes. ``tokens`` must be
+        page-aligned; pages arrive via ``_page_copy`` (block-table page
+        lists, the KO121-legal pool write), never as dense rows. The
+        entries start cache-only (ref == cache_ref), i.e. evictable under
+        pool pressure like any other prefix entry. Returns pages newly
+        imported (0 when the cache already covers the prefix).
+
+        Single-writer protocol: call from the thread that drives admit/
+        release — ``ContinuousBatcher.handoff`` routes here through the
+        worker's control handshake."""
+        toks = [int(t) for t in tokens]
+        if not toks or len(toks) % self.page:
+            raise ValueError(
+                f"imported prefix must be a non-empty multiple of the "
+                f"page size ({self.page}), got {len(toks)} tokens")
+        n = len(toks) // self.page
+        if len(layers) != self.cfg.n_layers:
+            raise ValueError(
+                f"handoff payload has {len(layers)} layers, engine has "
+                f"{self.cfg.n_layers}")
+        sh = self._shards[shard]
+        n_hit, _ = self._lookup_prefix(shard, toks)
+        if n_hit >= n:
+            return 0
+        self._ensure_free(sh, n)
+        pages = [sh.free.pop() for _ in range(n)]
+        dst = jnp.asarray(pages, jnp.int32)
+        src = jnp.arange(n, dtype=jnp.int32)
+        self._pools = [
+            (self._pin(self._page_copy(kp, dst, src, src_pool=lk),
+                       self._pool_sh),
+             self._pin(self._page_copy(vp, dst, src, src_pool=lv),
+                       self._pool_sh))
+            for (kp, vp), (lk, lv) in zip(self._pools, layers)]
+        for m in range(1, n + 1):
+            ptoks = tuple(toks[:m * self.page])
+            key = hash(ptoks)
+            ent = sh.prefix.get(key)
+            if ent is not None:
+                if ent[0] == ptoks:
+                    sh.prefix.move_to_end(key)
+                continue        # hash collision: keep the resident entry
+            pgs = tuple(pages[:m])
+            sh.prefix[key] = (ptoks, pgs)
+            for pg in pgs:
+                sh.ref[pg] = sh.ref.get(pg, 0) + 1
+                sh.cache_ref[pg] = sh.cache_ref.get(pg, 0) + 1
+        return n
 
     def run_segment(self) -> None:
         """One device dispatch: every active slot advances ``segment``

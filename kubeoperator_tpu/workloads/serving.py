@@ -175,6 +175,15 @@ class BatcherStats:
         slice_revoked | scale_down)."""
         self._m["requeued"].inc(n, reason=reason)
 
+    def dequeued(self, n: int = 1) -> None:
+        """Requests that left this batcher's queue without finishing here
+        — handed to a gateway requeue sink for re-routing to another
+        replica. Keeps the queue-depth gauge honest across migrations
+        (the receiving batcher re-counts them via ``enqueued``)."""
+        with self._lock:   # clamp at 0 needs read-modify-write
+            depth = self._m["queue_depth"].value()
+            self._m["queue_depth"].set(max(0.0, depth - n))
+
     def ttft_mean(self) -> float:
         """Mean observed time-to-first-token in seconds (0.0 before any
         observation). The paged-vs-dense bench compares means; p95 lives
@@ -182,6 +191,18 @@ class BatcherStats:
         h = self._m["ttft"]
         n = h.count()
         return h.sum() / n if n else 0.0
+
+    def ttft_histogram(self) -> tuple[tuple[float, ...], list[int], int,
+                                      float]:
+        """(bucket bounds, cumulative-free counts, count, sum) of the TTFT
+        histogram — the raw material a cluster gateway needs to merge
+        quantiles ACROSS replicas (a p95 of p95s is not a p95; merged
+        bucket counts give the real one)."""
+        h = self._m["ttft"]
+        slot = h.samples().get(())
+        if not slot:
+            return h.buckets, [0] * len(h.buckets), 0, 0.0
+        return h.buckets, list(slot["counts"]), int(slot["count"]), h.sum()
 
     def ttft_quantile(self, q: float = 0.95) -> float | None:
         """Upper-bound quantile over the TTFT histogram buckets — the
@@ -408,13 +429,31 @@ class ContinuousBatcher:
     so its tokens stay bit-identical to an undisturbed run. Both calls go
     through a control handshake serviced by the worker thread between
     steps, preserving the single-writer discipline on ``_track``.
+
+    Cluster tier (round 13): a ``cluster.ServeGateway`` fronting N
+    batchers wires each one with a ``requeue_sink`` — then drained
+    requests (and, once every shard is fenced, the stranded queue) leave
+    through the sink oldest-first to be re-routed to a healthy replica
+    instead of waiting on this batcher's head. ``inject`` is the other
+    end of that hand-off (pre-built requests enter the queue without
+    re-validation — their ``done`` events still reach the original
+    callers), ``backlog`` is the router's load signal, ``handoff``
+    imports prefilled KV pages from a disaggregated prefill worker via
+    the same control handshake admission uses (single-writer on the
+    engine), and ``replica`` stamps this batcher's identity onto every
+    admit span so TTFT decompositions can split gateway queueing from
+    replica queueing.
     """
 
     def __init__(self, engine: Any, *, stats: BatcherStats | None = None,
-                 tracer: Any = None):
+                 tracer: Any = None,
+                 requeue_sink: Callable[[list[_Pending]], None] | None = None,
+                 replica: int | str | None = None):
         self.engine = engine
         self.stats = stats if stats is not None else BatcherStats()
         self._tracer = tracer
+        self.requeue_sink = requeue_sink
+        self.replica = replica
         # dispatch→ready attribution: when the retirement fetch returns,
         # the segment dispatched at _dispatch_t0 is known device-complete
         self._dispatch_t0: float | None = None
@@ -549,19 +588,35 @@ class ContinuousBatcher:
                 self._fail_all(admit_now, e)
 
     def _apply_ctl_locked(self) -> None:
-        """Service pending drain handshakes (worker thread, lock held):
-        pop every in-flight request off the drained shards, requeue them
-        at the queue head in submission order, release their page
-        reservations, and fence the shards' slots out of the free list."""
+        """Service pending control handshakes (worker thread, lock held).
+
+        ``drain``: pop every in-flight request off the drained shards,
+        release their page reservations, and fence the shards' slots out
+        of the free list. Without a ``requeue_sink`` the victims go back
+        to this queue's head in submission order; with one (the cluster
+        gateway) they leave oldest-first through the sink to be re-routed
+        — and once EVERY shard is fenced the stranded queue goes with
+        them, because nothing left here could ever admit it.
+
+        ``handoff``: import a prefill worker's finished KV pages into the
+        engine's prefix cache (block-table page lists, no dense-row copy)
+        on the worker thread, preserving the engine's single-writer
+        protocol."""
         while self._ctl:
-            shard_set, reason, ev, out = self._ctl.popleft()
+            op, args, ev, out = self._ctl.popleft()
+            if op == "handoff":
+                tokens, layers, shard = args
+                try:
+                    out["pages"] = int(self.engine.import_prefix(
+                        tokens, layers, shard=shard))
+                except Exception as e:  # noqa: BLE001 — judged by caller
+                    out["error"] = e
+                ev.set()
+                continue
+            shard_set, reason = args
             victims = sorted(s for s in self._track
                              if s // self._shard_slots in shard_set)
             reqs = [self._track.pop(s)["req"] for s in victims]
-            # appendleft newest-first so the queue head ends up oldest-first
-            for r in sorted(reqs, key=lambda r: r.submitted_at,
-                            reverse=True):
-                self._queue.appendleft(r)
             for r in reqs:
                 self.stats.requeued(reason)
             if self._paged and victims:
@@ -574,6 +629,18 @@ class ContinuousBatcher:
                           if s // self._shard_slots not in shard_set]
             # ko: lint-ok[KO201] caller holds _cond: _apply_ctl_locked runs inside the worker's lock scope
             self._drained |= shard_set
+            sink = self.requeue_sink
+            if sink is not None and len(self._drained) == self._dp:
+                reqs += list(self._queue)
+                self._queue.clear()
+            reqs.sort(key=lambda r: r.submitted_at)   # submission order
+            if sink is not None and reqs:
+                self.stats.dequeued(len(reqs))
+                sink(reqs)
+            else:
+                # appendleft newest-first so the head ends up oldest-first
+                for r in reversed(reqs):
+                    self._queue.appendleft(r)
             out["requeued"] = [r.id for r in reqs]
             self._report_occupancy()
             ev.set()
@@ -594,11 +661,58 @@ class ContinuousBatcher:
         ev = threading.Event()
         out: dict = {}
         with self._cond:
-            self._ctl.append((shard_set, reason, ev, out))
+            self._ctl.append(("drain", (shard_set, reason), ev, out))
             self._cond.notify()
         if not ev.wait(timeout):
             raise TimeoutError("drain timed out waiting for the worker")
         return out["requeued"]
+
+    def backlog(self) -> int:
+        """Queued + in-flight request count — the admission-pressure
+        signal the cluster gateway's router balances on. Lock-free reads
+        of two container lengths: a heuristic, not a barrier."""
+        return len(self._queue) + len(self._track)
+
+    def inject(self, reqs: list[_Pending], front: bool = True) -> None:
+        """Enqueue pre-built requests (the gateway requeue path). The
+        requests were validated by their original ``submit`` and their
+        ``done`` events still reach the original callers — moving the
+        object between batchers is invisible to the blocked client.
+        ``front`` keeps drained victims ahead of this replica's own
+        arrivals (they are the oldest requests in the cluster)."""
+        if not reqs:
+            return
+        for _ in reqs:
+            self.stats.enqueued()
+        with self._cond:
+            if front:
+                # appendleft newest-first so the head ends up oldest-first
+                for r in sorted(reqs, key=lambda r: r.submitted_at,
+                                reverse=True):
+                    self._queue.appendleft(r)
+            else:
+                self._queue.extend(sorted(reqs,
+                                          key=lambda r: r.submitted_at))
+            self._cond.notify()
+
+    def handoff(self, tokens: Sequence[int], layers: Any = None,
+                shard: int = 0, timeout: float | None = 60.0) -> int:
+        """Import a disaggregated prefill worker's finished pages into
+        this replica's engine (``engine.import_prefix``) via the control
+        handshake, so the import runs on the worker thread between steps
+        — the engine's allocator stays single-writer. Returns the number
+        of whole pages imported (0 when the prefix was already cached)."""
+        ev = threading.Event()
+        out: dict = {}
+        with self._cond:
+            self._ctl.append(("handoff", (list(tokens), layers, int(shard)),
+                              ev, out))
+            self._cond.notify()
+        if not ev.wait(timeout):
+            raise TimeoutError("handoff timed out waiting for the worker")
+        if "error" in out:
+            raise out["error"]
+        return out["pages"]
 
     def readmit(self, shards=None) -> list[int]:
         """Hand drained shards' slots back to the admission pool (all
@@ -650,7 +764,8 @@ class ContinuousBatcher:
                 if r.trace is not None:
                     r.trace.admitted(slot=slot,
                                      shard=slot // self._shard_slots,
-                                     wave_s=admit_s, plan=plans.get(slot))
+                                     wave_s=admit_s, plan=plans.get(slot),
+                                     replica=self.replica)
                 if t["pos"] >= plen:
                     # pow2-length prompt: its first token was born in the
                     # admission prefill itself

@@ -155,6 +155,65 @@ def bench_paged(requests: int, dense_slots: int, segment: int, page: int,
     }
 
 
+def bench_cluster(requests: int = 60, replicas: int = 4, slots: int = 8,
+                  segment: int = 8, page: int = 16, groups: int = 15,
+                  prefix_len: int = 64, prefix_capacity: int = 24,
+                  step_s: float = 0.0002, dispatch_s: float = 0.0005,
+                  prefill_s: float = 0.01, stagger_s: float = 0.002,
+                  max_total: int = 256) -> dict:
+    """Round 13: sticky-prefix vs round-robin routing through the
+    ``ServeGateway``, SAME replicas, SAME aggregate KV HBM, SAME
+    multi-tenant shared-prefix long-tail trace (``groups`` distinct
+    system prompts cycled). Each replica's prefix cache is LRU-bounded
+    to ``prefix_capacity`` entries — big enough for one replica's share
+    of the tenant working set, far too small for all of it. Sticky
+    routing therefore keeps every tenant's prefix pages resident on its
+    home replica (admissions are cache hits); round-robin sprays every
+    tenant across every replica, so each cache thrashes the full set and
+    most admissions pay the whole prefill on the decode worker thread.
+    The tier-1 guard pins sticky ≥ 1.3× round-robin on mean TTFT."""
+    from kubeoperator_tpu.cluster import ServeGateway
+
+    trace = make_prefix_trace(requests, prefix_len, groups=groups)
+
+    def arm(policy: str) -> dict:
+        engines = [FakePagedEngine(
+            slots=slots, segment=segment, max_total=max_total, page=page,
+            prefix_capacity=prefix_capacity, step_s=step_s,
+            dispatch_s=dispatch_s, prefill_s=prefill_s)
+            for _ in range(replicas)]
+        batchers = [ContinuousBatcher(e, stats=BatcherStats())
+                    for e in engines]
+        gw = ServeGateway(batchers, policy=policy)
+        r = run_load(gw, trace, stagger_s)
+        snap = gw.snapshot()
+        return {
+            "policy": policy,
+            "pages_per_replica": engines[0].pages,
+            "wall_s": round(r["wall_s"], 3),
+            "tok_s": round(r["tok_s"], 1),
+            "mean_ttft_s": round(gw.stats.ttft_mean(), 4),
+            "prefix_hits": sum(e.prefix_hits for e in engines),
+            "affinity_ratio": snap["affinity_ratio"],
+            "routed": snap["routed"],
+        }
+
+    sticky = arm("sticky_prefix")
+    rr = arm("round_robin")
+    return {
+        "requests": requests,
+        "replicas": replicas,
+        "groups": groups,
+        "prefix_len": prefix_len,
+        "page": page,
+        "prefix_capacity": prefix_capacity,
+        "sticky": sticky,
+        "round_robin": rr,
+        "ttft_gain": round(
+            rr["mean_ttft_s"] / max(sticky["mean_ttft_s"], 1e-9), 2),
+    }
+
+
 def bench_tracing_overhead(requests: int, slots: int, segment: int,
                            step_s: float, dispatch_s: float,
                            prefill_s: float, stagger_s: float,
@@ -310,12 +369,52 @@ def main() -> None:
                     help="scaling mode: also run the real sharded engine "
                          "on available JAX devices (gated: shapes that "
                          "don't fit are marked skipped)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="gateway A/B: sticky-prefix vs round-robin over "
+                         "N batcher replicas at equal aggregate KV HBM on "
+                         "a multi-tenant shared-prefix trace (cost model)")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="cluster mode: gateway replicas")
+    ap.add_argument("--groups", type=int, default=15,
+                    help="cluster mode: distinct shared-prefix tenants")
+    ap.add_argument("--prefix-capacity", type=int, default=24,
+                    help="cluster mode: per-replica prefix-cache entries "
+                         "(LRU) — one replica's tenant share, not all")
     ap.add_argument("--tracing-overhead", action="store_true",
                     help="A/B the continuous engine with the serve tracer "
                          "off vs on (round 9: must stay under 5%% tok/s)")
     ap.add_argument("--out", type=str, default=None,
                     help="also write a MULTICHIP-style JSON artifact here")
     args = ap.parse_args()
+    if args.cluster:
+        result = bench_cluster(
+            requests=args.requests, replicas=args.replicas,
+            groups=args.groups, prefix_len=args.prefix_len, page=args.page,
+            prefix_capacity=args.prefix_capacity)
+        print(json.dumps(result))
+        if args.out:
+            artifact = {
+                "rc": 0,
+                "ok": result["ttft_gain"] >= 1.3,
+                "skipped": False,
+                "replicas": result["replicas"],
+                "groups": result["groups"],
+                "prefix_capacity": result["prefix_capacity"],
+                "ttft_gain": result["ttft_gain"],
+                "sticky": result["sticky"],
+                "round_robin": result["round_robin"],
+                "tail": (
+                    f"sticky ttft={result['sticky']['mean_ttft_s']}s "
+                    f"hits={result['sticky']['prefix_hits']} "
+                    f"affinity={result['sticky']['affinity_ratio']} | "
+                    f"rr ttft={result['round_robin']['mean_ttft_s']}s "
+                    f"hits={result['round_robin']['prefix_hits']} | "
+                    f"gain={result['ttft_gain']}x"),
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+        return
     if args.tracing_overhead:
         print(json.dumps(bench_tracing_overhead(
             args.requests, args.slots, args.segment, args.step,

@@ -1,0 +1,441 @@
+"""Cluster-tier serving (round 13): the ServeGateway's signature property
+— greedy tokens through the gateway bit-identical to solo generate()
+under every routing policy and mid-trace replica loss — plus the router's
+two signals (prefix-affinity accounting, saturation spill-over order),
+the gateway-level requeue on replica drain, the disaggregated
+prefill→decode page handoff (cost model AND real block-table pages), and
+the sticky-vs-round-robin mean-TTFT guard on the cost-model A/B."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.cluster import (
+    POLICIES, PrefillWorker, ServeGateway, aligned_prefix,
+)
+from kubeoperator_tpu.scenario.driver import run_load
+from kubeoperator_tpu.scenario.engines import FakePagedEngine, fake_row
+from kubeoperator_tpu.scenario.traces import make_prefix_trace
+from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+from kubeoperator_tpu.workloads.generate import generate
+from kubeoperator_tpu.workloads.serving import BatcherStats, ContinuousBatcher
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig,
+)
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq_len=24, dtype=jnp.float32,
+                        remat=False, attention="dense")
+
+# 16 tokens = exactly 2 pages at the page size the tiny CFG resolves to
+# (max_seq_len 24 -> page 8) — the same system prompt test_continuous uses
+PRE = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Transformer(CFG)
+    return nn.unbox(model.init(jax.random.key(7),
+                               jnp.zeros((2, 8), jnp.int32))["params"])
+
+
+def solo(params, prompt, max_tokens, temperature=0.0, **kw):
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), max_tokens,
+                   temperature=temperature, **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def _bench_mod():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_cluster(n, *, slots=4, prefix_capacity=None, prefill_s=0.0,
+                  dispatch_s=0.0, step_s=0.0):
+    engines = [FakePagedEngine(slots=slots, segment=2, max_total=24, page=8,
+                               prefix_capacity=prefix_capacity,
+                               step_s=step_s, dispatch_s=dispatch_s,
+                               prefill_s=prefill_s)
+               for _ in range(n)]
+    batchers = [ContinuousBatcher(e, stats=BatcherStats()) for e in engines]
+    return engines, batchers
+
+
+def _first_page_for_home(n_replicas, home, page=8):
+    """A deterministic first page whose sticky hash lands on ``home`` —
+    int-tuple hashes don't depend on PYTHONHASHSEED, so this is stable."""
+    i = 0
+    while True:
+        cand = [(i + j) % 50 + 1 for j in range(page)]
+        if hash(tuple(cand)) % n_replicas == home:
+            return cand
+        i += 1
+
+
+def _spin(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting for {msg}"
+        time.sleep(0.001)
+
+
+# ---------------------------------------------------------------------------
+# signature property: gateway == solo, every policy
+# ---------------------------------------------------------------------------
+
+def test_gateway_bit_exact_every_policy_cost_model():
+    """The same multi-tenant trace through sticky, round-robin, and
+    least-loaded routing: every reply equals the deterministic
+    pseudo-decode (the cost model's solo-generate oracle), and all three
+    policies agree token-for-token — routing is placement, never math."""
+    trace = make_prefix_trace(18, prefix_len=8,
+                              mix=((4, 6), (2, 8), (6, 4)), groups=3)
+    replies = {}
+    for policy in POLICIES:
+        engines, batchers = _fake_cluster(3)
+        gw = ServeGateway(batchers, policy=policy)
+        results = {}
+
+        def keep(i, prompt, mt, result, results=results):
+            results[i] = (prompt, mt, result)
+
+        run_load(gw, trace, on_result=keep)
+        assert len(results) == len(trace)
+        for i, (prompt, mt, result) in results.items():
+            want = [int(x) for x in fake_row(prompt, len(prompt) + mt)]
+            assert result == want, f"{policy} request {i} diverged"
+        replies[policy] = [results[i][2] for i in range(len(trace))]
+        snap = gw.snapshot()
+        assert sum(sum(d.values()) for d in snap["routed"].values()) \
+            == len(trace)
+        assert gw.stats.snapshot()["requests_total"] == len(trace)
+    assert replies["sticky_prefix"] == replies["round_robin"] \
+        == replies["least_loaded"]
+
+
+def test_gateway_bit_exact_real_engines(params):
+    """Two real SlotPoolEngine replicas behind the gateway: greedy
+    tokens are bit-identical to solo generate() — the acceptance pin on
+    real KV, not just the cost model."""
+    batchers = [ContinuousBatcher(SlotPoolEngine(CFG, params, slots=2,
+                                                 segment=3),
+                                  stats=BatcherStats())
+                for _ in range(2)]
+    gw = ServeGateway(batchers, policy="sticky_prefix")
+    reqs = [(PRE + [11, 12], 6), ([1, 2, 3, 4, 5], 6),
+            (PRE + [13], 7), ([7, 8, 9, 10, 11, 12, 13, 14], 5)]
+    got = {}
+    threads = [threading.Thread(
+        target=lambda i=i, p=p, mt=mt: got.__setitem__(
+            i, gw.submit(p, mt, timeout=120.0)), daemon=True)
+        for i, (p, mt) in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    for i, (p, mt) in enumerate(reqs):
+        assert got[i] == solo(params, p, mt), f"request {i} diverged"
+
+
+# ---------------------------------------------------------------------------
+# replica loss: gateway-level requeue, submission order, bit-exactness
+# ---------------------------------------------------------------------------
+
+def test_replica_loss_mid_decode_requeues_through_gateway():
+    """Drain a replica with requests mid-decode: the victims re-enter
+    the GATEWAY queue (oldest first), the dispatcher re-routes them to
+    the healthy replica with the ``requeue`` policy label, their blocked
+    clients get bit-exact tokens, and the aggregate requeue counter and
+    snapshot agree."""
+    engines, batchers = _fake_cluster(2)
+    # gate replica-0 segments so "mid-decode" is a sequenced fact
+    gate = threading.Semaphore(0)
+    hold = {"on": True}
+    eng0 = engines[0]
+    orig_seg = eng0.run_segment
+
+    def gated_segment():
+        if hold["on"]:
+            assert gate.acquire(timeout=30), "segment gate starved"
+        orig_seg()
+
+    eng0.run_segment = gated_segment
+    gw = ServeGateway(batchers, policy="sticky_prefix")
+    # observe the order victims reach the healthy replica
+    landed = []
+    orig_inject = batchers[1].inject
+
+    def spy_inject(reqs, front=True):
+        landed.extend(r.prompt_ids[-1] for r in reqs)
+        orig_inject(reqs, front=front)
+
+    batchers[1].inject = spy_inject
+    home0 = _first_page_for_home(2, 0)
+    # mt=15: each row needs ~8 gated segments, so the drain below lands
+    # with every victim still mid-decode
+    reqs = [(home0 + [20 + i], 15) for i in range(3)]
+    got = {}
+    threads = [threading.Thread(
+        target=lambda i=i, p=p, mt=mt: got.__setitem__(
+            i, gw.submit(p, mt, timeout=60.0)), daemon=True)
+        for i, (p, mt) in enumerate(reqs)]
+    for t in threads:
+        t.start()
+        time.sleep(0.01)        # distinct submitted_at stamps, in order
+    # feed segments one at a time until all three are co-resident
+    deadline = time.monotonic() + 30.0
+    while len(batchers[0]._track) < 3:
+        assert time.monotonic() < deadline, "3 requests never co-resident"
+        gate.release()
+        time.sleep(0.002)
+    # the worker is (or will be) parked inside a gated segment; keep
+    # feeding permits so it can reach the drain handshake between steps
+    feeder_stop = threading.Event()
+
+    def feeder():
+        while not feeder_stop.is_set():
+            gate.release()
+            time.sleep(0.002)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    ids = gw.drain_replica(0, reason="slice_revoked")
+    feeder_stop.set()
+    assert len(ids) == 3
+    hold["on"] = False
+    gate.release(50)
+    for t in threads:
+        t.join(60.0)
+    for i, (p, mt) in enumerate(reqs):
+        want = [int(x) for x in fake_row(p, len(p) + mt)]
+        assert got[i] == want, f"victim {i} diverged after re-route"
+    snap = gw.snapshot()
+    assert snap["requeued_total"] == 3 and snap["draining"] == [0]
+    # all three victims re-routed to the healthy replica, labeled requeue
+    assert snap["routed"]["1"].get("requeue") == 3
+    assert gw.stats.snapshot()["requests_requeued_total"] == 3
+    # victims reached the healthy replica in original submission order
+    assert landed == [20, 21, 22]
+    gw.readmit_replica(0)
+    assert gw.snapshot()["draining"] == []
+    # the readmitted replica routes again
+    assert gw.submit(home0 + [99], 4, timeout=60.0) \
+        == [int(x) for x in fake_row(home0 + [99], len(home0) + 1 + 4)]
+
+
+# ---------------------------------------------------------------------------
+# router signals: affinity accounting and spill-over order
+# ---------------------------------------------------------------------------
+
+def test_prefix_affinity_accounting():
+    """Sticky hits and misses are counted honestly: same-prefix requests
+    all land home (ratio 1.0), sub-page prompts fall back to least-loaded
+    without touching the ratio, and a drained home turns the next
+    same-prefix request into a counted spill."""
+    engines, batchers = _fake_cluster(2)
+    gw = ServeGateway(batchers, policy="sticky_prefix")
+    home0 = _first_page_for_home(2, 0)
+    for i in range(3):
+        gw.submit(home0 + [30 + i], 4, timeout=60.0)
+    assert gw.affinity_ratio() == 1.0
+    assert gw.snapshot()["routed"]["0"]["sticky"] == 3
+    # sub-page prompt: no page-aligned prefix to be sticky about
+    gw.submit([1, 2, 3], 4, timeout=60.0)
+    assert gw.affinity_ratio() == 1.0          # not sticky-eligible
+    gw.drain_replica(0)
+    gw.submit(home0 + [40], 4, timeout=60.0)   # home gone -> spill
+    assert gw.affinity_ratio() == pytest.approx(3 / 4)
+    snap = gw.snapshot()
+    assert snap["routed"]["1"].get("spill") == 1
+
+
+def test_saturation_spills_to_least_loaded():
+    """A saturated home sheds load to the LEAST-loaded healthy replica:
+    with the home's backlog at ``spill_after`` and another replica
+    busier than the idle one, the spill lands on the idle replica."""
+    engines, batchers = _fake_cluster(3)
+    gates = []
+    for eng in engines[:2]:     # replicas 0 and 1 hold their decodes
+        gate = threading.Semaphore(0)
+        orig = eng.run_segment
+        eng.run_segment = (lambda g=gate, o=orig:
+                           (g.acquire(timeout=30), o()))
+        gates.append(gate)
+    gw = ServeGateway(batchers, policy="sticky_prefix", spill_after=2)
+    home0 = _first_page_for_home(3, 0)
+    home1 = _first_page_for_home(3, 1)
+    done = []
+    for k, (p, mt) in enumerate([(home0 + [50], 6), (home0 + [51], 6),
+                                 (home1 + [52], 6)]):
+        t = threading.Thread(
+            target=lambda p=p, mt=mt: done.append(
+                gw.submit(p, mt, timeout=60.0)), daemon=True)
+        t.start()
+    _spin(lambda: batchers[0].backlog() == 2 and batchers[1].backlog() == 1,
+          msg="home saturated, replica 1 busy")
+    # home 0 is at spill_after=2; replica 2 (idle) beats replica 1 (busy)
+    gw.submit(home0 + [53], 4, timeout=60.0)
+    snap = gw.snapshot()
+    assert snap["routed"]["2"].get("spill") == 1
+    assert gw.affinity_ratio() == pytest.approx(3 / 4)
+    for g in gates:
+        g.release(50)
+    _spin(lambda: len(done) == 3, msg="held decodes finish")
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill -> decode handoff
+# ---------------------------------------------------------------------------
+
+def test_disagg_handoff_removes_prefill_from_decode_path():
+    """With a PrefillWorker attached, a page-aligned prompt's prefill
+    runs on the worker's engine and the decode replica's admission is a
+    prefix hit — the admit wave stops paying the prefill sleep, which is
+    exactly the segment-time interference the attribution measures. The
+    admit span also carries the replica stamp (serve-trace satellite)."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        ServeTracer, ServeTraceStore,
+    )
+    prompt = PRE + [11, 12]     # 2-page aligned prefix + unique tail
+    PREFILL_S = 0.05
+
+    def build(with_worker):
+        engines = [FakePagedEngine(slots=4, segment=2, max_total=24, page=8,
+                                   step_s=0.0, dispatch_s=0.0,
+                                   prefill_s=PREFILL_S)
+                   for _ in range(2)]
+        store = ServeTraceStore(max_records=8)
+        batchers = [ContinuousBatcher(e, stats=BatcherStats(),
+                                      tracer=ServeTracer(store))
+                    for e in engines]
+        worker = None
+        if with_worker:
+            worker = PrefillWorker(FakePagedEngine(
+                slots=1, segment=2, max_total=24, page=8,
+                step_s=0.0, dispatch_s=0.0, prefill_s=PREFILL_S))
+        gw = ServeGateway(batchers, policy="sticky_prefix",
+                          prefill_worker=worker, handoff_min_pages=1)
+        return gw, engines, store, worker
+
+    def admit_span(store):
+        rec = store.records()[0]
+        return next(s for s in rec.spans if s["name"] == "admit")
+
+    # baseline: the decode worker thread pays the full prefill
+    gw, engines, store, _ = build(with_worker=False)
+    got = gw.submit(prompt, 6, timeout=60.0)
+    assert got == [int(x) for x in fake_row(prompt, len(prompt) + 6)]
+    cold = admit_span(store)
+    assert cold["duration_s"] >= PREFILL_S
+    assert gw.snapshot()["handoff_pages"] == 0
+
+    # disaggregated: pages land first, the decode admission is a hit
+    gw, engines, store, worker = build(with_worker=True)
+    got = gw.submit(prompt, 6, timeout=60.0)
+    assert got == [int(x) for x in fake_row(prompt, len(prompt) + 6)]
+    hot = admit_span(store)
+    assert hot["duration_s"] < PREFILL_S / 2, \
+        "decode admission still paying the prefill"
+    assert worker.prefills == 1
+    assert gw.snapshot()["handoff_pages"] == 2          # whole pages
+    assert sum(e.prefix_hits for e in engines) == 1
+    # the admit span is stamped with the replica that served it
+    idx = int(hot["attributes"]["replica"])
+    assert gw.snapshot()["routed"][str(idx)].get("sticky") == 1
+    # the SAME aligned prefix doesn't hand off twice
+    gw.submit(aligned_prefix(prompt, 8) + [42], 6, timeout=60.0)
+    assert gw.snapshot()["handoff_pages"] == 2
+
+
+def test_real_engine_page_handoff_bit_exact(params):
+    """Real block-table handoff: export_prefix on the prefill engine
+    gathers whole pages, import_prefix lands them in a second engine's
+    pool via _page_copy, and a subsequent decode over that prefix is a
+    prefix-cache hit with tokens bit-identical to solo generate()."""
+    src = SlotPoolEngine(CFG, params, slots=2, segment=3)
+    worker = PrefillWorker(src)
+    payload = worker.prefill(PRE)               # 16 tokens = 2 pages
+    assert payload["pages"] == 2
+    assert len(payload["layers"]) == CFG.n_layers
+    for kp, vp in payload["layers"]:
+        assert kp.shape[0] == 2                 # whole pages, not rows
+
+    dst = SlotPoolEngine(CFG, params, slots=2, segment=3)
+    assert dst.import_prefix(payload["tokens"], payload["layers"]) == 2
+    # re-import of a covered prefix is a no-op
+    assert dst.import_prefix(payload["tokens"], payload["layers"]) == 0
+
+    prompt, mt = PRE + [11, 12], 6
+    track = {0: None}
+    pos = dst.admit([(0, prompt, mt, 0.0, 0)])
+    assert dst.prefix_hits == 1                 # imported pages hit
+    last = len(prompt) + mt - 1
+    p = pos[0]
+    for _ in range(50):
+        if p >= last:
+            break
+        dst.run_segment()
+        p = min(p + dst.segment, last)
+    buf, _ = dst.poll()
+    assert buf[0][:len(prompt) + mt].tolist() == solo(params, prompt, mt)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 bench guard + artifact of record
+# ---------------------------------------------------------------------------
+
+def test_cluster_sticky_beats_round_robin_ttft():
+    """Equal replicas, equal aggregate KV HBM, same multi-tenant
+    shared-prefix trace: sticky-prefix routing must hold >= 1.3x the
+    round-robin mean TTFT (acceptance; ~2x typical on this shape)."""
+    bs = _bench_mod()
+    out = bs.bench_cluster(requests=48)
+    assert out["ttft_gain"] >= 1.3, out
+    assert out["sticky"]["prefix_hits"] > out["round_robin"]["prefix_hits"]
+    assert out["sticky"]["affinity_ratio"] == 1.0
+
+
+def test_cluster_serving_artifact_checked_in():
+    """MULTICHIP_serving_r03.json is the cluster A/B's number of record:
+    present, ok, and holding the same >=1.3x TTFT bar the live bench is
+    pinned to."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MULTICHIP_serving_r03.json")
+    with open(path, encoding="utf-8") as fh:
+        art = json.load(fh)
+    assert art["ok"] is True and art["rc"] == 0
+    assert art["ttft_gain"] >= 1.3
+    assert art["sticky"]["mean_ttft_s"] < art["round_robin"]["mean_ttft_s"]
+
+
+# ---------------------------------------------------------------------------
+# scenario spec: replicas/router keys
+# ---------------------------------------------------------------------------
+
+def test_scenario_spec_validates_cluster_keys():
+    from kubeoperator_tpu.scenario.spec import SCENARIOS, validate_spec
+    base = {"name": "x", "beats": 4, "workloads": [
+        {"kind": "serving", "trace": {"shape": "uniform", "requests": 4}}]}
+    ok = dict(base)
+    ok["workloads"] = [dict(base["workloads"][0], replicas=3,
+                            router="round_robin")]
+    assert validate_spec(ok) == []
+    bad_reps = dict(base)
+    bad_reps["workloads"] = [dict(base["workloads"][0], replicas=0)]
+    assert any("replicas" in e for e in validate_spec(bad_reps))
+    bad_router = dict(base)
+    bad_router["workloads"] = [dict(base["workloads"][0], router="nope")]
+    assert any("router" in e for e in validate_spec(bad_router))
+    # the catalog ships a cluster scenario and it validates clean
+    assert validate_spec(SCENARIOS["cluster_prefix_burst"]) == []
