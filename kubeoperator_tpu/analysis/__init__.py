@@ -4,10 +4,14 @@
 families in :mod:`rules_jax` (KO1xx — host sync in loops, donation
 misuse, retrace hazards, closure capture, unpinned sharded writes) and
 :mod:`rules_control` (KO2xx — unguarded shared-state writes, undeclared
-metric names) plus the project-scoped drift checks in :mod:`project`
-(README↔registry, README↔rule-table, catalog schema).
+metric names), the whole-program rules in :mod:`rules_concurrency`
+(KO3xx — interprocedural lock/race analysis over the semantic model in
+:mod:`semantic`), and the project-scoped drift checks in :mod:`project`
+(README↔registry, README↔rule-table, catalog schema) plus the KO140
+jit trace-signature baseline (``analysis/signatures.json``).
 :mod:`compile_guard` is the runtime counterpart used by tier-1 to pin
-compiles per shape signature.
+compiles per shape signature — and to assert the runtime signatures
+stay a subset of the static baseline.
 """
 
 from kubeoperator_tpu.analysis.compile_guard import (
@@ -18,7 +22,7 @@ from kubeoperator_tpu.analysis.core import (
     severity_at_least,
 )
 from kubeoperator_tpu.analysis import (  # noqa: F401  (rule registration)
-    project, rules_control, rules_jax,
+    project, rules_concurrency, rules_control, rules_jax, semantic,
 )
 
 __all__ = [

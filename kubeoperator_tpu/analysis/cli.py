@@ -4,15 +4,30 @@ Exit status: 0 when no finding reaches ``--fail-level`` (default
 ``warning``), 1 otherwise, 2 on usage errors. ``--json`` emits the
 machine-readable report (schema version 1) consumed by scripts/
 lint_gate.sh and CI.
+
+Incremental mode: ``--changed`` (working tree vs HEAD) or ``--since
+REV`` lints the whole program — the semantic model and KO3xx/KO140
+rules need every module — but *reports* only findings in the changed
+files, so the gate stays fast to read as the tree grows.
+
+Adoption mode: ``--baseline report.json`` compares against a previous
+``--json`` report; pre-existing findings are printed as warnings but
+only NEW findings trip the exit code — a gate can be adopted mid-stream
+without a flag-day. ``--update-signatures`` regenerates the KO140 jit
+trace-signature baseline (analysis/signatures.json) and exits.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
+import subprocess
 import sys
 
 from kubeoperator_tpu.analysis.core import (
-    RULES, SEVERITIES, _ensure_rules, lint_paths, severity_at_least,
+    RULES, SEVERITIES, _ensure_rules, find_project_root, lint_paths,
+    severity_at_least,
 )
 
 
@@ -34,9 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: all")
     p.add_argument("--no-project", action="store_true",
                    help="skip project-scoped rules (README drift, "
-                        "catalog schema)")
+                        "catalog schema, signature baseline)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files changed vs HEAD "
+                        "(the full semantic model is still built)")
+    p.add_argument("--since", metavar="REV", default=None,
+                   help="report only findings in files changed since REV "
+                        "(implies --changed)")
+    p.add_argument("--update-signatures", action="store_true",
+                   help="regenerate the KO140 jit trace-signature "
+                        "baseline (analysis/signatures.json) and exit")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help="previous --json report: pre-existing findings "
+                        "warn, only new ones fail")
     return p
 
 
@@ -44,9 +71,49 @@ def list_rules(out=sys.stdout) -> None:
     _ensure_rules()
     for rid in sorted(RULES):
         rule = RULES[rid]
-        scope = "project" if getattr(rule, "project_scope", False) \
-            else "module"
+        if getattr(rule, "project_scope", False):
+            scope = "project"
+        elif getattr(rule, "semantic_scope", False):
+            scope = "program"
+        else:
+            scope = "module"
         out.write(f"{rid}  {rule.severity:<7}  {scope:<7}  {rule.title}\n")
+
+
+def _changed_files(root: str, since: str | None) -> set[str] | None:
+    """Absolute paths of files changed vs ``since`` (default HEAD),
+    including uncommitted/untracked work. None when git is unusable —
+    the caller falls back to a full report rather than a silent pass."""
+    rev = since or "HEAD"
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", rev, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = diff.stdout.splitlines()
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    return {os.path.abspath(os.path.join(root, n))
+            for n in names if n.strip()}
+
+
+def _load_baseline_report(path: str) -> set[tuple[str, str, str]] | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    out = set()
+    for f in doc.get("findings", []):
+        out.add((f.get("path", ""), f.get("rule", ""),
+                 f.get("message", "")))
+    return out
 
 
 def run_lint(argv: list[str] | None = None, out=sys.stdout) -> int:
@@ -58,20 +125,69 @@ def run_lint(argv: list[str] | None = None, out=sys.stdout) -> int:
     if args.select:
         select = {r.strip() for chunk in args.select
                   for r in chunk.split(",") if r.strip()}
+    root = find_project_root(next(iter(args.paths), "."))
+    if args.update_signatures:
+        from kubeoperator_tpu.analysis import semantic
+        from kubeoperator_tpu.analysis.core import (
+            ModuleContext, _iter_files,
+        )
+        if root is None:
+            out.write("error: no project root (pyproject.toml) found\n")
+            return 2
+        contexts = {}
+        for path in _iter_files(args.paths):
+            if not path.endswith(".py"):
+                continue
+            try:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    contexts[path] = ModuleContext.parse(path, fh.read())
+            except SyntaxError:
+                continue
+        model = semantic.build_model(contexts, root=root)
+        written = semantic.update_signatures(root, model)
+        n = len(semantic.jit_fingerprints(model))
+        out.write(f"wrote {n} jit signature(s) to {written}\n")
+        return 0
+    report_on = None
+    if args.changed or args.since:
+        if root is None:
+            out.write("error: --changed/--since need a project root "
+                      "(pyproject.toml) for git\n")
+            return 2
+        report_on = _changed_files(root, args.since)
+        if report_on is None:
+            out.write("warning: git diff failed; reporting all files\n")
     result = lint_paths(args.paths, select=select,
-                        project=not args.no_project)
+                        project=not args.no_project, report_on=report_on)
+    known = set()
+    if args.baseline:
+        base = _load_baseline_report(args.baseline)
+        if base is None:
+            out.write(f"error: cannot read baseline report "
+                      f"{args.baseline}\n")
+            return 2
+        known = base
+    def _is_known(f):
+        return (f.path, f.rule, f.message) in known
     if args.as_json:
         out.write(result.to_json() + "\n")
     else:
         for f in result.findings:
-            out.write(f.format() + "\n")
+            prefix = "[pre-existing] " if known and _is_known(f) else ""
+            out.write(prefix + f.format() + "\n")
         counts = result.counts()
         summary = ", ".join(f"{counts[s]} {s}" for s in reversed(SEVERITIES))
         out.write(f"{len(result.findings)} finding(s) ({summary}); "
                   f"{result.suppressed} suppressed; "
                   f"{result.files} file(s) checked\n")
+        if known:
+            pre = sum(1 for f in result.findings if _is_known(f))
+            out.write(f"baseline: {pre} pre-existing finding(s) "
+                      f"tolerated, "
+                      f"{len(result.findings) - pre} new\n")
     gate = [f for f in result.findings
-            if severity_at_least(f.severity, args.fail_level)]
+            if severity_at_least(f.severity, args.fail_level)
+            and not (known and _is_known(f))]
     return 1 if gate else 0
 
 

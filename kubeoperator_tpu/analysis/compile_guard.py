@@ -124,6 +124,38 @@ class CompileCountGuard:
             raise AssertionError(
                 f"retrace detected — >1 trace per shape signature: {detail}")
 
+    def signature_names(self) -> set[str]:
+        """Distinct function names observed tracing — the dynamic half of
+        the KO140 contract: everything that compiled at runtime must be a
+        jit site the static fingerprint pass knows about."""
+        return {n for (n, _, _) in self.counts}
+
+    def assert_within_baseline(self, baseline_path: str | None = None,
+                               names: set[str] | None = None) -> None:
+        """Raise unless every traced function name appears as a wrapped
+        callable in the checked-in ``analysis/signatures.json`` (KO140)
+        baseline. Wires the runtime guard to the static fingerprints —
+        and to the ROADMAP AOT cache key: a function compiling at
+        runtime that the baseline has never heard of is exactly the
+        signature drift KO140 exists to catch."""
+        import json
+        import os
+
+        if baseline_path is None:
+            baseline_path = os.path.join(os.path.dirname(__file__),
+                                         "signatures.json")
+        with open(baseline_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        known = {fp.get("function") for fp in
+                 doc.get("signatures", {}).values()}
+        observed = names if names is not None else self.signature_names()
+        unknown = sorted(n for n in observed if n not in known)
+        if unknown:
+            raise AssertionError(
+                f"function(s) traced at runtime but absent from the jit "
+                f"signature baseline {baseline_path}: {unknown} — "
+                f"regenerate with `ko lint --update-signatures`")
+
 
 def compile_count_guard() -> CompileCountGuard:
     """``with compile_count_guard() as guard: ...`` — see the module

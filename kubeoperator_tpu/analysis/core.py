@@ -269,17 +269,46 @@ def pragma_findings(path: str, pragmas: list[Pragma],
     return out
 
 
-def apply_pragmas(findings: list[Finding],
-                  pragmas: list[Pragma]) -> tuple[list[Finding], int]:
+#: statement types a pragma extends across when they span lines — simple
+#: (non-compound) statements only, so a pragma on a `with`/`for` header
+#: line can never silence the whole block under it
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+                 ast.Return, ast.Raise, ast.Assert, ast.Delete)
+
+
+def statement_extents(tree: ast.AST) -> list[tuple[int, int]]:
+    """(start, end) line spans of every *simple* statement that wraps
+    across lines — e.g. a parenthesised ``jax.jit(...)`` assignment. A
+    pragma anywhere in the span (and a finding anchored anywhere in it)
+    belong to the same statement."""
+    return [(n.lineno, n.end_lineno) for n in ast.walk(tree)
+            if isinstance(n, _SIMPLE_STMTS)
+            and getattr(n, "end_lineno", n.lineno) > n.lineno]
+
+
+def apply_pragmas(findings: list[Finding], pragmas: list[Pragma],
+                  extents: list[tuple[int, int]] | None = None,
+                  ) -> tuple[list[Finding], int]:
     """Drop findings suppressed by a pragma on the same line (or on a
-    standalone comment line immediately above). KO000/KO001 — the pragma
-    hygiene rules — are never suppressible."""
+    standalone comment line immediately above). When ``extents`` is
+    given, a pragma landing anywhere inside a multi-line simple
+    statement covers the statement's full span — the innermost span is
+    used, so nesting stays tight. KO000/KO001 — the pragma hygiene
+    rules — are never suppressible."""
     cover: dict[int, set[str]] = {}
     for p in pragmas:
         ids = set(p.rules)
         cover.setdefault(p.line, set()).update(ids)
         if p.standalone:
             cover.setdefault(p.line + 1, set()).update(ids)
+    if extents:
+        for line, ids in list(cover.items()):
+            spans = [s for s in extents if s[0] <= line <= s[1]]
+            if not spans:
+                continue
+            a, b = min(spans, key=lambda s: s[1] - s[0])
+            for covered in range(a, b + 1):
+                cover.setdefault(covered, set()).update(ids)
     kept, suppressed = [], 0
     for f in findings:
         ids = cover.get(f.line, ())
@@ -339,15 +368,42 @@ def _ensure_rules() -> None:
     """Import the rule modules for their @register side effects, so the
     engine works no matter which entry point was imported first."""
     from kubeoperator_tpu.analysis import (  # noqa: F401
-        project, rules_control, rules_jax,
+        project, rules_concurrency, rules_control, rules_jax, semantic,
     )
+
+
+def _module_findings(ctx: ModuleContext,
+                     select: set[str] | None) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in RULES.values():
+        if getattr(rule, "project_scope", False) \
+                or getattr(rule, "semantic_scope", False):
+            continue
+        if select and rule.id not in select:
+            continue
+        findings.extend(rule.check(ctx))
+    return findings
+
+
+def _semantic_findings(model, select: set[str] | None) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in RULES.values():
+        if not getattr(rule, "semantic_scope", False):
+            continue
+        if select and rule.id not in select:
+            continue
+        findings.extend(rule.check_model(model))
+    return findings
 
 
 def lint_file(path: str, text: str | None = None,
               select: set[str] | None = None) -> tuple[list[Finding], int]:
-    """Lint one python module: run every registered AST rule, then apply
-    pragma suppression. Returns (findings, n_suppressed). Syntax errors
-    come back as a single KO002 finding rather than crashing the run."""
+    """Lint one python module: run every registered AST rule plus the
+    semantic (whole-program) rules over a single-module model, then
+    apply pragma suppression. Returns (findings, n_suppressed). Syntax
+    errors come back as a single KO002 finding rather than crashing."""
+    from kubeoperator_tpu.analysis import semantic as semantic_mod
+
     _ensure_rules()
     if text is None:
         with open(path, encoding="utf-8", errors="replace") as fh:
@@ -359,33 +415,37 @@ def lint_file(path: str, text: str | None = None,
                         line=e.lineno or 1, col=(e.offset or 0) + 1,
                         message=f"syntax error: {e.msg}",
                         hint="file does not parse; fix before linting")], 0
-    findings: list[Finding] = []
-    for rule in RULES.values():
-        if getattr(rule, "project_scope", False):
-            continue
-        if select and rule.id not in select:
-            continue
-        findings.extend(rule.check(ctx))
+    findings = _module_findings(ctx, select)
+    model = semantic_mod.build_model({path: ctx})
+    findings.extend(_semantic_findings(model, select))
     pragmas = scan_pragmas(ctx.lines)
     findings.extend(f for f in pragma_findings(path, pragmas, RULES)
                     if not select or f.rule in select)
-    return apply_pragmas(findings, pragmas)
+    return apply_pragmas(findings, pragmas, statement_extents(ctx.tree))
 
 
 def lint_paths(paths: Iterable[str], *, select: Iterable[str] | None = None,
-               project: bool = True) -> LintResult:
-    """Lint every ``.py`` file (and ``catalog.yml``) under ``paths``; when
-    ``project`` is true, additionally run the project-scoped drift rules
-    (README metric/rule tables) anchored at the enclosing repo root."""
+               project: bool = True,
+               report_on: set[str] | None = None) -> LintResult:
+    """Lint every ``.py`` file (and ``catalog.yml``) under ``paths``.
+    All modules are parsed into ONE whole-program semantic model before
+    the KO3xx/KO140 rules run, so cross-file lock and signature facts
+    resolve no matter which subset is being reported. ``report_on``
+    (absolute paths) filters the *reported* findings to changed files —
+    the incremental ``--changed`` mode — without shrinking the model.
+    When ``project`` is true, the project-scoped drift rules (README
+    metric/rule tables, signature baseline) run anchored at the
+    enclosing repo root."""
     from kubeoperator_tpu.analysis import project as project_rules
+    from kubeoperator_tpu.analysis import semantic as semantic_mod
 
     _ensure_rules()
 
     sel = set(select) if select else None
     findings: list[Finding] = []
-    suppressed = 0
     files = 0
     seen_catalog = False
+    contexts: dict[str, ModuleContext] = {}
     for path in _iter_files(paths):
         files += 1
         if path.endswith(".yml"):
@@ -393,22 +453,56 @@ def lint_paths(paths: Iterable[str], *, select: Iterable[str] | None = None,
             found = project_rules.check_catalog(path)
             findings.extend(f for f in found if not sel or f.rule in sel)
             continue
-        found, supp = lint_file(path, select=sel)
-        findings.extend(found)
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            text = fh.read()
+        try:
+            ctx = ModuleContext.parse(path, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="KO002", severity="error", path=path,
+                line=e.lineno or 1, col=(e.offset or 0) + 1,
+                message=f"syntax error: {e.msg}",
+                hint="file does not parse; fix before linting"))
+            continue
+        contexts[path] = ctx
+        findings.extend(_module_findings(ctx, sel))
+        findings.extend(f for f in pragma_findings(
+            path, scan_pragmas(ctx.lines), RULES)
+            if not sel or f.rule in sel)
+    root = find_project_root(next(iter(paths), "."))
+    model = semantic_mod.build_model(contexts, root=root)
+    findings.extend(_semantic_findings(model, sel))
+    if project and root is not None:
+        found = list(project_rules.check_readme_metrics(root))
+        found += project_rules.check_readme_rules(root)
+        found += RULES["KO140"].check_project(model)
+        if not seen_catalog:
+            cat = os.path.join(root, "kubeoperator_tpu", "config",
+                               "catalog.yml")
+            if os.path.exists(cat):
+                found += project_rules.check_catalog(cat)
+        findings.extend(f for f in found if not sel or f.rule in sel)
+    # pragma suppression runs last so semantic findings — which land on
+    # any file in the model — get the same treatment as per-module ones
+    kept: list[Finding] = []
+    suppressed = 0
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path, found in by_path.items():
+        ctx = contexts.get(path)
+        if ctx is None:
+            kept.extend(found)
+            continue
+        ok, supp = apply_pragmas(found, scan_pragmas(ctx.lines),
+                                 statement_extents(ctx.tree))
+        kept.extend(ok)
         suppressed += supp
-    if project:
-        root = find_project_root(next(iter(paths), "."))
-        if root is not None:
-            found = project_rules.check_readme_metrics(root)
-            found += project_rules.check_readme_rules(root)
-            if not seen_catalog:
-                cat = os.path.join(root, "kubeoperator_tpu", "config",
-                                   "catalog.yml")
-                if os.path.exists(cat):
-                    found += project_rules.check_catalog(cat)
-            findings.extend(f for f in found if not sel or f.rule in sel)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return LintResult(findings=findings, suppressed=suppressed, files=files)
+    if report_on is not None:
+        kept = [f for f in kept
+                if os.path.abspath(f.path) in report_on]
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=kept, suppressed=suppressed, files=files)
 
 
 def find_project_root(start: str) -> str | None:

@@ -1,0 +1,328 @@
+"""Interprocedural concurrency rules (KO3xx) over the whole-program
+semantic model (``semantic.py``).
+
+KO301 generalizes KO201 across call and thread boundaries: starting
+from every discovered thread entrypoint (``Thread(target=...)``,
+``Timer``, executor ``submit``, task-engine beats) it walks the call
+graph tracking the *per-path* set of held locks, and flags a write to a
+lock-owning class's attribute when **some** path from a thread reaches
+it without that class's lock. Per-path (not may-hold) semantics is what
+lets it exonerate the callees KO201 cannot: ``ServeGateway._picked`` is
+written lock-free lexically, but every path into it already holds
+``_lock`` — no finding.
+
+KO302 builds the lock-acquisition-order graph — an edge L1→L2 whenever
+L2 is acquired while L1 may be held, including through calls into other
+classes — and reports any strongly-connected component (a potential
+ABBA deadlock), plus direct re-acquisition of a non-reentrant ``Lock``.
+
+KO303 flags invoking a *stored callback field* (an attribute of a
+lock-owning class that is neither method, lock, event, nor typed
+sub-object — e.g. the batcher's ``requeue_sink``) while any lock may be
+held: the callback's owner is another subsystem that may re-enter the
+lock, the classic self-deadlock-by-callback. May-hold (union) semantics
+on purpose — a callback under a lock on *any* path is worth a look, and
+single-subscriber designs document themselves with a pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Iterator
+
+from kubeoperator_tpu.analysis.core import Finding, Rule, register
+from kubeoperator_tpu.analysis.semantic import (
+    FuncInfo, LockNode, ProjectModel,
+)
+
+_CTOR_METHODS = {"__init__", "__post_init__", "__new__"}
+#: reach-analysis state cap — far above the repo's real state count, a
+#: backstop against pathological call graphs in fuzzed input
+_MAX_STATES = 50_000
+
+
+def _fmt_lock(lock: LockNode) -> str:
+    return f"{lock[0]}.{lock[1]}"
+
+
+# ---------------------------------------------------------------------------
+# KO301 — per-path reach from thread entrypoints
+# ---------------------------------------------------------------------------
+
+@register
+class ThreadWriteWithoutLock(Rule):
+    """KO301 — an attribute of a lock-owning class is written on some
+    path from a thread entrypoint without that class's lock held."""
+
+    id = "KO301"
+    severity = "warning"
+    title = "thread-reachable write without the owning class's lock"
+    hint = ("take the owning lock on the unlocked path (or hoist the "
+            "write under the caller's `with`), or document the "
+            "single-writer invariant with a pragma")
+
+    semantic_scope = True
+
+    def check_model(self, model: ProjectModel) -> Iterator[Finding]:
+        seen: set[int] = set()           # id(write node) — first path wins
+        for entry in model.entrypoints:
+            root = model.functions.get(entry.func)
+            if root is None:
+                continue
+            yield from self._walk(model, root, entry, seen)
+
+    def _walk(self, model: ProjectModel, root: FuncInfo, entry,
+              seen: set[int]) -> Iterator[Finding]:
+        start = (root.key, frozenset())
+        visited: set = {start}
+        queue = deque([start])
+        while queue:
+            key, held = queue.popleft()
+            func = model.functions[key]
+            for op in func.ops:
+                eff = held | model.held_locks(func, op.held)
+                if op.kind == "write":
+                    yield from self._check_write(model, func, op, eff,
+                                                 entry, seen)
+                elif op.kind == "call":
+                    callee = model.resolve_call(func, op.chain)
+                    if callee is None or callee.name in _CTOR_METHODS:
+                        continue
+                    state = (callee.key, eff)
+                    if state not in visited and len(visited) < _MAX_STATES:
+                        visited.add(state)
+                        queue.append(state)
+
+    def _check_write(self, model: ProjectModel, func: FuncInfo, op,
+                     eff: frozenset[LockNode], entry,
+                     seen: set[int]) -> Iterator[Finding]:
+        owner = model.type_of_chain(func, op.chain[:-1])
+        if owner is None or owner not in model.classes:
+            return
+        cls = model.classes[owner]
+        attr = op.chain[-1]
+        if not cls.locks or attr in cls.locks or attr in cls.events:
+            return
+        if func.name in _CTOR_METHODS and func.owner == owner:
+            return                        # constructing, not yet shared
+        if any(lock[0] == owner for lock in eff):
+            return                        # some lock of the owner is held
+        if id(op.node) in seen:
+            return
+        seen.add(id(op.node))
+        locks = ", ".join(f"self.{a}" for a in sorted(cls.locks))
+        via = f"{entry.via} entrypoint " \
+              f"{entry.func[0] + '.' if entry.func[0] else ''}{entry.func[1]}"
+        yield Finding(
+            rule=self.id, severity=self.severity, path=func.ctx.path,
+            line=op.node.lineno, col=op.node.col_offset + 1,
+            message=f"{func.qual} writes {owner}.{attr} on a path from "
+                    f"{via} without holding the class's lock ({locks})",
+            hint=self.hint)
+
+
+# ---------------------------------------------------------------------------
+# shared may-hold fixpoint (KO302/KO303)
+# ---------------------------------------------------------------------------
+
+def _may_held(model: ProjectModel) -> dict[tuple, frozenset[LockNode]]:
+    """For every function, the union of locks held across *any* call
+    path into it (conservative union semantics, seeded empty at every
+    function so public entry from anywhere is covered)."""
+    held: dict[tuple, set[LockNode]] = {k: set() for k in model.functions}
+    changed = True
+    while changed:
+        changed = False
+        for key, func in model.functions.items():
+            base = held[key]
+            for op in func.ops:
+                if op.kind != "call":
+                    continue
+                callee = model.resolve_call(func, op.chain)
+                if callee is None or callee.name in _CTOR_METHODS:
+                    continue
+                eff = base | model.held_locks(func, op.held)
+                tgt = held[callee.key]
+                if not eff <= tgt:
+                    tgt |= eff
+                    changed = True
+    return {k: frozenset(v) for k, v in held.items()}
+
+
+# ---------------------------------------------------------------------------
+# KO302 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+@register
+class LockOrderCycle(Rule):
+    """KO302 — the may-hold lock-acquisition graph has a cycle: two (or
+    more) locks each acquired while the other may be held, across any
+    mix of classes and call chains. Also flags directly re-acquiring a
+    non-reentrant ``threading.Lock`` already held."""
+
+    id = "KO302"
+    severity = "error"
+    title = "lock-acquisition-order cycle (potential deadlock)"
+    hint = ("impose a global acquisition order (always take the locks "
+            "in the same sequence) or narrow one side to drop its lock "
+            "before calling into the other")
+
+    semantic_scope = True
+
+    def check_model(self, model: ProjectModel) -> Iterator[Finding]:
+        may = _may_held(model)
+        edges: dict[LockNode, set[LockNode]] = {}
+        sites: dict[tuple[LockNode, LockNode], tuple] = {}
+        for key, func in model.functions.items():
+            for op in func.ops:
+                if op.kind != "acquire":
+                    continue
+                l2 = model.lock_of_chain(func, op.chain)
+                if l2 is None:
+                    continue
+                eff = may[key] | model.held_locks(func, op.held)
+                for l1 in eff:
+                    if l1 == l2:
+                        continue        # self re-entry handled below
+                    edges.setdefault(l1, set()).add(l2)
+                    sites.setdefault((l1, l2), (func, op))
+                yield from self._self_reentry(model, func, op, l2)
+        yield from self._cycles(model, edges, sites)
+
+    def _self_reentry(self, model: ProjectModel, func: FuncInfo, op,
+                      lock: LockNode) -> Iterator[Finding]:
+        """Lexical-only on purpose: the may-hold union would brand any
+        method *sometimes* called under the lock as a guaranteed
+        deadlock when it takes the lock itself."""
+        held_here = model.held_locks(func, op.held)
+        kind = model.classes[lock[0]].locks.get(lock[1])
+        if lock in held_here and kind == "Lock":
+            yield Finding(
+                rule=self.id, severity=self.severity, path=func.ctx.path,
+                line=op.node.lineno, col=op.node.col_offset + 1,
+                message=f"{func.qual} re-acquires non-reentrant lock "
+                        f"{_fmt_lock(lock)} already held on this path — "
+                        f"guaranteed self-deadlock",
+                hint="use an RLock, or split the locked region")
+
+    def _cycles(self, model: ProjectModel,
+                edges: dict[LockNode, set[LockNode]],
+                sites: dict) -> Iterator[Finding]:
+        for scc in _sccs(edges):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            # anchor at the acquire site of the first edge inside the SCC
+            anchor = None
+            for l1 in cyc:
+                for l2 in sorted(edges.get(l1, ())):
+                    if l2 in scc and (l1, l2) in sites:
+                        anchor = sites[(l1, l2)]
+                        break
+                if anchor:
+                    break
+            if anchor is None:
+                continue
+            func, op = anchor
+            order = " -> ".join(_fmt_lock(x) for x in cyc + [cyc[0]])
+            yield Finding(
+                rule=self.id, severity=self.severity, path=func.ctx.path,
+                line=op.node.lineno, col=op.node.col_offset + 1,
+                message=f"lock-acquisition-order cycle: {order} — threads "
+                        f"taking these in opposite orders deadlock",
+                hint=self.hint)
+
+
+def _sccs(edges: dict[LockNode, set[LockNode]]) -> list[set[LockNode]]:
+    """Tarjan, iterative (lint runs inside pytest's default recursion
+    limit on adversarial graphs)."""
+    nodes: set[LockNode] = set(edges)
+    for targets in edges.values():
+        nodes |= targets
+    index: dict[LockNode, int] = {}
+    low: dict[LockNode, int] = {}
+    on_stack: set[LockNode] = set()
+    stack: list[LockNode] = []
+    counter = [0]
+    out: list[set[LockNode]] = []
+
+    for start in sorted(nodes):
+        if start in index:
+            continue
+        work = [(start, iter(sorted(edges.get(start, ()))))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(edges.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KO303 — callback invoked while a lock may be held
+# ---------------------------------------------------------------------------
+
+@register
+class CallbackUnderLock(Rule):
+    """KO303 — a stored callback field is invoked while a lock may be
+    held on some path; the callback's owner can re-enter the lock."""
+
+    id = "KO303"
+    severity = "warning"
+    title = "callback invoked while holding a lock it may re-enter"
+    hint = ("collect the callback's arguments under the lock but invoke "
+            "it after release — or document why the subscriber can "
+            "never re-enter (pragma)")
+
+    semantic_scope = True
+
+    def check_model(self, model: ProjectModel) -> Iterator[Finding]:
+        may = _may_held(model)
+        for key, func in model.functions.items():
+            for op in func.ops:
+                if op.kind != "call":
+                    continue
+                cb = model.is_callback_field(func, op.chain)
+                if cb is None:
+                    continue
+                eff = may[key] | model.held_locks(func, op.held)
+                if not eff:
+                    continue
+                locks = ", ".join(sorted(_fmt_lock(x) for x in eff))
+                yield Finding(
+                    rule=self.id, severity=self.severity,
+                    path=func.ctx.path, line=op.node.lineno,
+                    col=op.node.col_offset + 1,
+                    message=f"{func.qual} invokes callback {cb} while "
+                            f"{locks} may be held — the subscriber can "
+                            f"re-enter and deadlock",
+                    hint=self.hint)

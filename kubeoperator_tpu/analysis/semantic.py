@@ -1,0 +1,851 @@
+"""Whole-program semantic model for ``ko lint`` (ISSUE 14).
+
+Per-file AST rules (KO1xx/KO2xx) see one module at a time; the bugs that
+survived PRs 11–13 cross files: the gateway dispatcher thread mutates
+batcher state, the autoscaler beat runs inside the task engine's timer
+thread, and a jit signature edited in one module silently invalidates
+the compile cache another module pins. This module builds one
+:class:`ProjectModel` over every parsed :class:`ModuleContext` so rules
+can ask *program*-level questions:
+
+- which class owns which locks (and their kinds — ``Lock`` vs the
+  reentrant ``RLock``/``Condition``),
+- what type each ``self.<attr>`` / annotated local holds, so calls like
+  ``self.batcher.drain()`` resolve to a method in another class,
+- which methods are **thread entrypoints** (``threading.Thread(target=
+  self._loop)``, ``Timer``, ``pool.submit(self._beat)``, and the task
+  engine's ``.every(interval, name, fn)`` beat registrations),
+- which lock chains are lexically held at every write / call / acquire
+  (the *ops* lists on :class:`FuncInfo`), feeding the interprocedural
+  reach analysis in ``rules_concurrency.py`` (KO301–KO303),
+- and the static **jit fingerprints** behind KO140: every
+  ``jax.jit(...)`` site's trace-relevant surface (static/donate args,
+  wrapped callable params, ``self.*`` config reads) hashed against the
+  checked-in ``analysis/signatures.json`` baseline so an edit that
+  would silently retrace fails lint with a field-level diff,
+  regenerable via ``ko lint --update-signatures``.
+
+Known analysis limits (deliberate, documented here rather than half
+fixed): no inheritance-based method resolution, no typing of tuple
+unpacking (``req, ev = item`` — the serving ``done``-event set escapes
+KO303), and containers are opaque (``for r in self._replicas`` leaves
+``r`` untyped). The rules err quiet on what the model cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from kubeoperator_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, register,
+)
+from kubeoperator_tpu.analysis.rules_control import _LOCK_TYPES, _lock_call
+
+#: (class, lock-attr) pair — one node in the lock-order graph
+LockNode = tuple[str, str]
+
+
+# ---------------------------------------------------------------------------
+# model dataclasses
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Op:
+    """One lock-relevant operation inside a function body: an attribute
+    write, a resolved-later call, a ``with``-acquire, or a callback
+    invocation. ``chain`` is the raw dotted access path, e.g.
+    ``("self", "batcher", "drain")``; ``held`` the lock *chains* of
+    every enclosing ``with`` item (resolved against var types later)."""
+
+    kind: str                       # "write" | "call" | "acquire"
+    chain: tuple[str, ...]
+    node: ast.AST
+    held: tuple[tuple[str, ...], ...]
+    args: tuple[ast.AST, ...] = ()
+
+
+@dataclass
+class FuncInfo:
+    """One function or method, flattened: nested defs/lambdas fold into
+    their owner so a worker loop's inner helper is analysed as part of
+    the loop."""
+
+    owner: str | None               # class name, or None for module level
+    name: str
+    node: ast.AST
+    ctx: ModuleContext
+    var_types: dict[str, str] = field(default_factory=dict)
+    ops: list[Op] = field(default_factory=list)
+
+    @property
+    def key(self) -> tuple[str | None, str]:
+        return (self.owner, self.name)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.owner}.{self.name}" if self.owner else self.name
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    ctx: ModuleContext
+    locks: dict[str, str] = field(default_factory=dict)   # attr -> kind
+    events: set[str] = field(default_factory=set)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    methods: dict[str, FuncInfo] = field(default_factory=dict)
+    #: attrs that look rebindable from outside — declared Callable,
+    #: ctor-initialized to None / a bare param, or written by another
+    #: class's code. Only these count as KO303 callback fields.
+    maybe_callbacks: set[str] = field(default_factory=set)
+    externally_bound: set[str] = field(default_factory=set)
+
+
+@dataclass
+class Entrypoint:
+    """A function some thread other than the caller's will run."""
+
+    func: tuple[str | None, str]    # FuncInfo key
+    via: str                        # "Thread" | "Timer" | "submit" | "beat"
+    node: ast.AST
+    path: str
+
+
+@dataclass
+class ProjectModel:
+    root: str | None
+    modules: dict[str, ModuleContext] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    functions: dict[tuple[str | None, str], FuncInfo] = \
+        field(default_factory=dict)
+    entrypoints: list[Entrypoint] = field(default_factory=list)
+
+    # -- resolution ---------------------------------------------------------
+    def type_of_chain(self, func: FuncInfo,
+                      chain: tuple[str, ...]) -> str | None:
+        """Class name the access path lands on, walking attr types:
+        ``("self", "batcher")`` -> ``ContinuousBatcher``. Returns None as
+        soon as a hop is untyped."""
+        if not chain:
+            return None
+        cur = func.var_types.get(chain[0])
+        for attr in chain[1:]:
+            if cur is None or cur not in self.classes:
+                return None
+            cur = self.classes[cur].attr_types.get(attr)
+        return cur
+
+    def lock_of_chain(self, func: FuncInfo,
+                      chain: tuple[str, ...]) -> LockNode | None:
+        """``("self", "_cond")`` -> ``("ContinuousBatcher", "_cond")``
+        when the final attr is a declared lock of the owner's class."""
+        if len(chain) < 2:
+            return None
+        owner = self.type_of_chain(func, chain[:-1])
+        if owner is None or owner not in self.classes:
+            return None
+        if chain[-1] in self.classes[owner].locks:
+            return (owner, chain[-1])
+        return None
+
+    def held_locks(self, func: FuncInfo,
+                   held_chains: tuple[tuple[str, ...], ...]
+                   ) -> frozenset[LockNode]:
+        out = set()
+        for chain in held_chains:
+            lock = self.lock_of_chain(func, chain)
+            if lock is not None:
+                out.add(lock)
+        return frozenset(out)
+
+    def resolve_call(self, func: FuncInfo,
+                     chain: tuple[str, ...]) -> FuncInfo | None:
+        """A call op's target FuncInfo, or None (builtin, untyped,
+        callback field...)."""
+        if len(chain) == 1:
+            return self.functions.get((None, chain[0]))
+        owner = self.type_of_chain(func, chain[:-1])
+        if owner is None or owner not in self.classes:
+            return None
+        return self.classes[owner].methods.get(chain[-1])
+
+    def is_callback_field(self, func: FuncInfo,
+                          chain: tuple[str, ...]) -> str | None:
+        """A call through ``<typed obj>.<attr>(...)`` where ``attr`` is a
+        *stored callback* — not a method/lock/event/typed sub-object,
+        and bindable from outside the class (Callable-annotated,
+        ctor-defaulted to None/a param, or assigned by foreign code,
+        like the batcher's ``requeue_sink``). Returns ``Class.attr``."""
+        if len(chain) < 2:
+            return None
+        owner = self.type_of_chain(func, chain[:-1])
+        if owner is None or owner not in self.classes:
+            return None
+        info = self.classes[owner]
+        attr = chain[-1]
+        if attr in info.methods or attr in info.locks or attr in info.events \
+                or attr in info.attr_types:
+            return None
+        if attr not in info.maybe_callbacks \
+                and attr not in info.externally_bound:
+            return None
+        return f"{owner}.{attr}"
+
+
+# ---------------------------------------------------------------------------
+# model construction
+# ---------------------------------------------------------------------------
+
+def build_model(modules: dict[str, ModuleContext],
+                root: str | None = None) -> ProjectModel:
+    model = ProjectModel(root=root, modules=dict(modules))
+    # pass 1: classes, their locks/events, and every function shell
+    for path, ctx in modules.items():
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                _collect_class(model, ctx, path, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FuncInfo(owner=None, name=node.name, node=node,
+                                ctx=ctx)
+                model.functions.setdefault(info.key, info)
+    # pass 2 (needs the full class table): attr types, var types, ops
+    for info in model.functions.values():
+        _collect_func_body(model, info)
+    for cls in model.classes.values():
+        _collect_attr_types(model, cls)
+        _collect_callback_fields(cls)
+    # pass 3: thread entrypoints and cross-class attribute bindings
+    # (need ops + var types everywhere)
+    for info in model.functions.values():
+        _collect_entrypoints(model, info)
+        for op in info.ops:
+            if op.kind != "write" or len(op.chain) < 2:
+                continue
+            owner = model.type_of_chain(info, op.chain[:-1])
+            if owner in model.classes and owner != info.owner:
+                model.classes[owner].externally_bound.add(op.chain[-1])
+    return model
+
+
+def _collect_class(model: ProjectModel, ctx: ModuleContext, path: str,
+                   node: ast.ClassDef) -> None:
+    if node.name in model.classes:       # first definition wins
+        return
+    cls = ClassInfo(name=node.name, path=path, node=node, ctx=ctx)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and _lock_call(ctx, sub.value):
+            kind = _lock_kind(ctx, sub.value)
+            for t in sub.targets:
+                attr = _self_or_class_attr(t)
+                if attr:
+                    cls.locks[attr] = kind
+        elif isinstance(sub, ast.AnnAssign) and sub.value is not None \
+                and _lock_call(ctx, sub.value):
+            attr = _self_or_class_attr(sub.target)
+            if attr:
+                cls.locks[attr] = _lock_kind(ctx, sub.value)
+        elif isinstance(sub, ast.Assign) and _event_call(ctx, sub.value):
+            for t in sub.targets:
+                attr = _self_or_class_attr(t)
+                if attr:
+                    cls.events.add(attr)
+        elif isinstance(sub, ast.AnnAssign) and sub.value is not None \
+                and _event_call(ctx, sub.value):
+            attr = _self_or_class_attr(sub.target)
+            if attr:
+                cls.events.add(attr)
+    for meth in node.body:
+        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = FuncInfo(owner=node.name, name=meth.name, node=meth,
+                            ctx=ctx)
+            cls.methods[meth.name] = info
+            model.functions[info.key] = info
+    model.classes[node.name] = cls
+
+
+def _lock_kind(ctx: ModuleContext, value: ast.AST) -> str:
+    name = ctx.dotted(value.func) if isinstance(value, ast.Call) else None
+    if name in _LOCK_TYPES:
+        return name.rsplit(".", 1)[1]
+    if isinstance(value, ast.Call):      # field(default_factory=...)
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                inner = ctx.dotted(kw.value)
+                if inner in _LOCK_TYPES:
+                    return inner.rsplit(".", 1)[1]
+    return "Lock"
+
+
+def _event_call(ctx: ModuleContext, value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    if ctx.dotted(value.func) == "threading.Event":
+        return True
+    for kw in value.keywords:
+        if kw.arg == "default_factory" \
+                and ctx.dotted(kw.value) == "threading.Event":
+            return True
+    return False
+
+
+def _self_or_class_attr(t: ast.AST) -> str | None:
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return t.attr
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+def _ann_class(ctx: ModuleContext, ann: ast.AST | None) -> str | None:
+    """An annotation expression -> simple class name (last dotted part),
+    peeling Optional/string quoting where cheap."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):   # Optional[X] / list[X] — only the
+        name = ctx.dotted(ann.value)     # Optional wrapper is transparent
+        if name and name.rsplit(".", 1)[-1] == "Optional":
+            return _ann_class(ctx, ann.slice)
+        return None
+    name = ctx.dotted(ann)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _collect_attr_types(model: ProjectModel, cls: ClassInfo) -> None:
+    """self.<attr> -> class name, from ``self.x = ClassName(...)``,
+    annotated assigns, and ``self.x = <param>`` with an annotated param."""
+    ctx = cls.ctx
+    for node in ast.walk(cls.node):
+        if isinstance(node, ast.AnnAssign):
+            attr = _self_or_class_attr(node.target)
+            typ = _ann_class(ctx, node.annotation)
+            if attr and typ in model.classes and attr not in cls.locks:
+                cls.attr_types.setdefault(attr, typ)
+    for meth in cls.methods.values():
+        params = _param_types(model, ctx, meth.node)
+        for node in ast.walk(meth.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                typ = _value_type(model, ctx, node.value, params)
+                if typ is not None and t.attr not in cls.locks:
+                    cls.attr_types.setdefault(t.attr, typ)
+
+
+def _collect_callback_fields(cls: ClassInfo) -> None:
+    """Attrs plausibly holding an externally-supplied callable:
+    ``Callable``-annotated class fields, and ctor assigns of ``None`` or
+    a bare (untyped) parameter that is later *called* — the call-site
+    filter in :meth:`ProjectModel.is_callback_field` does the rest."""
+    for node in cls.node.body:
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and "Callable" in ast.unparse(node.annotation):
+            cls.maybe_callbacks.add(node.target.id)
+    for name in _CTOR_METHODS_LOCAL:
+        meth = cls.methods.get(name)
+        if meth is None:
+            continue
+        a = meth.node.args
+        param_names = {p.arg for p in list(a.posonlyargs) + list(a.args)
+                       + list(a.kwonlyargs)} - {"self"}
+        for node in ast.walk(meth.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            is_none = isinstance(node.value, ast.Constant) \
+                and node.value.value is None
+            is_param = isinstance(node.value, ast.Name) \
+                and node.value.id in param_names
+            if not (is_none or is_param):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    cls.maybe_callbacks.add(t.attr)
+
+
+_CTOR_METHODS_LOCAL = ("__init__", "__post_init__")
+
+
+def _param_types(model: ProjectModel, ctx: ModuleContext,
+                 fn: ast.AST) -> dict[str, str]:
+    out: dict[str, str] = {}
+    args = fn.args
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        typ = _ann_class(ctx, a.annotation)
+        if typ in model.classes:
+            out[a.arg] = typ
+    return out
+
+
+def _value_type(model: ProjectModel, ctx: ModuleContext, value: ast.AST,
+                params: dict[str, str]) -> str | None:
+    """RHS expression -> known class name (constructor call, annotated
+    param, or either arm of a conditional expression)."""
+    if isinstance(value, ast.IfExp):
+        return (_value_type(model, ctx, value.body, params)
+                or _value_type(model, ctx, value.orelse, params))
+    if isinstance(value, ast.Call):
+        name = ctx.dotted(value.func)
+        if name:
+            simple = name.rsplit(".", 1)[-1]
+            if simple in model.classes:
+                return simple
+    if isinstance(value, ast.Name):
+        return params.get(value.id)
+    return None
+
+
+# -- function bodies: var types, held-lock chains, ops ----------------------
+
+def _access_chain(node: ast.AST) -> tuple[str, ...] | None:
+    """``self.batcher.drain`` -> ("self","batcher","drain"); None when the
+    root is not a plain name (calls/subscripts break the chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return tuple(reversed(parts))
+
+
+def _collect_func_body(model: ProjectModel, info: FuncInfo) -> None:
+    ctx = info.ctx
+    info.var_types = _param_types(model, ctx, info.node)
+    if info.owner:
+        info.var_types["self"] = info.owner
+    # locals bound to a constructor / typed value or aliasing self.<attr>
+    alias: dict[str, tuple[str, ...]] = {}       # local -> chain it aliases
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            typ = _value_type(model, ctx, node.value, info.var_types)
+            if typ is not None:
+                info.var_types.setdefault(name, typ)
+            chain = _access_chain(node.value)
+            if chain is not None and len(chain) > 1:
+                alias.setdefault(name, chain)
+    held = _held_map(info.node)
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                chain = _store_chain(t, alias)
+                if chain is not None:
+                    info.ops.append(Op("write", chain, node,
+                                       held.get(node, ())))
+        if isinstance(node, ast.Call):
+            chain = _access_chain(node.func)
+            if chain is not None:
+                if chain[0] in alias:
+                    chain = alias[chain[0]] + chain[1:]
+                info.ops.append(Op("call", chain, node, held.get(node, ()),
+                                   tuple(node.args)))
+        if isinstance(node, ast.With):
+            for item in node.items:
+                chain = _access_chain(item.context_expr)
+                if chain is not None:
+                    if chain[0] in alias:
+                        chain = alias[chain[0]] + chain[1:]
+                    info.ops.append(Op("acquire", chain, item.context_expr,
+                                       held.get(node, ())))
+
+
+def _store_chain(target: ast.AST,
+                 alias: dict[str, tuple[str, ...]]) -> tuple[str, ...] | None:
+    """Store-root chain of an assignment target: ``self.x``,
+    ``self.x[i]`` and tuple elements all count; ``f().x`` does not."""
+    nodes = target.elts \
+        if isinstance(target, (ast.Tuple, ast.List)) else [target]
+    for node in nodes:
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        chain = _access_chain(node)
+        if chain is not None and len(chain) > 1:
+            if chain[0] in alias:
+                chain = alias[chain[0]] + chain[1:]
+            return chain
+    return None
+
+
+def _held_map(fn: ast.AST) -> dict[ast.AST, tuple[tuple[str, ...], ...]]:
+    """node -> chains of every enclosing ``with`` item, computed in one
+    downward pass (nested defs inherit the enclosing held set — a worker
+    closure defined under a lock runs under it only at def site, but the
+    repo's nested defs are immediately-registered callbacks, so folding
+    them in errs on the conservative side)."""
+    out: dict[ast.AST, tuple[tuple[str, ...], ...]] = {}
+
+    def walk(node: ast.AST, held: tuple[tuple[str, ...], ...]) -> None:
+        out[node] = held
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                chain = _access_chain(item.context_expr)
+                if chain is not None:
+                    inner = inner + (chain,)
+            for child in ast.iter_child_nodes(node):
+                walk(child, inner if child in node.body else held)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(fn, ())
+    return out
+
+
+# -- thread entrypoints -----------------------------------------------------
+
+_THREAD_CTORS = {"threading.Thread": "Thread", "threading.Timer": "Timer"}
+
+
+def _collect_entrypoints(model: ProjectModel, info: FuncInfo) -> None:
+    ctx = info.ctx
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.dotted(node.func)
+        if name in _THREAD_CTORS:
+            target = _kw(node, "target")
+            if target is None and name == "threading.Timer" \
+                    and len(node.args) >= 2:
+                target = node.args[1]
+            if target is None and name == "threading.Thread" and node.args:
+                target = node.args[0]
+            _note_target(model, info, target, _THREAD_CTORS[name], node)
+            continue
+        chain = _access_chain(node.func)
+        if chain and chain[-1] == "submit" and node.args:
+            _note_target(model, info, node.args[0], "submit", node)
+        elif chain and chain[-1] == "every" and len(node.args) >= 3:
+            _note_target(model, info, node.args[2], "beat", node)
+
+
+def _kw(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _note_target(model: ProjectModel, info: FuncInfo,
+                 target: ast.AST | None, via: str, site: ast.Call) -> None:
+    if target is None:
+        return
+    if isinstance(target, ast.Lambda):
+        # beat idiom: every(i, "name", lambda: autoscale_tick(platform))
+        for sub in ast.walk(target.body):
+            if isinstance(sub, ast.Call):
+                _note_target(model, info, sub.func, via, site)
+        return
+    chain = _access_chain(target)
+    if chain is None:
+        return
+    if len(chain) == 1:
+        fn = model.functions.get((None, chain[0]))
+        # a local nested def folds into its owner — already analysed
+        if fn is not None:
+            model.entrypoints.append(Entrypoint(
+                func=fn.key, via=via, node=site, path=info.ctx.path))
+        return
+    owner = model.type_of_chain(info, chain[:-1])
+    if owner in model.classes \
+            and chain[-1] in model.classes[owner].methods:
+        model.entrypoints.append(Entrypoint(
+            func=(owner, chain[-1]), via=via, node=site,
+            path=info.ctx.path))
+
+
+# ---------------------------------------------------------------------------
+# jit fingerprints (KO140)
+# ---------------------------------------------------------------------------
+
+SIGNATURE_BASENAME = "signatures.json"
+
+
+def signature_baseline_path(root: str) -> str:
+    """Prefer an existing baseline, then an existing analysis/ dir;
+    fresh projects fall back to ``<root>/analysis/signatures.json``
+    (created on ``--update-signatures``)."""
+    dirs = (os.path.join("kubeoperator_tpu", "analysis"), "analysis")
+    for rel in dirs:
+        p = os.path.join(root, rel, SIGNATURE_BASENAME)
+        if os.path.exists(p):
+            return p
+    for rel in dirs:
+        if os.path.isdir(os.path.join(root, rel)):
+            return os.path.join(root, rel, SIGNATURE_BASENAME)
+    return os.path.join(root, "analysis", SIGNATURE_BASENAME)
+
+
+def _unparse(node: ast.AST | None) -> str | None:
+    return None if node is None else ast.unparse(node)
+
+
+def jit_fingerprints(model: ProjectModel) -> dict[str, dict]:
+    """key ``file::qualname::function`` -> trace-signature fingerprint.
+    ``line`` is carried for anchoring but excluded from comparison — an
+    edit above a jit site must not read as drift."""
+    out: dict[str, dict] = {}
+    for path, ctx in sorted(model.modules.items()):
+        rel = _relpath(model, path)
+        for site in _iter_jit_sites(ctx):
+            fp = _fingerprint(model, ctx, rel, site)
+            key = f"{rel}::{fp['qualname']}::{fp['function']}"
+            n, base = 1, key
+            while key in out:
+                n += 1
+                key = f"{base}#{n}"
+            out[key] = fp
+    return out
+
+
+def _relpath(model: ProjectModel, path: str) -> str:
+    if model.root:
+        try:
+            return os.path.relpath(os.path.abspath(path),
+                                   model.root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return os.path.basename(path)
+
+
+@dataclass
+class _JitSite:
+    call: ast.Call | None     # the jax.jit(...) call (None for bare @jax.jit)
+    node: ast.AST             # anchor node for findings
+    wrapped: ast.AST | None   # expression naming the traced callable
+    fn_def: ast.AST | None    # resolved def of the traced callable
+    qualname: str
+    function: str
+
+
+def _iter_jit_sites(ctx: ModuleContext) -> Iterator[_JitSite]:
+    """Every ``jax.jit`` application in the module, whatever the form:
+    assignment, ``return jax.jit(...)``, immediately-invoked
+    ``jax.jit(f)(x)``, passed as an argument, or used as a (bare or
+    parameterised) decorator."""
+    if not ctx.has_jax:
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and ctx.dotted(node.func) == "jax.jit":
+            wrapped = node.args[0] if node.args else None
+            fn_def, fn_name = _resolve_wrapped(ctx, node, wrapped)
+            yield _JitSite(call=node, node=node, wrapped=wrapped,
+                           fn_def=fn_def, qualname=_qualname(ctx, node),
+                           function=fn_name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                # bare `@jax.jit` only — `@jax.jit(...)` is a Call,
+                # already yielded by the branch above
+                if not isinstance(deco, ast.Call) \
+                        and ctx.dotted(deco) == "jax.jit":
+                    yield _JitSite(
+                        call=None, node=deco, wrapped=None, fn_def=node,
+                        qualname=_qualname(ctx, node), function=node.name)
+
+
+def _qualname(ctx: ModuleContext, node: ast.AST) -> str:
+    parts: list[str] = []
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = ctx.parent(cur)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def _resolve_wrapped(ctx: ModuleContext, site: ast.AST,
+                     wrapped: ast.AST | None) -> tuple[ast.AST | None, str]:
+    """The def of the callable handed to jax.jit, looked up lexically:
+    ``self._segment_body`` -> the method in the enclosing class; a bare
+    name -> a def in the enclosing function or at module level."""
+    if wrapped is None:
+        return None, "<unknown>"
+    if isinstance(wrapped, ast.Lambda):
+        return wrapped, "<lambda>"
+    chain = _access_chain(wrapped)
+    if chain is None:
+        return None, ast.unparse(wrapped)
+    name = chain[-1]
+    if chain[0] == "self":
+        cur = ctx.parent(site)
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = ctx.parent(cur)
+        if cur is not None:
+            for meth in cur.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef))\
+                        and meth.name == name:
+                    return meth, name
+        return None, name
+    scope = ctx.enclosing_function(site)
+    for pool in ([scope] if scope is not None else []) + [ctx.tree]:
+        for sub in ast.walk(pool):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name == name:
+                return sub, name
+    return None, name
+
+
+def _fingerprint(model: ProjectModel, ctx: ModuleContext, rel: str,
+                 site: _JitSite) -> dict:
+    kwargs: dict[str, str] = {}
+    donate = static_nums = static_names = None
+    if site.call is not None:
+        for kw in site.call.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _unparse(kw.value)
+            elif kw.arg == "static_argnums":
+                static_nums = _unparse(kw.value)
+            elif kw.arg == "static_argnames":
+                static_names = _unparse(kw.value)
+            elif kw.arg is not None:
+                kwargs[kw.arg] = _unparse(kw.value)
+            else:                      # **extra — shape-relevant, record it
+                kwargs["**"] = _unparse(kw.value)
+    arg_names: list[str] = []
+    trace_deps: list[str] = []
+    if site.fn_def is not None:
+        a = site.fn_def.args
+        arg_names = [p.arg for p in
+                     list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                     if p.arg != "self"]
+        deps = set()
+        body = site.fn_def.body
+        for stmt in (body if isinstance(body, list) else [body]):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Load):
+                    chain = _access_chain(sub)
+                    if chain and chain[0] == "self":
+                        deps.add(".".join(chain))
+        trace_deps = sorted(deps)
+    return {
+        "file": rel,
+        "qualname": site.qualname,
+        "function": site.function,
+        "donate_argnums": donate,
+        "static_argnums": static_nums,
+        "static_argnames": static_names,
+        "jit_kwargs": dict(sorted(kwargs.items())),
+        "arg_names": arg_names,
+        "trace_deps": trace_deps,
+        "line": site.node.lineno,
+    }
+
+
+_COMPARED_FIELDS = ("function", "donate_argnums", "static_argnums",
+                    "static_argnames", "jit_kwargs", "arg_names",
+                    "trace_deps")
+
+
+def load_baseline(path: str) -> dict[str, dict] | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return doc.get("signatures", {})
+
+
+def write_baseline(path: str, fingerprints: dict[str, dict]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {"version": 1,
+           "comment": "jit trace-signature baseline — regenerate with "
+                      "`ko lint --update-signatures` (KO140)",
+           "signatures": {k: fingerprints[k] for k in sorted(fingerprints)}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def update_signatures(root: str, model: ProjectModel) -> str:
+    path = signature_baseline_path(root)
+    write_baseline(path, jit_fingerprints(model))
+    return path
+
+
+@register
+class JitSignatureDrift(Rule):
+    """KO140 — a jit site's statically-derived trace signature no longer
+    matches the checked-in ``analysis/signatures.json`` baseline. Any
+    such drift silently retraces at runtime and will invalidate the
+    planned AOT compile cache; the baseline makes the change explicit
+    and reviewable."""
+
+    id = "KO140"
+    severity = "error"
+    title = "jit trace-signature drift vs checked-in baseline"
+    hint = ("if the new signature is intended, regenerate the baseline "
+            "with `ko lint --update-signatures` and commit the diff")
+
+    project_scope = True    # needs the repo root; exempt from per-module runs
+
+    def check_project(self, model: ProjectModel) -> Iterator[Finding]:
+        if model.root is None:
+            return
+        current = jit_fingerprints(model)
+        base_path = signature_baseline_path(model.root)
+        baseline = load_baseline(base_path)
+        rel_base = os.path.relpath(base_path, model.root)
+        if baseline is None:
+            if current:
+                first = min(current.values(), key=lambda f: (f["file"],
+                                                             f["line"]))
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=rel_base,
+                    line=1, col=1,
+                    message=f"{len(current)} jit site(s) found but no "
+                            f"signature baseline exists at {rel_base}",
+                    hint=self.hint + f" (first site: {first['file']}:"
+                                     f"{first['line']})")
+            return
+        for key in sorted(set(current) | set(baseline)):
+            cur, base = current.get(key), baseline.get(key)
+            if cur is None:
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=rel_base,
+                    line=1, col=1,
+                    message=f"jit site {key!r} is in the signature "
+                            f"baseline but no longer in the tree",
+                    hint=self.hint)
+                continue
+            if base is None:
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=cur["file"],
+                    line=cur["line"], col=1,
+                    message=f"new jit site {key!r} is not in the "
+                            f"signature baseline",
+                    hint=self.hint)
+                continue
+            drift = [f for f in _COMPARED_FIELDS if cur[f] != base[f]]
+            if drift:
+                diff = "; ".join(
+                    f"{f}: {base[f]!r} -> {cur[f]!r}" for f in drift)
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=cur["file"],
+                    line=cur["line"], col=1,
+                    message=f"jit trace signature of {key!r} drifted from "
+                            f"the baseline ({diff})",
+                    hint=self.hint)

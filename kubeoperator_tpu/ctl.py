@@ -330,6 +330,14 @@ def cmd_lint(args) -> int:
         argv.append("--no-project")
     if args.list_rules:
         argv.append("--list-rules")
+    if args.changed:
+        argv.append("--changed")
+    if args.since:
+        argv += ["--since", args.since]
+    if args.update_signatures:
+        argv.append("--update-signatures")
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
     argv += ["--fail-level", args.fail_level]
     for sel in args.select or ():
         argv += ["--select", sel]
@@ -444,6 +452,14 @@ def build_parser(sub) -> None:
     lint.add_argument("--no-project", action="store_true",
                       help="skip README/catalog project checks")
     lint.add_argument("--list-rules", action="store_true")
+    lint.add_argument("--changed", action="store_true",
+                      help="report only findings in files changed vs HEAD")
+    lint.add_argument("--since", default=None, metavar="REV",
+                      help="report only findings in files changed since REV")
+    lint.add_argument("--update-signatures", action="store_true",
+                      help="regenerate the KO140 jit signature baseline")
+    lint.add_argument("--baseline", default=None, metavar="PATH",
+                      help="previous --json report; only new findings fail")
     lint.set_defaults(fn=cmd_lint)
 
     scen = sub.add_parser(

@@ -3,8 +3,22 @@
 # (default: warning). Tier-1's self-clean assertion (tests/test_lint.py)
 # and this script invoke the same engine — one gate, two entry points.
 #
+# When a JSON baseline exists (scripts/lint_baseline.json, or the path
+# in $LINT_BASELINE), the gate compares against it: pre-existing
+# findings are tolerated with a warning, only NEW findings fail — so
+# the gate can be adopted mid-stream without a flag-day. Regenerate the
+# baseline with:
+#
+#   python -m kubeoperator_tpu.analysis.cli kubeoperator_tpu --json \
+#       > scripts/lint_baseline.json || true
+#
 #   scripts/lint_gate.sh                 # lint kubeoperator_tpu/
 #   scripts/lint_gate.sh path --json     # any ko-lint arguments pass through
 set -euo pipefail
 cd "$(dirname "$0")/.."
+BASELINE="${LINT_BASELINE:-scripts/lint_baseline.json}"
+if [[ -f "$BASELINE" ]]; then
+    exec python -m kubeoperator_tpu.analysis.cli \
+        --baseline "$BASELINE" "${@:-kubeoperator_tpu}"
+fi
 exec python -m kubeoperator_tpu.analysis.cli "${@:-kubeoperator_tpu}"

@@ -1,0 +1,18 @@
+"""KO303: a stored callback (ctor-injected, like the batcher's
+``requeue_sink``) invoked while the class's lock is held — whoever
+subscribed can call back into this object and re-enter the lock."""
+
+import threading
+
+
+class Notifier:
+    def __init__(self, on_done=None):
+        self._lock = threading.Lock()
+        self.on_done = on_done
+        self.fired = 0
+
+    def fire(self):
+        with self._lock:
+            self.fired += 1
+            if self.on_done is not None:
+                self.on_done(self.fired)

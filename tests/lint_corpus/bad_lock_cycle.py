@@ -1,0 +1,35 @@
+"""KO302: the classic two-class ABBA deadlock. ``Accounts.transfer``
+takes Accounts._lock then calls into the ledger, which takes
+Ledger._lock; ``Ledger.record`` takes Ledger._lock then calls back into
+accounts, which takes Accounts._lock. Two threads running one each
+deadlock."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = []
+
+    def audit(self):
+        with self._lock:
+            return len(self.entries)
+
+    def record(self, accounts: "Accounts"):
+        with self._lock:
+            accounts.balance_locked()
+
+
+class Accounts:
+    def __init__(self, ledger: Ledger):
+        self._lock = threading.Lock()
+        self.ledger = ledger
+
+    def transfer(self):
+        with self._lock:
+            self.ledger.audit()
+
+    def balance_locked(self):
+        with self._lock:
+            return 0

@@ -1,0 +1,20 @@
+"""KO301 (and its lexical ancestor KO201): a worker thread reaches a
+shared-attribute write without ever taking the class's declared lock.
+The write sits two calls away from the ``Thread(target=...)`` — only
+the interprocedural pass sees the unlocked path."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        self.count += 1

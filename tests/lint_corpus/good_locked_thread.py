@@ -1,0 +1,25 @@
+"""Positive fixture: clean under the interprocedural lock analysis.
+The worker loop takes the lock and the helper it calls writes under it
+— KO301 walks the path and exonerates ``_bump`` even though the write
+is lexically lock-free. The per-file KO201 cannot see the caller's
+``with``, so its lexical limit is documented with a pragma."""
+
+import threading
+
+
+class LockedWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.count += 1
+                self._bump()
+
+    def _bump(self):
+        # ko: lint-ok[KO201] caller holds _lock: _bump is only ever called from _loop's with block (KO301 proves it program-wide)
+        self.total += 1
