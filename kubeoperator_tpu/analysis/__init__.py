@@ -15,7 +15,7 @@ stay a subset of the static baseline.
 """
 
 from kubeoperator_tpu.analysis.compile_guard import (
-    CompileCountGuard, compile_count_guard,
+    CompileCountGuard, active_guard, compile_count_guard,
 )
 from kubeoperator_tpu.analysis.core import (
     Finding, LintResult, RULES, SEVERITIES, lint_file, lint_paths,
@@ -26,6 +26,7 @@ from kubeoperator_tpu.analysis import (  # noqa: F401  (rule registration)
 )
 
 __all__ = [
-    "CompileCountGuard", "compile_count_guard", "Finding", "LintResult",
+    "CompileCountGuard", "active_guard", "compile_count_guard", "Finding",
+    "LintResult",
     "RULES", "SEVERITIES", "lint_file", "lint_paths", "severity_at_least",
 ]

@@ -114,6 +114,30 @@ class CompileCountGuard:
             slot["traces"] += c
         return out
 
+    def record_aot_compile(self, name: str, args: tuple = (),
+                           kwargs: dict | None = None) -> None:
+        """The AOT cache's miss path compiled out-of-band: its
+        ``.lower().compile()`` goes through :attr:`_ko_jitted` and never
+        runs the traced wrapper, so the guard would miss it. The cache
+        reports the compile here as one ordinary trace event — cold
+        bring-up therefore still fails :meth:`assert_zero_compiles`, and
+        the serving batcher's compile-event accounting stays honest."""
+        sig = self._signature(name, tuple(args), dict(kwargs or {}))
+        self.counts[sig] = self.counts.get(sig, 0) + 1
+
+    def assert_zero_compiles(self, name: str | None = None) -> None:
+        """Raise if *anything* traced or compiled — the warm bring-up
+        contract: a worker constructed against a populated AOT cache must
+        load executables, not build them. (assert_single_compile pins the
+        cold path to 1 per signature; this pins the warm path to 0.)"""
+        bad = [(n, c) for (n, _, _), c in sorted(self.counts.items())
+               if c and (name is None or n == name)]
+        if bad:
+            detail = ", ".join(f"{n}×{c}" for n, c in bad)
+            raise AssertionError(
+                f"warm bring-up compiled — expected zero trace events, "
+                f"got: {detail}")
+
     def assert_single_compile(self, name: str | None = None) -> None:
         """Raise if any (function, shape signature) traced more than once
         — i.e. a retrace happened for a shape that was already compiled."""
@@ -161,3 +185,14 @@ def compile_count_guard() -> CompileCountGuard:
     """``with compile_count_guard() as guard: ...`` — see the module
     docstring."""
     return CompileCountGuard()
+
+
+def active_guard() -> CompileCountGuard | None:
+    """The guard currently patching ``jax.jit``, if any. ``_counting_jit``
+    is a bound method, so while a guard is active ``jax.jit.__self__`` is
+    that guard — this is how the AOT cache's miss path finds whom to
+    report its out-of-band compile to."""
+    import jax
+
+    owner = getattr(jax.jit, "__self__", None)
+    return owner if isinstance(owner, CompileCountGuard) else None

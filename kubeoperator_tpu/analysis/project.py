@@ -34,10 +34,11 @@ _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
 #: README sections whose metric tables must equal the registry
 _TABLE_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
-                   "## Scenario replay")
+                   "## Scenario replay", "## AOT compile cache")
 #: README sections whose inline ko_* mentions must be registered
 _MENTION_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
-                     "## Scheduling", "## Scenario replay")
+                     "## Scheduling", "## Scenario replay",
+                     "## AOT compile cache")
 
 
 class ProjectRule(Rule):

@@ -653,3 +653,37 @@ class PagedPoolWriteBypass(Rule):
                 and "pool" in node.id.lower():
             return node.id
         return None
+
+
+@register
+class OpaqueJitCallable(Rule):
+    """KO141 — ``jax.jit`` applied to a callable expression the KO140
+    fingerprint cannot resolve to a def: a factory call's return value,
+    a name bound by assignment, a cross-module attribute. For resolvable
+    defs the fingerprint records the full trace-dependency surface —
+    transitive ``self.*`` reads and enclosing-scope closure captures —
+    so any drift rolls the AOT compile-artifact cache key via the KO140
+    baseline. An opaque callable's deps are invisible: its captured
+    values can change while the cache key stays put, and a warm worker
+    would load a stale executable."""
+
+    id = "KO141"
+    severity = "warning"
+    title = "jit callable opaque to the KO140 fingerprint (stale AOT artifact risk)"
+    hint = ("jit a def the fingerprint can resolve — wrap the factory "
+            "result in a named function or pass the captured deps as "
+            "traced arguments; pragma with a reason only if the site "
+            "never enters the AOT cache")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from kubeoperator_tpu.analysis.semantic import _iter_jit_sites
+
+        for site in _iter_jit_sites(ctx):
+            if site.wrapped is None or site.fn_def is not None:
+                continue
+            yield self.finding(
+                ctx, site.node,
+                f"jax.jit({ast.unparse(site.wrapped)}): the traced "
+                f"callable's trace deps and closure captures are "
+                f"invisible to the KO140 fingerprint, so the AOT cache "
+                f"key cannot see them drift")

@@ -709,6 +709,110 @@ def _resolve_wrapped(ctx: ModuleContext, site: ast.AST,
     return None, name
 
 
+def _enclosing_class(ctx: ModuleContext, node: ast.AST) -> ast.ClassDef | None:
+    cur = ctx.parent(node)
+    while cur is not None and not isinstance(cur, ast.ClassDef):
+        cur = ctx.parent(cur)
+    return cur
+
+
+def _fn_body(fn: ast.AST) -> list[ast.AST]:
+    body = fn.body
+    return body if isinstance(body, list) else [body]   # Lambda: one expr
+
+
+def transitive_self_deps(ctx: ModuleContext, site: _JitSite) -> list[str]:
+    """Every ``self.*`` the traced callable reads, *including* reads
+    inside same-class methods it reaches (``self._micro_step`` as a scan
+    body, direct ``self._helper(...)`` calls, ...) — the full set of
+    instance state the trace depends on, which the AOT cache key must see
+    change (KO141)."""
+    fn = site.fn_def
+    if fn is None:
+        return []
+    cls = (_enclosing_class(ctx, fn)
+           if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+           else None) or _enclosing_class(ctx, site.node)
+    methods: dict[str, ast.AST] = {}
+    if cls is not None:
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    deps: set[str] = set()
+    visited: set[str] = set()
+    stack: list[ast.AST] = [fn]
+    while stack:
+        for stmt in _fn_body(stack.pop()):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Load):
+                    chain = _access_chain(sub)
+                    if chain and chain[0] == "self":
+                        deps.add(".".join(chain))
+                        # recurse into any same-class method the body
+                        # references through self — called directly or
+                        # handed to scan/vmap as a callable
+                        if len(chain) == 2 and chain[1] in methods \
+                                and chain[1] not in visited:
+                            visited.add(chain[1])
+                            stack.append(methods[chain[1]])
+    return sorted(deps)
+
+
+def closure_deps(ctx: ModuleContext, site: _JitSite) -> list[str]:
+    """Enclosing-scope *variables* the traced callable closes over —
+    free names of the def/lambda that are parameters or assigned names of
+    an enclosing function. Imports, nested defs and module globals are
+    excluded (stable code objects, not captured values): the point is to
+    fingerprint the data a trace bakes in, e.g. the fsdp step closing
+    over ``args`` (its ``args.lr`` is a real trace constant)."""
+    fn = site.fn_def
+    if fn is None:
+        return []
+    a = fn.args
+    bound = {p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            bound.add(extra.arg)
+    loaded: set[str] = set()
+    for stmt in _fn_body(fn):
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name):
+                if isinstance(sub.ctx, ast.Load):
+                    loaded.add(sub.id)
+                else:               # any Store/Del makes the name local
+                    bound.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                bound.add(sub.name)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for alias in sub.names:
+                    bound.add((alias.asname or alias.name).split(".")[0])
+    free = loaded - bound - {"self"}
+    if not free:
+        return []
+    outer: set[str] = set()
+    cur = ctx.parent(fn if isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                     else site.node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ca = cur.args
+            outer |= {p.arg for p in
+                      list(ca.posonlyargs) + list(ca.args)
+                      + list(ca.kwonlyargs)}
+            for extra in (ca.vararg, ca.kwarg):
+                if extra is not None:
+                    outer.add(extra.arg)
+            for s in cur.body:
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Name) \
+                            and isinstance(sub.ctx, ast.Store):
+                        outer.add(sub.id)
+        cur = ctx.parent(cur)
+    return sorted(free & outer)
+
+
 def _fingerprint(model: ProjectModel, ctx: ModuleContext, rel: str,
                  site: _JitSite) -> dict:
     kwargs: dict[str, str] = {}
@@ -726,22 +830,11 @@ def _fingerprint(model: ProjectModel, ctx: ModuleContext, rel: str,
             else:                      # **extra — shape-relevant, record it
                 kwargs["**"] = _unparse(kw.value)
     arg_names: list[str] = []
-    trace_deps: list[str] = []
     if site.fn_def is not None:
         a = site.fn_def.args
         arg_names = [p.arg for p in
                      list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
                      if p.arg != "self"]
-        deps = set()
-        body = site.fn_def.body
-        for stmt in (body if isinstance(body, list) else [body]):
-            for sub in ast.walk(stmt):
-                if isinstance(sub, ast.Attribute) \
-                        and isinstance(sub.ctx, ast.Load):
-                    chain = _access_chain(sub)
-                    if chain and chain[0] == "self":
-                        deps.add(".".join(chain))
-        trace_deps = sorted(deps)
     return {
         "file": rel,
         "qualname": site.qualname,
@@ -751,14 +844,15 @@ def _fingerprint(model: ProjectModel, ctx: ModuleContext, rel: str,
         "static_argnames": static_names,
         "jit_kwargs": dict(sorted(kwargs.items())),
         "arg_names": arg_names,
-        "trace_deps": trace_deps,
+        "trace_deps": transitive_self_deps(ctx, site),
+        "closure_deps": closure_deps(ctx, site),
         "line": site.node.lineno,
     }
 
 
 _COMPARED_FIELDS = ("function", "donate_argnums", "static_argnums",
                     "static_argnames", "jit_kwargs", "arg_names",
-                    "trace_deps")
+                    "trace_deps", "closure_deps")
 
 
 def load_baseline(path: str) -> dict[str, dict] | None:
@@ -790,9 +884,11 @@ def update_signatures(root: str, model: ProjectModel) -> str:
 class JitSignatureDrift(Rule):
     """KO140 — a jit site's statically-derived trace signature no longer
     matches the checked-in ``analysis/signatures.json`` baseline. Any
-    such drift silently retraces at runtime and will invalidate the
-    planned AOT compile cache; the baseline makes the change explicit
-    and reviewable."""
+    such drift silently retraces at runtime and rolls the AOT
+    compile-artifact cache key (``aot/cache.py`` folds the baseline
+    entry into ``CacheKey``, so a drifted-but-uncommitted baseline would
+    serve stale executables); the baseline makes the change explicit and
+    reviewable."""
 
     id = "KO140"
     severity = "error"
@@ -839,10 +935,11 @@ class JitSignatureDrift(Rule):
                             f"signature baseline",
                     hint=self.hint)
                 continue
-            drift = [f for f in _COMPARED_FIELDS if cur[f] != base[f]]
+            drift = [f for f in _COMPARED_FIELDS
+                     if cur.get(f) != base.get(f)]
             if drift:
                 diff = "; ".join(
-                    f"{f}: {base[f]!r} -> {cur[f]!r}" for f in drift)
+                    f"{f}: {base.get(f)!r} -> {cur.get(f)!r}" for f in drift)
                 yield Finding(
                     rule=self.id, severity=self.severity, path=cur["file"],
                     line=cur["line"], col=1,

@@ -1,0 +1,19 @@
+"""Persistent AOT compile-artifact cache (zero-retrace bring-up).
+
+See :mod:`kubeoperator_tpu.aot.cache` for the design; the public surface
+is :class:`CompileCache` + :class:`CacheKey` (what engines consult at
+construction), :func:`default_cache_dir` (where the manifests and CLI
+agree to look), and :func:`warm`/:data:`CATALOG` (the pre-build step).
+"""
+
+from kubeoperator_tpu.aot.cache import (AotResult, CacheKey, CompileCache,
+                                        baseline_fingerprint,
+                                        default_cache_dir, mesh_signature,
+                                        shape_signature)
+from kubeoperator_tpu.aot.warm import CATALOG, warm
+
+__all__ = [
+    "AotResult", "CacheKey", "CompileCache", "CATALOG",
+    "baseline_fingerprint", "default_cache_dir", "mesh_signature",
+    "shape_signature", "warm",
+]

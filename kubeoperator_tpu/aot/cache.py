@@ -1,0 +1,517 @@
+"""Persistent AOT compile-artifact cache: bring-up as a load, not a trace.
+
+Every topology change — autoscale, healing, preemption recovery, gateway
+replica replacement — used to pay a full trace+compile of the segment and
+train-step functions on the new worker, the dominant term in scale-up
+latency. This module turns that into a disk read: :class:`CompileCache`
+stores serialized executables produced by JAX's AOT path
+(``jitted.lower(*args).compile()`` + ``jax.experimental.
+serialize_executable``), keyed by a :class:`CacheKey` fingerprint of
+everything that can invalidate an executable:
+
+* topology — device kind, device count, and the ``MeshSpec`` axis sizes;
+* the call's shape signature (treedef + per-leaf shape/dtype, the same
+  describe rule the compile-count guard uses);
+* donation and static argnums;
+* jax + jaxlib versions (serialized executables are not portable across
+  either);
+* the function's KO140 source fingerprint from the checked-in
+  ``analysis/signatures.json`` baseline — so a *source-level* signature
+  change (new trace dep, changed donation, new closure capture) rolls the
+  key even when shapes stay identical. Lint rule KO141 flags the jit
+  sites whose deps the baseline cannot see, and ``scripts/lint_gate.sh``
+  fails CI when the baseline itself is stale.
+
+On a hit the engine gets a loaded executable and **zero** compiles happen
+(``compile_count_guard().assert_zero_compiles()`` pins this in tier-1).
+On a miss the cache live-compiles, reports the compile to the active
+guard (so the serving batcher's trace accounting and the zero-compile pin
+both stay honest), and writes the artifact back atomically. Backends
+whose executables refuse to serialize degrade to persisting the lowered
+HLO and pointing jaxlib's own compilation cache at ``<root>/xla`` — the
+next bring-up still traces, but XLA's compile is a disk hit.
+
+Concurrency: artifact directories are written under a temp name and
+published with one ``os.replace``; a loser of the publish race discards
+its copy and keeps the winner's (single-writer per entry, KO301-clean).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import threading
+import time
+from typing import Any, Callable
+
+_SCHEMA = 1
+_META = "meta.json"
+_ARTIFACT = "artifact.bin"
+_IN_USE = "in_use.json"
+
+
+def default_cache_dir() -> str:
+    """``KO_AOT_CACHE`` if set (the manifests mount it), else a per-user
+    cache dir — never a repo-relative path, so CLI and engine agree."""
+    env = os.environ.get("KO_AOT_CACHE")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "kubeoperator-tpu", "aot")
+
+
+def _describe(leaf: Any) -> Any:
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return [list(leaf.shape), str(leaf.dtype)]
+    return type(leaf).__name__
+
+
+def shape_signature(args: tuple, kwargs: dict | None = None) -> str:
+    """Treedef + per-leaf (shape, dtype) of one example call — the same
+    rule ``analysis.compile_guard`` uses, so the cache key and the guard
+    agree on what "one signature" means."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((tuple(args), kwargs or {}))
+    return json.dumps([str(treedef), [_describe(x) for x in leaves]])
+
+
+def mesh_signature(spec: Any) -> str:
+    """Canonical string for a MeshSpec (axis sizes > 1), ``solo`` for the
+    single-device path."""
+    if spec is None:
+        return "solo"
+    parts = [f"{n}{s}" for n, s in spec.sizes() if s > 1]
+    return ",".join(parts) or "solo"
+
+
+def baseline_fingerprint(function: str, baseline_path: str | None = None) -> str:
+    """Hex digest of the KO140 baseline entries naming ``function`` — the
+    source half of the cache key. ``unbaselined`` when the function has no
+    entry (the artifact then only rolls on shape/version changes; KO140's
+    drift gate is what keeps the baseline current)."""
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "analysis", "signatures.json")
+    try:
+        with open(baseline_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return "unbaselined"
+    rows = [fp for key, fp in sorted(doc.get("signatures", {}).items())
+            if fp.get("function") == function]
+    if not rows:
+        return "unbaselined"
+    blob = json.dumps(rows, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    """Everything that can invalidate a serialized executable."""
+
+    name: str
+    device_kind: str
+    n_devices: int
+    mesh: str
+    shape_sig: str
+    donate_argnums: tuple[int, ...]
+    static_argnums: tuple[int, ...]
+    jax_version: str
+    jaxlib_version: str
+    baseline_sig: str
+
+    def payload(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["donate_argnums"] = list(self.donate_argnums)
+        d["static_argnums"] = list(self.static_argnums)
+        return d
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(self.payload(), sort_keys=True).encode("utf-8")
+        return hashlib.sha256(blob).hexdigest()[:20]
+
+
+@dataclasses.dataclass
+class AotResult:
+    """What one cache consult produced: the executable to install (or
+    ``None`` when nothing loadable nor compilable was available), whether
+    it was a hit, and how long bring-up took."""
+
+    name: str
+    fingerprint: str
+    hit: bool
+    seconds: float
+    source: str               # cache | compile | hlo_fallback
+    fn: Callable | None
+
+    @property
+    def stats(self) -> dict:
+        return {"hits": 1 if self.hit else 0,
+                "misses": 0 if self.hit else 1}
+
+
+class _AotExecutable:
+    """Callable facade over a loaded/compiled executable. Forwards the
+    compile-count guard handle from the jit wrapper it replaces, so the
+    serving batcher's ``_note_compiles`` keeps seeing trace events (an AOT
+    miss is reported into the same guard)."""
+
+    def __init__(self, fn: Callable, *, guard: Any = None,
+                 fingerprint: str = "", source: str = "cache"):
+        self._fn = fn
+        self._ko_aot = {"fingerprint": fingerprint, "source": source}
+        if guard is not None:
+            self._ko_compile_guard = guard
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        return self._fn(*args, **kwargs)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+class CompileCache:
+    """Filesystem-backed executable cache. Layout::
+
+        <root>/<name>/<fingerprint>/meta.json     key anatomy + kind
+                                    artifact.bin  pickled serialize() tuple
+                                                  (or lowered HLO text)
+                                    in_use.json   pid marker while loaded
+        <root>/xla/                               jaxlib compilation cache
+                                                  (HLO-fallback wiring)
+
+    Counters (:attr:`hits`/:attr:`misses`) are process-local; the metric
+    families ``ko_aot_cache_{hits,misses}_total`` and
+    ``ko_aot_bringup_seconds`` get one sample per consult.
+    """
+
+    def __init__(self, root: str | None = None, *,
+                 baseline_path: str | None = None):
+        self.root = os.path.abspath(root or default_cache_dir())
+        os.makedirs(self.root, exist_ok=True)
+        self.baseline_path = baseline_path
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+        self._in_use: set[str] = set()
+
+    # -- key construction ---------------------------------------------------
+    def key_for(self, name: str, args: tuple, kwargs: dict | None = None, *,
+                mesh_spec: Any = None, donate: tuple[int, ...] = (),
+                static: tuple[int, ...] = ()) -> CacheKey:
+        import jax
+
+        dev = jax.devices()[0]
+        return CacheKey(
+            name=name,
+            device_kind=f"{dev.platform}:{getattr(dev, 'device_kind', '?')}",
+            n_devices=len(jax.devices()),
+            mesh=mesh_signature(mesh_spec),
+            shape_sig=shape_signature(args, kwargs),
+            donate_argnums=tuple(donate),
+            static_argnums=tuple(static),
+            jax_version=jax.__version__,
+            jaxlib_version=_jaxlib_version(),
+            baseline_sig=baseline_fingerprint(name, self.baseline_path),
+        )
+
+    # -- the one entry point engines use -------------------------------------
+    def load_or_compile(self, name: str, jitted: Callable, args: tuple,
+                        kwargs: dict | None = None, *, mesh_spec: Any = None,
+                        donate: tuple[int, ...] = (),
+                        static: tuple[int, ...] = ()) -> AotResult:
+        """Return a ready executable for ``jitted`` at ``args``' shapes.
+
+        Hit: deserialize the stored executable — no trace, no compile.
+        Miss: ``.lower().compile()`` live (reported to the active
+        compile-count guard as one trace event), persist the artifact,
+        return the compiled executable. Either way the caller installs
+        ``result.fn`` in place of its jit wrapper when non-``None``.
+        """
+        self._wire_xla_cache()
+        key = self.key_for(name, args, kwargs, mesh_spec=mesh_spec,
+                           donate=donate, static=static)
+        fp = key.fingerprint()
+        entry = self._entry_dir(name, fp)
+        guard = _active_guard()
+        t0 = time.perf_counter()
+
+        loaded = self._try_load(entry)
+        if loaded is not None:
+            fn = _AotExecutable(loaded, guard=guard, fingerprint=fp,
+                                source="cache")
+            hit, source = True, "cache"
+        else:
+            target = getattr(jitted, "_ko_jitted", jitted)
+            lowered = target.lower(*args, **(kwargs or {}))
+            compiled = self._compile_fresh(lowered)
+            if guard is not None:
+                guard.record_aot_compile(name, args, kwargs or {})
+            source = self._store(entry, key, compiled, lowered)
+            fn = _AotExecutable(compiled, guard=guard, fingerprint=fp,
+                                source=source)
+            hit = False
+        seconds = time.perf_counter() - t0
+
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+            self._in_use.add(fp)
+        self._mark_in_use(entry)
+        self._record_metrics(name, hit=hit, seconds=seconds)
+        return AotResult(name=name, fingerprint=fp, hit=hit,
+                         seconds=seconds, source=source, fn=fn)
+
+    @staticmethod
+    def _compile_fresh(lowered: Any) -> Any:
+        """Compile with jaxlib's persistent compilation cache disabled for
+        this thread: an executable REPLAYED from that cache re-serializes
+        into a payload whose jitted symbols deserialize_and_load cannot
+        resolve ("Symbols not found"), so artifacts must always come from
+        a fresh XLA compile. The artifact store itself is the persistence
+        layer here — skipping the jaxlib disk hit on this one call costs
+        nothing the cache doesn't give back."""
+        try:
+            from jax._src import compilation_cache
+            from jax._src.config import enable_compilation_cache
+        except ImportError:            # future jax moved it: compile as-is
+            return lowered.compile()
+        with enable_compilation_cache(False):
+            # is_cache_used() latches its verdict once per process, so the
+            # disabled config is invisible until the latch resets; reset on
+            # both sides so this compile sees "disabled" and later ordinary
+            # compiles re-latch against the ambient (enabled) config. A
+            # concurrent compile in the window merely skips one disk hit.
+            compilation_cache.reset_cache()
+            try:
+                return lowered.compile()
+            finally:
+                compilation_cache.reset_cache()
+
+    # -- load / store --------------------------------------------------------
+    def _try_load(self, entry: str) -> Callable | None:
+        meta_path = os.path.join(entry, _META)
+        art_path = os.path.join(entry, _ARTIFACT)
+        if not (os.path.isfile(meta_path) and os.path.isfile(art_path)):
+            return None
+        try:
+            import jax
+
+            with open(meta_path, encoding="utf-8") as fh:
+                meta = json.load(fh)
+            if meta.get("schema") != _SCHEMA:
+                raise ValueError(f"schema {meta.get('schema')} != {_SCHEMA}")
+            key = meta.get("key", {})
+            if (key.get("jax_version") != jax.__version__
+                    or key.get("jaxlib_version") != _jaxlib_version()):
+                raise ValueError(
+                    f"built for jax {key.get('jax_version')}/"
+                    f"jaxlib {key.get('jaxlib_version')}, running "
+                    f"{jax.__version__}/{_jaxlib_version()}")
+            if meta.get("kind") != "executable":
+                # HLO fallback entry: a compile still happens, but jaxlib's
+                # compilation cache under <root>/xla makes it a disk hit.
+                return None
+            from jax.experimental import serialize_executable
+
+            with open(art_path, "rb") as fh:
+                payload = pickle.loads(fh.read())
+            return serialize_executable.deserialize_and_load(*payload)
+        except Exception:
+            # Corrupt / tampered / version-skewed artifact: quarantine so
+            # the rewrite below gets a clean slate, fall back to compiling.
+            self._quarantine(entry)
+            return None
+
+    def _store(self, entry: str, key: CacheKey, compiled: Any,
+               lowered: Any) -> str:
+        kind = "executable"
+        try:
+            from jax.experimental import serialize_executable
+
+            payload = serialize_executable.serialize(compiled)
+            # Probe the round-trip before publishing: XLA:CPU under
+            # parallel codegen (e.g. --xla_force_host_platform_device_count
+            # without ..._parallel_codegen_split_count=1) serializes
+            # executables whose split-module symbols deserialize_and_load
+            # cannot resolve ("Symbols not found"). Publishing such an
+            # artifact would quarantine+recompile on every consult — worse
+            # than the honest HLO fallback.
+            serialize_executable.deserialize_and_load(*payload)
+            blob = pickle.dumps(payload)
+        except Exception:
+            kind = "hlo"
+            try:
+                blob = lowered.as_text().encode("utf-8")
+            except Exception:
+                return "compile"       # nothing persistable on this backend
+        meta = {"schema": _SCHEMA, "kind": kind, "key": key.payload(),
+                "fingerprint": key.fingerprint(),
+                "artifact_bytes": len(blob), "created_at": time.time()}
+        tmp = f"{entry}.tmp-{os.getpid()}-{threading.get_ident()}"
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            with open(os.path.join(tmp, _ARTIFACT), "wb") as fh:
+                fh.write(blob)
+            with open(os.path.join(tmp, _META), "w", encoding="utf-8") as fh:
+                json.dump(meta, fh, indent=1, sort_keys=True)
+            try:
+                os.replace(tmp, entry)
+            except OSError:
+                # publish race: another bring-up won; keep the winner's copy
+                shutil.rmtree(tmp, ignore_errors=True)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+        return "compile" if kind == "executable" else "hlo_fallback"
+
+    def _quarantine(self, entry: str) -> None:
+        try:
+            os.replace(entry, f"{entry}.corrupt-{os.getpid()}")
+        except OSError:
+            shutil.rmtree(entry, ignore_errors=True)
+
+    def _mark_in_use(self, entry: str) -> None:
+        try:
+            os.makedirs(entry, exist_ok=True)
+            with open(os.path.join(entry, _IN_USE), "w",
+                      encoding="utf-8") as fh:
+                json.dump({"pid": os.getpid(), "at": time.time()}, fh)
+        except OSError:
+            pass
+
+    def _wire_xla_cache(self) -> None:
+        """HLO-fallback wiring: if no jaxlib compilation cache is
+        configured, point it at ``<root>/xla`` so even trace-again entries
+        skip the XLA compile. Never overrides an operator's setting."""
+        try:
+            import jax
+
+            if jax.config.jax_compilation_cache_dir is None:
+                jax.config.update("jax_compilation_cache_dir",
+                                  os.path.join(self.root, "xla"))
+        except Exception:
+            pass
+
+    def _record_metrics(self, name: str, *, hit: bool, seconds: float) -> None:
+        try:
+            from kubeoperator_tpu.telemetry.metrics import record_aot_event
+
+            record_aot_event(name, hit=hit, seconds=seconds)
+        except Exception:
+            pass
+
+    # -- inventory / control plane -------------------------------------------
+    def _entry_dir(self, name: str, fingerprint: str) -> str:
+        return os.path.join(self.root, name, fingerprint)
+
+    def in_use_fingerprints(self) -> set[str]:
+        with self._lock:
+            return set(self._in_use)
+
+    def entries(self) -> list[dict]:
+        """Inventory rows for ``ko aot list`` / ``GET /api/v1/aot/status``:
+        one per published artifact, sizes included, live holders marked."""
+        rows: list[dict] = []
+        with self._lock:
+            local = set(self._in_use)
+        if not os.path.isdir(self.root):
+            return rows
+        for name in sorted(os.listdir(self.root)):
+            group = os.path.join(self.root, name)
+            if name == "xla" or not os.path.isdir(group):
+                continue
+            for fp in sorted(os.listdir(group)):
+                entry = os.path.join(group, fp)
+                meta_path = os.path.join(entry, _META)
+                if ".corrupt-" in fp or not os.path.isfile(meta_path):
+                    continue
+                try:
+                    with open(meta_path, encoding="utf-8") as fh:
+                        meta = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                size = 0
+                for f in os.listdir(entry):
+                    try:
+                        size += os.path.getsize(os.path.join(entry, f))
+                    except OSError:
+                        pass
+                holder = self._holder_pid(entry)
+                rows.append({
+                    "name": name, "fingerprint": fp,
+                    "kind": meta.get("kind"), "size_bytes": size,
+                    "created_at": meta.get("created_at"),
+                    "key": meta.get("key", {}),
+                    "in_use": fp in local or holder is not None,
+                    "holder_pid": holder,
+                })
+        return rows
+
+    def _holder_pid(self, entry: str) -> int | None:
+        try:
+            with open(os.path.join(entry, _IN_USE), encoding="utf-8") as fh:
+                pid = int(json.load(fh).get("pid", -1))
+        except (OSError, ValueError):
+            return None
+        return pid if _pid_alive(pid) else None
+
+    def status(self) -> dict:
+        rows = self.entries()
+        return {"root": self.root,
+                "entries": rows,
+                "count": len(rows),
+                "total_bytes": sum(r["size_bytes"] for r in rows),
+                "hits": self.hits,
+                "misses": self.misses}
+
+    def purge(self, fingerprint: str | None = None, *,
+              force: bool = False) -> dict:
+        """Delete artifacts (all, or one fingerprint). Entries referenced
+        by a running engine — this process's loads, or any entry whose
+        ``in_use.json`` names a live pid — are refused unless ``force``."""
+        removed: list[str] = []
+        refused: list[str] = []
+        for row in self.entries():
+            fp = row["fingerprint"]
+            if fingerprint is not None and fp != fingerprint:
+                continue
+            if row["in_use"] and not force:
+                refused.append(fp)
+                continue
+            shutil.rmtree(self._entry_dir(row["name"], fp),
+                          ignore_errors=True)
+            removed.append(fp)
+            with self._lock:
+                self._in_use.discard(fp)
+        return {"removed": removed, "refused": refused}
+
+
+def _jaxlib_version() -> str:
+    try:
+        import jaxlib
+
+        return jaxlib.__version__
+    except Exception:
+        return "unknown"
+
+
+def _active_guard() -> Any:
+    try:
+        from kubeoperator_tpu.analysis.compile_guard import active_guard
+
+        return active_guard()
+    except Exception:
+        return None

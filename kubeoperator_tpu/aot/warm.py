@@ -1,0 +1,101 @@
+"""The warm catalog: pre-build AOT artifacts for known configurations.
+
+``ko aot warm`` (and the Dockerfile.workloads image-build hook) run this
+so that the first worker bring-up on a node — autoscale, healing, a
+rolling upgrade — lands on a populated cache instead of paying the
+trace+compile. Each catalog entry constructs the real engine/trainer with
+``compile_cache=`` wired, which routes through the exact
+``CompileCache.load_or_compile`` path production bring-up uses: the
+artifact written here has the same key a scaled-up worker will compute.
+
+Entries are keyed by the serving/training configs the manifests deploy
+(plus smoke-sized variants so the catalog itself is testable on CPU in
+seconds). Warming on a host whose device kind differs from the target
+fleet produces artifacts the fleet will simply miss on — the key includes
+the device kind — so an image built on CPU still helps CPU CI and the
+cost-model benches, while TPU artifacts are built by the first TPU pod
+and shared via the mounted cache volume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from kubeoperator_tpu.aot.cache import CompileCache
+
+
+def _warm_serve(cache: CompileCache, *, vocab: int, d_model: int,
+                n_heads: int, n_layers: int, d_ff: int, max_seq_len: int,
+                slots: int, segment: int) -> Any:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+    from kubeoperator_tpu.workloads.transformer import (Transformer,
+                                                        TransformerConfig)
+
+    cfg = TransformerConfig(vocab_size=vocab, d_model=d_model,
+                            n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,
+                            max_seq_len=max_seq_len, dtype=jnp.float32)
+    params = nn.unbox(Transformer(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    eng = SlotPoolEngine(cfg, params, slots=slots, segment=segment,
+                         compile_cache=cache)
+    return eng.aot
+
+
+def _warm_train(cache: CompileCache, *, batch_size: int, image_size: int,
+                num_classes: int, depth: int) -> Any:
+    from kubeoperator_tpu.workloads.train import TrainConfig, Trainer
+
+    cfg = TrainConfig(batch_size=batch_size, image_size=image_size,
+                      num_classes=num_classes, depth=depth,
+                      warmup_steps=2, total_steps=10)
+    tr = Trainer(cfg, compile_cache=cache)
+    state = tr.init_state()
+    images, labels = tr.synthetic_batch()
+    tr.train_step(state, images, labels)
+    return tr.aot
+
+
+# name -> (builder, kwargs). Smoke entries compile in seconds on CPU and
+# are what CI and the image-build hook warm; the "default" entries match
+# the serve manifest's deployed configuration.
+CATALOG: dict[str, tuple[Callable[..., Any], dict]] = {
+    "serve-smoke": (_warm_serve, dict(vocab=64, d_model=32, n_heads=4,
+                                      n_layers=2, d_ff=64, max_seq_len=24,
+                                      slots=4, segment=4)),
+    "train-smoke": (_warm_train, dict(batch_size=8, image_size=32,
+                                      num_classes=10, depth=18)),
+    "serve-default": (_warm_serve, dict(vocab=256, d_model=128, n_heads=8,
+                                        n_layers=4, d_ff=512,
+                                        max_seq_len=256, slots=16,
+                                        segment=8)),
+}
+
+
+def warm(cache: CompileCache, names: list[str] | None = None, *,
+         emit: Callable[[str], None] = lambda s: None) -> list[dict]:
+    """Build every requested catalog entry through the cache; return one
+    row per entry with the hit/miss outcome and bring-up seconds. Unknown
+    names raise (a typo'd warm catalog must not silently warm nothing)."""
+    picked = names or ["serve-smoke", "train-smoke"]
+    unknown = [n for n in picked if n not in CATALOG]
+    if unknown:
+        raise KeyError(f"unknown warm catalog entr{'y' if len(unknown) == 1 else 'ies'}: "
+                       f"{unknown} (have: {sorted(CATALOG)})")
+    rows: list[dict] = []
+    for entry in picked:
+        builder, kwargs = CATALOG[entry]
+        res = builder(cache, **kwargs)
+        row = {"entry": entry,
+               "function": res.name if res else None,
+               "fingerprint": res.fingerprint if res else None,
+               "hit": bool(res.hit) if res else None,
+               "seconds": round(res.seconds, 4) if res else None,
+               "source": res.source if res else None}
+        rows.append(row)
+        emit(f"warm {entry}: {'hit' if row['hit'] else 'built'} "
+             f"{row['fingerprint']} in {row['seconds']}s")
+    return rows

@@ -764,6 +764,16 @@ async def autoscale_status(request: web.Request) -> web.Response:
         rows = [r for r in rows if r["cluster"] in visible]
     return web.json_response(rows)
 
+async def aot_status(request: web.Request) -> web.Response:
+    """``GET /api/v1/aot/status`` — inventory of the controller-local AOT
+    compile-artifact cache (the same directory `ko aot` operates on; a
+    fleet view would aggregate per-worker /metrics, this answers "what
+    would a worker scheduled here load?")."""
+    def _status():
+        from kubeoperator_tpu.aot import CompileCache
+        return CompileCache().status()
+    return web.json_response(await _sync(request, _status))
+
 
 # ---------------------------------------------------------------------------
 # hosts
@@ -1215,6 +1225,7 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/schema", openapi_schema)
     r.add_get("/api/v1/dashboard/{item}", dashboard)
     r.add_get("/api/v1/autoscale/status", autoscale_status)
+    r.add_get("/api/v1/aot/status", aot_status)
     r.add_get("/api/v1/logs", search_system_logs)
     r.add_get("/api/v1/events", search_cluster_events)
 

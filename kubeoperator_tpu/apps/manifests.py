@@ -314,7 +314,11 @@ data:
       {{"title": "Gateway: routing by replica/policy, affinity, handoff pages", "type": "timeseries", "gridPos": {{"x":0,"y":40,"w":24,"h":8}},
         "targets": [{{"expr": "sum(rate(ko_gateway_requests_routed_total[5m])) by (replica, policy)", "legendFormat": "replica {{{{replica}}}} {{{{policy}}}}"}},
                     {{"expr": "avg(ko_gateway_prefix_affinity_ratio)", "legendFormat": "prefix affinity"}},
-                    {{"expr": "sum(rate(ko_gateway_handoff_pages_total[5m]))", "legendFormat": "handoff pages/s"}}]}}
+                    {{"expr": "sum(rate(ko_gateway_handoff_pages_total[5m]))", "legendFormat": "handoff pages/s"}}]}},
+      {{"title": "AOT cache: hit/miss rate, bring-up p95", "type": "timeseries", "gridPos": {{"x":0,"y":48,"w":24,"h":8}},
+        "targets": [{{"expr": "sum(rate(ko_aot_cache_hits_total[5m])) by (fn)", "legendFormat": "hits {{{{fn}}}}"}},
+                    {{"expr": "sum(rate(ko_aot_cache_misses_total[5m])) by (fn)", "legendFormat": "misses {{{{fn}}}}"}},
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_aot_bringup_seconds_bucket[5m])) by (le, outcome))", "legendFormat": "bring-up p95 {{{{outcome}}}}"}}]}}
     ]}}
 ---
 apiVersion: v1
@@ -571,13 +575,20 @@ spec:
       containers:
       - name: server
         image: "{registry}/ko-workloads:latest"
+        # --aot-cache points at the image's pre-warmed compile-artifact
+        # store (Dockerfile.workloads warms serve-smoke/train-smoke at
+        # build time), so replica bring-up loads executables instead of
+        # tracing+compiling — the node hostPath accumulates full-size keys
         command: ["python", "-m", "kubeoperator_tpu.train.jobs", "serve",
-                  "--port", "8080", "--ckpt-dir", "/ckpt"]
+                  "--port", "8080", "--ckpt-dir", "/ckpt",
+                  "--aot-cache", "/var/cache/kubeoperator-tpu/aot"]
         ports: [{{containerPort: 8080}}]
         readinessProbe: {{httpGet: {{path: /healthz, port: 8080}}}}
         resources: {{limits: {{google.com/tpu: "4"}}}}
-        volumeMounts: [{{name: ckpt, mountPath: /ckpt}}]
-      volumes: [{{name: ckpt, hostPath: {{path: /var/lib/kubeoperator/ckpt}}}}]
+        volumeMounts: [{{name: ckpt, mountPath: /ckpt}},
+                       {{name: aot-cache, mountPath: /var/cache/kubeoperator-tpu/aot}}]
+      volumes: [{{name: ckpt, hostPath: {{path: /var/lib/kubeoperator/ckpt}}}},
+                {{name: aot-cache, hostPath: {{path: /var/cache/kubeoperator-tpu/aot}}}}]
 ---
 apiVersion: v1
 kind: Service

@@ -378,6 +378,59 @@ def cmd_scenario(args) -> int:
     return 0
 
 
+def cmd_aot(args) -> int:
+    """``ko aot`` — operate the persistent compile-artifact cache locally
+    (no controller, no login — the cache is a directory, like ``ko lint``
+    is a parser): inventory, warm the workload catalog, purge, status."""
+    # Warming on a CPU host (image builds, CI): XLA:CPU's parallel codegen
+    # emits split LLVM modules whose symbols don't survive
+    # serialize_executable — force one module so the baked artifacts
+    # actually deserialize. Harmless on TPU (xla_cpu_* flags are inert
+    # there); set before jax initialises its backend below.
+    flag = "--xla_cpu_parallel_codegen_split_count=1"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    from kubeoperator_tpu.aot import CATALOG, CompileCache, warm
+    cache = CompileCache(args.cache or None)
+    if args.action == "list":
+        rows = [{"name": r["name"], "fingerprint": r["fingerprint"],
+                 "kind": r["kind"], "mesh": r["key"].get("mesh", "?"),
+                 "KiB": f"{r['size_bytes'] / 1024:.0f}",
+                 "in_use": "yes" if r["in_use"] else ""}
+                for r in cache.entries()]
+        table(rows, ["name", "fingerprint", "kind", "mesh", "KiB", "in_use"])
+        return 0
+    if args.action == "status":
+        s = cache.status()
+        print(f"{s['root']}: {s['count']} artifact(s), "
+              f"{s['total_bytes'] / 1024:.0f} KiB, "
+              f"hits {s['hits']} misses {s['misses']}")
+        return 0
+    if args.action == "warm":
+        try:
+            rows = warm(cache, args.names or None)
+        except KeyError as e:
+            print(f"error: unknown catalog entry {e} "
+                  f"(have: {', '.join(sorted(CATALOG))})", file=sys.stderr)
+            return 1
+        for r in rows:
+            state = "hit (already warm)" if r["hit"] else f"compiled ({r['source']})"
+            print(f"{r['entry']}/{r['function']}: {state} "
+                  f"in {r['seconds']:.2f}s → {r['fingerprint']}")
+        return 0
+    # purge: in-use artifacts (this process, or any live pid's in_use.json
+    # marker) are refused without --force so a running engine's loaded
+    # executable never loses its backing entry mid-flight
+    out = cache.purge(args.names[0] if args.names else None, force=args.force)
+    for fp in out["removed"]:
+        print(f"removed {fp}")
+    for fp in out["refused"]:
+        print(f"refused {fp}: in use by a running engine (--force overrides)",
+              file=sys.stderr)
+    return 1 if out["refused"] else 0
+
+
 def build_parser(sub) -> None:
     """Register the ``ctl`` subcommands on the main argument parser."""
     login = sub.add_parser("login", help="authenticate against a controller")
@@ -474,6 +527,20 @@ def build_parser(sub) -> None:
     scen.add_argument("--check", action="store_true",
                       help="exit 2 if any SLO breached or tokens lost")
     scen.set_defaults(fn=cmd_scenario)
+
+    aot = sub.add_parser(
+        "aot", help="persistent AOT compile-artifact cache (zero-retrace "
+                    "bring-up)")
+    aot.add_argument("action", choices=("list", "warm", "purge", "status"))
+    aot.add_argument("names", nargs="*",
+                     help="warm: catalog entries (default: the smoke set); "
+                          "purge: one fingerprint (default: all)")
+    aot.add_argument("--cache", default="",
+                     help="cache root (default: $KO_AOT_CACHE or "
+                          "~/.cache/kubeoperator-tpu/aot)")
+    aot.add_argument("--force", action="store_true",
+                     help="purge even artifacts a running engine holds")
+    aot.set_defaults(fn=cmd_aot)
 
     logs = sub.add_parser("logs", help="search system logs")
     logs.add_argument("--query", default="")

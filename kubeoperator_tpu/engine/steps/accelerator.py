@@ -19,6 +19,10 @@ from kubeoperator_tpu.engine.steps import k8s
 
 TPU_ENV_DIR = "/etc/kubeoperator"
 LIBTPU_PATH = "/lib/libtpu.so"
+# node-local root of the AOT compile-artifact cache (aot/cache.py): the
+# workload charts hostPath-mount it, so a replacement worker's engine
+# bring-up is an artifact load, not a trace+compile
+AOT_CACHE_DIR = "/var/cache/kubeoperator-tpu/aot"
 
 NVIDIA_RUNTIME_TOML = """[plugins."io.containerd.grpc.v1.cri".containerd.runtimes.nvidia]
   runtime_type = "io.containerd.runc.v2"
@@ -58,13 +62,19 @@ def run(ctx: StepContext):
             # by JAX workload pods (jax.distributed.initialize)
             peers = slice_peers(ctx, th.host.tpu_slice_id)
             hostnames = ",".join(p.host.ip for p in peers)
+            # part 3 (round 15): the AOT cache root — scale/heal executions
+            # carry the operator's override in their params, so autoscaled
+            # and healed replacement workers point at the same warmed store
+            aot_dir = str(ctx.params.get("aot_cache_dir") or AOT_CACHE_DIR)
             env = (
                 f"TPU_ACCELERATOR_TYPE={th.host.tpu_type}\n"
                 f"TPU_WORKER_ID={th.host.tpu_worker_id}\n"
                 f"TPU_WORKER_HOSTNAMES={hostnames}\n"
                 f"TPU_SLICE_ID={th.host.tpu_slice_id}\n"
+                f"KO_AOT_CACHE={aot_dir}\n"
             )
             o.ensure_dir(TPU_ENV_DIR)
+            o.ensure_dir(aot_dir)
             o.ensure_file(f"{TPU_ENV_DIR}/tpu.env", env)
 
     ctx.fan_out(per)

@@ -113,16 +113,23 @@ def _scale_params(sizing: dict, direction: str, cfg) -> tuple[dict, int] | None:
     delta = step if direction == "up" else -step
     lo = int(cfg.get("autoscale_min_workers", 1))
     hi = int(cfg.get("autoscale_max_workers", 8))
-    if sizing.get("tpu_pools"):
-        pools = scale_pool_counts(sizing["tpu_pools"], delta, lo, hi)
+    # new workers join pointed at the warmed AOT artifact store (the
+    # accelerator step writes KO_AOT_CACHE into tpu.env from this param),
+    # so the scale-up's bring-up is a cache load — the whole point of
+    # scaling on an SLO breach is closing the breach window fast
+    base = dict(sizing)
+    if cfg.get("aot_cache_dir"):
+        base.setdefault("aot_cache_dir", str(cfg.get("aot_cache_dir")))
+    if base.get("tpu_pools"):
+        pools = scale_pool_counts(base["tpu_pools"], delta, lo, hi)
         if pools is None:
             return None
-        return {**sizing, "tpu_pools": pools}, int(pools[0]["count"])
-    cur = int(sizing.get("worker_size", lo))
+        return {**base, "tpu_pools": pools}, int(pools[0]["count"])
+    cur = int(base.get("worker_size", lo))
     want = max(lo, min(hi, cur + delta))
     if want == cur:
         return None
-    return {**sizing, "worker_size": want}, want
+    return {**base, "worker_size": want}, want
 
 
 def _emit_scale(platform, cluster: Cluster, params: dict,

@@ -59,9 +59,11 @@ def _current_sizing(platform, cluster: Cluster) -> dict:
     exs.sort(key=lambda e: e.created_at, reverse=True)
     sizing: dict = {}
     for e in exs:                       # newest-first, merged per key — an
-        for k in ("worker_size", "tpu_pools"):   # older execution may be the
-            if k in e.params and k not in sizing:  # only one that set a key
-                sizing[k] = e.params[k]
+        # aot_cache_dir rides along: a healed replacement worker must point
+        # at the same warmed compile-artifact store as the one it replaces
+        for k in ("worker_size", "tpu_pools", "aot_cache_dir"):
+            if k in e.params and k not in sizing:  # older execution may be
+                sizing[k] = e.params[k]            # the only one set a key
     return sizing
 
 

@@ -66,6 +66,12 @@ QUERIED_METRICS = {
     "ko_train_step_seconds_bucket": "jax-train",
     "ko_train_mfu": "jax-train",
     "ko_train_collective_seconds": "jax-train",
+    # AOT compile cache (round 15): whether worker bring-up loaded a
+    # persisted executable or paid a live trace+compile, and how long —
+    # served off the worker's /metrics like the batcher families
+    "ko_aot_cache_hits_total": "jax-serve",
+    "ko_aot_cache_misses_total": "jax-serve",
+    "ko_aot_bringup_seconds_bucket": "jax-serve",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -119,6 +125,15 @@ PROMQL = {
     "train_collective_rate": "sum(rate(ko_train_collective_seconds[5m]))",
     "train_collective_by_kind":
         "sum(rate(ko_train_collective_seconds[5m])) by (collective)",
+    # AOT compile cache (round 15): hit vs miss rate across bring-ups (a
+    # sustained miss rate during autoscale churn means scale-up is paying
+    # cold compiles — check the cache mount and the warm catalog) and the
+    # bring-up latency p95 the cache exists to crush
+    "aot_hit_rate": "sum(rate(ko_aot_cache_hits_total[5m]))",
+    "aot_miss_rate": "sum(rate(ko_aot_cache_misses_total[5m]))",
+    "aot_bringup_p95":
+        "histogram_quantile(0.95, "
+        "sum(rate(ko_aot_bringup_seconds_bucket[5m])) by (le))",
 }
 
 
@@ -491,6 +506,10 @@ class ClusterMonitor:
                 for r in prom.query(PROMQL["train_collective_by_kind"])}
         except Exception:  # noqa: BLE001 — metric gaps are data, not errors
             train_collectives = {}
+        # AOT bring-up plane (round 15): None marks "no cache-aware worker"
+        aot_hit_rate = prom.scalar_or_none(PROMQL["aot_hit_rate"])
+        aot_miss_rate = prom.scalar_or_none(PROMQL["aot_miss_rate"])
+        aot_bringup_p95 = prom.scalar_or_none(PROMQL["aot_bringup_p95"])
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -521,6 +540,9 @@ class ClusterMonitor:
             "train_mfu": train_mfu,
             "train_collective_rate": train_coll_rate,
             "train_collectives": train_collectives,
+            "aot_hit_rate": aot_hit_rate,
+            "aot_miss_rate": aot_miss_rate,
+            "aot_bringup_p95": aot_bringup_p95,
             "time": iso_now(),
         }
         self._save_snapshot(data)
@@ -562,6 +584,8 @@ class ClusterMonitor:
                        "gateway_handoff_rate": data["gateway_handoff_rate"],
                        "train_step_p95": data["train_step_p95"],
                        "train_mfu": data["train_mfu"],
+                       "aot_hit_rate": data["aot_hit_rate"],
+                       "aot_bringup_p95": data["aot_bringup_p95"],
                        "pod_count": data["pod_count"]})
         points = points[-self.HISTORY_POINTS:]
         # SLO evaluation rides the same beat, judged over the freshly

@@ -515,6 +515,53 @@ def record_train_step(workload: str, step_seconds: float,
                                    collective=kind)
 
 
+# -- AOT compile-cache families (aot/cache.py) ------------------------------
+# Bring-up spans a warm deserialize (~tens of ms) to a cold multi-minute
+# trace+compile of a full model; start finer than DEFAULT_BUCKETS and
+# stretch past it.
+AOT_BRINGUP_BUCKETS: tuple[float, ...] = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 180.0,
+    600.0)
+
+
+def declare_aot_metrics(registry: Registry) -> dict:
+    """Declare the ``ko_aot_*`` vocabulary on ``registry`` and return the
+    families keyed by short name. The CompileCache records one sample per
+    consult into the process-global REGISTRY, so a scrape of any worker
+    (serve pod, train pod, warm hook) shows whether its bring-up loaded
+    or compiled; declared at import so the README drift lint sees the
+    vocabulary."""
+    return {
+        "hits": registry.counter(
+            "ko_aot_cache_hits_total",
+            "AOT compile-cache loads that skipped trace+compile (bring-up "
+            "served from a persisted executable), by jitted function.",
+            labels=("fn",)),
+        "misses": registry.counter(
+            "ko_aot_cache_misses_total",
+            "AOT compile-cache consults that fell back to a live "
+            "trace+compile (artifact absent, corrupt, or version-"
+            "mismatched), by jitted function.",
+            labels=("fn",)),
+        "bringup": registry.histogram(
+            "ko_aot_bringup_seconds",
+            "Wall-clock bring-up of one jitted function through the AOT "
+            "cache: deserialize on a hit, trace+compile+persist on a "
+            "miss.",
+            labels=("fn", "outcome"), buckets=AOT_BRINGUP_BUCKETS),
+    }
+
+
+def record_aot_event(fn: str, *, hit: bool, seconds: float,
+                     registry: Registry | None = None) -> None:
+    """One call per CompileCache consult: bump the hit or miss counter
+    and observe the bring-up histogram."""
+    fams = declare_aot_metrics(registry if registry is not None else REGISTRY)
+    (fams["hits"] if hit else fams["misses"]).inc(fn=fn)
+    fams["bringup"].observe(float(seconds), fn=fn,
+                            outcome="hit" if hit else "miss")
+
+
 # -- SLO engine families (services/monitor.evaluate_slos) -------------------
 # Set by the controller's monitor beat, not by BatcherStats: SLO attainment
 # and burn are judged over the persisted snapshot history, so they live on
@@ -592,3 +639,4 @@ GATEWAY_HANDOFF_PAGES = REGISTRY.counter(
 
 declare_serve_metrics(REGISTRY)
 declare_train_metrics(REGISTRY)
+declare_aot_metrics(REGISTRY)

@@ -168,6 +168,13 @@ class RequestTrace:
     def compile_event(self, n: int) -> None:
         self.root.add_event("compile", n=n)
 
+    def aot_event(self, *, hit: bool, seconds: float) -> None:
+        """The engine's AOT-cache bring-up outcome, noted on the first
+        in-flight requests: ``hit`` means the segment executable was
+        deserialized (zero compiles); a miss pairs with a compile event."""
+        self.root.add_event("aot", hit=bool(hit),
+                            seconds=round(float(seconds), 6))
+
     def ttft(self, seconds: float) -> None:
         self.root.attributes["ttft_s"] = round(seconds, 6)
 

@@ -391,11 +391,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
         # path — the engine itself degrades, no branch here
         mesh_spec = (parse_mesh(args.mesh, jax.device_count())
                      if args.mesh else None)
+        # --aot-cache: bring-up consults the persistent compile-artifact
+        # cache; a warm artifact turns construction into a deserialize
+        # (zero compiles), a miss live-compiles and writes it back
+        compile_cache = None
+        if getattr(args, "aot_cache", None):
+            from kubeoperator_tpu.aot import CompileCache
+
+            compile_cache = CompileCache(args.aot_cache)
         try:
             engine = SlotPoolEngine(cfg, model_params, slots=args.slots,
                                     segment=args.segment,
                                     page=args.page, pages=args.pages,
-                                    mesh_spec=mesh_spec)
+                                    mesh_spec=mesh_spec,
+                                    compile_cache=compile_cache)
         except ValueError as e:
             raise SystemExit(f"serve: {e}") from e
         # round 9: per-request span trees into the in-process ring —
@@ -414,7 +423,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "slots": args.slots, "segment": args.segment,
               "page": engine.page, "pages": engine.pages,
               "mesh": (dict(engine.spec.sizes())
-                       if engine.spec is not None else None)})
+                       if engine.spec is not None else None),
+              "aot": ({"hit": engine.aot.hit,
+                       "fingerprint": engine.aot.fingerprint,
+                       "seconds": round(engine.aot.seconds, 4)}
+                      if engine.aot is not None else None)})
         engine.run_segment()
     else:
         if args.mesh:
@@ -658,6 +671,20 @@ def cmd_fsdp(args: argparse.Namespace) -> int:
     y = jax.device_put(
         jax.random.randint(jax.random.key(2), (batch,), 0, vocab), bs)
 
+    # --aot-cache: the overlapped step consults the compile-artifact
+    # cache once the example (params, x, y) exist — warm bring-up loads
+    # the executable, a miss compiles live and persists it
+    if getattr(args, "aot_cache", None):
+        from kubeoperator_tpu.aot import CompileCache
+
+        aot = CompileCache(args.aot_cache).load_or_compile(
+            "step_fn", step, (params, x, y), mesh_spec=spec, donate=(0,))
+        if aot.fn is not None:
+            step = aot.fn
+        emit({"job": "fsdp", "aot": {"hit": aot.hit,
+                                     "fingerprint": aot.fingerprint,
+                                     "seconds": round(aot.seconds, 4)}})
+
     times: list[float] = []
     for i in range(args.warmup + args.steps):
         t0 = time.perf_counter()
@@ -682,6 +709,7 @@ def cmd_fsdp(args: argparse.Namespace) -> int:
         n_fsdp=spec.fsdp, peak_flops=peak,
         overlap=not args.no_overlap)
     att = costmodel.attribute(step_s, model)
+    # ko: lint-ok[KO141] profiler probe only — a throwaway jit for collective attribution, never AOT-cached
     prof = costmodel.profiled_collective_seconds(
         jax.jit(loss_fn), params, x, y)
     if prof is not None:
@@ -859,6 +887,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "shards — the admission limiter (default "
                          "slots * max_seq_len/page + dp, dense-"
                          "equivalent HBM)")
+    sv.add_argument("--aot-cache", type=str, default=None,
+                    help="continuous engine: AOT compile-artifact cache "
+                         "dir — bring-up loads the segment executable "
+                         "instead of trace+compiling when warm "
+                         "(autoscaled workers pass the shared mount)")
 
     fs = sub.add_parser("fsdp", help="chunked ZeRO-3 training with "
                                      "latency-hiding gather/compute overlap")
@@ -878,6 +911,10 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("--no-overlap", action="store_true",
                     help="gather each layer chunk serially before its "
                          "compute (the A/B baseline schedule)")
+    fs.add_argument("--aot-cache", type=str, default=None,
+                    help="AOT compile-artifact cache dir for the "
+                         "overlapped step (warm bring-up skips the "
+                         "trace+compile)")
 
     pp = sub.add_parser("pipeline",
                         help="device-pipelined training over a pp mesh axis")

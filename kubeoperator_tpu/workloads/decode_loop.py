@@ -259,7 +259,8 @@ class SlotPoolEngine:
                  slots: int = 16, segment: int = 8,
                  page: int | None = None, pages: int | None = None,
                  mesh: Any = None, mesh_spec: MeshSpec | None = None,
-                 devices: Sequence[Any] | None = None):
+                 devices: Sequence[Any] | None = None,
+                 compile_cache: Any = None):
         if cfg.moe_experts != 0 or not cfg.scan_layers:
             raise ValueError(
                 "SlotPoolEngine requires scan_layers=True and no MoE "
@@ -371,6 +372,21 @@ class SlotPoolEngine:
         self._seg_fn = jax.jit(
             self._segment_body, donate_argnums=self._donate,
             **({"out_shardings": out_sh} if out_sh is not None else {}))
+        # AOT compile-artifact cache: on a hit the segment dispatch is a
+        # deserialized executable and bring-up performs zero compiles; on
+        # a miss the cache live-compiles here (reported to any active
+        # compile-count guard) and persists the artifact for the next
+        # worker. The example args are exactly run_segment's tuple.
+        self.aot = None
+        if compile_cache is not None:
+            res = compile_cache.load_or_compile(
+                "_segment_body", self._seg_fn,
+                (self._buf, self._pos, self._last, self._plen, self._temp,
+                 self._seeds, self._pools, self._bt),
+                mesh_spec=self.spec, donate=self._donate)
+            if res.fn is not None:
+                self._seg_fn = res.fn
+            self.aot = res
 
     def _pin(self, x: jnp.ndarray, sh: NamedSharding | None) -> jnp.ndarray:
         """Place one pool buffer on its canonical sharding (identity on

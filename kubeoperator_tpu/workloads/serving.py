@@ -458,6 +458,7 @@ class ContinuousBatcher:
         # the segment dispatched at _dispatch_t0 is known device-complete
         self._dispatch_t0: float | None = None
         self._compiles_seen = 0
+        self._aot_noted = False
         self._cond = threading.Condition()
         self._queue: deque[_Pending] = deque()
         self._track: dict[int, dict] = {}       # slot -> in-flight state
@@ -733,6 +734,18 @@ class ContinuousBatcher:
         """Compile events for in-flight traces — meaningful only when a
         ``compile_count_guard`` was active while the engine built its
         segment fn (tier-1 and the bench wrap it); otherwise a getattr."""
+        # AOT bring-up outcome: annotate the first in-flight traces once —
+        # a hit explains a fast TTFT the same way a compile event explains
+        # a slow one (the cold miss ALSO lands below as a compile event,
+        # because the cache reports it into the active guard)
+        aot = getattr(self.engine, "aot", None)
+        if aot is not None and not self._aot_noted and self._track:
+            # ko: lint-ok[KO201,KO301] single-writer: only the worker thread notes bring-up
+            self._aot_noted = True
+            for t in self._track.values():
+                if t["req"].trace is not None:
+                    t["req"].trace.aot_event(hit=aot.hit,
+                                             seconds=aot.seconds)
         guard = getattr(getattr(self.engine, "_seg_fn", None),
                         "_ko_compile_guard", None)
         if guard is None:

@@ -172,7 +172,7 @@ class Trainer:
     """Builds sharded state + a compiled train step for a ResNet classifier."""
 
     def __init__(self, cfg: TrainConfig | None = None, spec: MeshSpec | None = None,
-                 devices: list | None = None):
+                 devices: list | None = None, compile_cache: Any = None):
         self.cfg = cfg or TrainConfig()
         devices = devices if devices is not None else jax.devices()
         self.spec = spec or MeshSpec(dp=len(devices))
@@ -188,6 +188,8 @@ class Trainer:
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None
         self._init_fn: Callable | None = None
+        self._compile_cache = compile_cache
+        self.aot = None
 
     # -- state -------------------------------------------------------------
     def init_state(self, rng: jax.Array | None = None) -> TrainState:
@@ -215,6 +217,16 @@ class Trainer:
                    labels: jnp.ndarray) -> tuple[TrainState, dict]:
         if self._step_fn is None:
             self._step_fn = self._build_step()
+            # AOT cache consult happens on the first step, the earliest
+            # point the example (state, batch) shapes exist: a hit swaps
+            # in the deserialized executable before anything traces.
+            if self._compile_cache is not None:
+                res = self._compile_cache.load_or_compile(
+                    "_py_step", self._step_fn, (state, images, labels),
+                    mesh_spec=self.spec, donate=(0,))
+                if res.fn is not None:
+                    self._step_fn = res.fn
+                self.aot = res
         return self._step_fn(state, images, labels)
 
     def _py_step(self, state: TrainState, images, labels):

@@ -134,6 +134,7 @@ class ViTTrainer:
 
     def train_step(self, state, images, labels):
         if self._step is None:
+            # ko: lint-ok[KO141] factory deps are ctor-fixed (model config + optimizer); this trainer is not AOT-cached
             self._step = jax.jit(train_step_fn(self.model, self.tx),
                                  donate_argnums=(0,),
                                  in_shardings=(None, self.batch_shd,

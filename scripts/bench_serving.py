@@ -335,6 +335,89 @@ def bench_scaling_real(shapes=SCALING_SHAPES) -> dict:
     return {"device_kind": jax.devices()[0].platform, "curve": curve}
 
 
+def bench_bringup(slots: int = 4, segment: int = 4) -> dict:
+    """Cold-vs-warm worker bring-up A/B through the AOT compile-artifact
+    cache (round 15), on the real engine: the cold arm trace+compiles and
+    persists the executable, the warm arm constructs the same engine
+    against the populated store and must load it back — zero compile
+    events under the guard. The autoscale replay then reruns the round-4
+    scale-up timeline (detect → schedule → bring-up → drain the backlog
+    that accrued while waiting) with each arm's measured bring-up time:
+    the breach window closes exactly bring-up-delta sooner warm."""
+    import shutil
+    import tempfile
+
+    # serialize_executable round-trips on XLA:CPU only when codegen stays
+    # in one LLVM module (see tests/conftest.py); inert elsewhere. Set
+    # before the first device touch below initialises the backend.
+    flag = "--xla_cpu_parallel_codegen_split_count=1"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.analysis.compile_guard import compile_count_guard
+    from kubeoperator_tpu.aot import CompileCache
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+    from kubeoperator_tpu.workloads.transformer import (
+        Transformer, TransformerConfig,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=24,
+                            dtype=jnp.float32, remat=False,
+                            attention="dense")
+    params = nn.unbox(Transformer(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    root = tempfile.mkdtemp(prefix="ko-aot-bench-")
+
+    def bringup() -> dict:
+        cache = CompileCache(root)
+        with compile_count_guard() as guard:
+            t0 = time.perf_counter()
+            eng = SlotPoolEngine(cfg, params, slots=slots, segment=segment,
+                                 compile_cache=cache)
+            wall = time.perf_counter() - t0
+        return {"seconds": round(wall, 4), "compiles": guard.total(),
+                "hit": bool(eng.aot.hit), "source": eng.aot.source,
+                "fingerprint": eng.aot.fingerprint}
+
+    try:
+        cold = bringup()
+        warm = bringup()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    speedup = round(cold["seconds"] / max(warm["seconds"], 1e-9), 2)
+
+    # Scale-up replay on the round-4 autoscaler timeline (cost model):
+    # detect the SLO breach (one evaluation period), schedule the pod,
+    # bring the worker up (measured above), then drain the backlog that
+    # accrued at `overload_rps` while the fleet was short — the existing
+    # replicas spare `drain_rps` once the new worker absorbs its share.
+    detect_s, schedule_s = 1.0, 2.0
+    overload_rps, drain_rps = 4.0, 8.0
+
+    def replay(bring_s: float) -> float:
+        waiting = detect_s + schedule_s + bring_s
+        backlog = overload_rps * waiting
+        return round(waiting + backlog / drain_rps, 4)
+
+    result = {
+        "device_kind": jax.devices()[0].platform,
+        "bringup_ab": {"cold": cold, "warm": warm, "speedup": speedup},
+        "autoscale_replay": {
+            "detect_s": detect_s, "schedule_s": schedule_s,
+            "overload_rps": overload_rps, "drain_rps": drain_rps,
+            "cold_breach_close_s": replay(cold["seconds"]),
+            "warm_breach_close_s": replay(warm["seconds"]),
+        },
+    }
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=48)
@@ -383,9 +466,39 @@ def main() -> None:
     ap.add_argument("--tracing-overhead", action="store_true",
                     help="A/B the continuous engine with the serve tracer "
                          "off vs on (round 9: must stay under 5%% tok/s)")
+    ap.add_argument("--bringup", action="store_true",
+                    help="cold-vs-warm worker bring-up through the AOT "
+                         "compile-artifact cache (real engine) plus the "
+                         "autoscale breach-window replay (round 15)")
     ap.add_argument("--out", type=str, default=None,
                     help="also write a MULTICHIP-style JSON artifact here")
     args = ap.parse_args()
+    if args.bringup:
+        result = bench_bringup(slots=args.slots, segment=args.segment)
+        print(json.dumps(result))
+        if args.out:
+            ab, rp = result["bringup_ab"], result["autoscale_replay"]
+            artifact = {
+                "rc": 0,
+                "ok": (ab["warm"]["compiles"] == 0
+                       and ab["speedup"] >= 5.0
+                       and rp["warm_breach_close_s"]
+                       < rp["cold_breach_close_s"]),
+                "skipped": False,
+                **result,
+                "tail": (
+                    f"cold {ab['cold']['seconds']}s "
+                    f"({ab['cold']['compiles']} compile) | "
+                    f"warm {ab['warm']['seconds']}s "
+                    f"({ab['warm']['compiles']} compiles) | "
+                    f"{ab['speedup']}x | breach close "
+                    f"{rp['cold_breach_close_s']}s -> "
+                    f"{rp['warm_breach_close_s']}s"),
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+        return
     if args.cluster:
         result = bench_cluster(
             requests=args.requests, replicas=args.replicas,

@@ -16,6 +16,29 @@
 #   scripts/lint_gate.sh path --json     # any ko-lint arguments pass through
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# KO140 signature-baseline freshness: regenerate and diff. The findings
+# baseline above tolerates PRE-EXISTING findings, which must never extend
+# to a stale jit-signature baseline — the AOT compile cache folds these
+# entries into its artifact keys (aot/cache.py), so shipping a stale file
+# would serve stale executables. Regenerate-to-the-side and restore, so
+# the working tree is untouched on failure.
+SIG="kubeoperator_tpu/analysis/signatures.json"
+if [[ -f "$SIG" ]]; then
+    SAVED="$(mktemp)"
+    cp "$SIG" "$SAVED"
+    python -m kubeoperator_tpu.analysis.cli --update-signatures \
+        kubeoperator_tpu >/dev/null
+    if ! diff -u "$SAVED" "$SIG"; then
+        cp "$SAVED" "$SIG"
+        rm -f "$SAVED"
+        echo "error: $SIG is stale vs the tree (diff above)" >&2
+        echo "hint: run \`ko lint --update-signatures\` and commit the diff" >&2
+        exit 3
+    fi
+    rm -f "$SAVED"
+fi
+
 BASELINE="${LINT_BASELINE:-scripts/lint_baseline.json}"
 if [[ -f "$BASELINE" ]]; then
     exec python -m kubeoperator_tpu.analysis.cli \

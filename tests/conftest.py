@@ -13,7 +13,12 @@ import os
 # too late — override through jax.config before any backend initialises.
 # Tests want the virtual 8-device CPU mesh regardless of real hardware.
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    # XLA:CPU splits codegen into parallel LLVM modules under the forced
+    # multi-device host platform; serialize_executable drops the split
+    # symbols and deserialize fails with "Symbols not found". One module
+    # keeps AOT artifacts (kubeoperator_tpu/aot) round-trippable on CPU.
+    + " --xla_cpu_parallel_codegen_split_count=1")
 
 import jax
 

@@ -1,0 +1,15 @@
+"""Corpus: silent AOT cache-key drift (KO141) — jax.jit applied to a
+factory's return value. The traced callable's dependency on ``scale`` is
+invisible to the KO140 fingerprint, so changing the captured value would
+not roll the compile-artifact cache key and a warm worker would load the
+stale executable."""
+import jax
+
+
+def make_step(scale):
+    def step(x):
+        return x * scale
+    return step
+
+
+step = jax.jit(make_step(2.0))     # KO141: opaque callable expression

@@ -1,0 +1,396 @@
+"""AOT compile-artifact cache (ISSUE 15): warm bring-up is a load, not a
+trace — zero compile events pinned by the guard, tokens/losses
+bit-identical to the live-compiled path, corrupted/version-skewed
+artifacts fall back to compiling with the miss recorded, and concurrent
+bring-up on one cache directory is race-free (single writer per entry).
+"""
+
+import json
+import os
+import threading
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.analysis import compile_count_guard
+from kubeoperator_tpu.aot import CompileCache, warm
+from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+from kubeoperator_tpu.workloads.generate import generate
+from kubeoperator_tpu.workloads.sharding import MeshSpec
+from kubeoperator_tpu.workloads.transformer import (
+    Transformer, TransformerConfig,
+)
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, max_seq_len=24, dtype=jnp.float32,
+                        remat=False, attention="dense")
+
+MESH_2x4 = MeshSpec(dp=2, tp=4)
+
+needs_8dev = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs 8 devices (conftest forces 8 virtual CPU devices)")
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Transformer(CFG)
+    return nn.unbox(model.init(jax.random.key(7),
+                               jnp.zeros((2, 8), jnp.int32))["params"])
+
+
+def solo(params, prompt, max_tokens):
+    out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), max_tokens)
+    return np.asarray(out)[0].tolist()
+
+
+def drain(eng, track):
+    for _ in range(200):
+        if all(p >= last for p, last in track.values()):
+            break
+        eng.run_segment()
+        for s, (p, last) in track.items():
+            track[s] = (min(p + eng.segment, last), last)
+    buf, _ = eng.poll()
+    return buf
+
+
+def admit_tracked(eng, track, entries):
+    pos = eng.admit(entries)
+    for slot, prompt, mt, _t, _s in entries:
+        track[slot] = (pos[slot], len(prompt) + mt - 1)
+
+
+def decode_all(eng, reqs):
+    track = {}
+    admit_tracked(eng, track, [(s, p, mt, 0.0, 0)
+                               for s, (p, mt) in reqs.items()])
+    buf = drain(eng, track)
+    return {s: buf[s][:len(p) + mt].tolist() for s, (p, mt) in reqs.items()}
+
+
+REQS = {0: ([1, 2, 3, 4, 5], 6),
+        1: ([7, 8, 9, 10, 11, 12, 13, 14], 5),
+        2: ([42], 9),
+        3: ([3, 1, 4, 1, 5, 9, 2], 12)}
+
+
+# ---------------------------------------------------------------------------
+# key anatomy
+# ---------------------------------------------------------------------------
+
+def test_cache_key_rolls_on_every_input(tmp_path):
+    cache = CompileCache(str(tmp_path))
+    args = (jnp.zeros((4, 8), jnp.float32),)
+    base = cache.key_for("f", args)
+    assert base.fingerprint() == cache.key_for("f", args).fingerprint()
+    rolled = [
+        cache.key_for("g", args),                              # name
+        cache.key_for("f", (jnp.zeros((4, 9), jnp.float32),)),  # shape
+        cache.key_for("f", (jnp.zeros((4, 8), jnp.int32),)),    # dtype
+        cache.key_for("f", args, mesh_spec=MESH_2x4),          # mesh
+        cache.key_for("f", args, donate=(0,)),                 # donation
+        cache.key_for("f", args, static=(0,)),                 # static args
+    ]
+    fps = {base.fingerprint()} | {k.fingerprint() for k in rolled}
+    assert len(fps) == 1 + len(rolled), "every key field must roll the key"
+
+
+def test_cache_key_folds_ko140_baseline(tmp_path):
+    """The source half of the key: a baselined function's fingerprint
+    differs from an unbaselined one, and tampering with the checked-in
+    baseline entry rolls the key."""
+    cache = CompileCache(str(tmp_path))
+    args = (jnp.zeros((2,), jnp.float32),)
+    real = cache.key_for("_segment_body", args)
+    assert real.baseline_sig not in ("", "unbaselined")
+    assert cache.key_for("no_such_fn", args).baseline_sig == "unbaselined"
+
+    # tampered baseline -> different source fingerprint -> different key
+    doc = {"version": 1, "signatures": {
+        "x.py::_segment_body": {"function": "_segment_body",
+                                "trace_deps": ["self.other"]}}}
+    alt = tmp_path / "signatures.json"
+    alt.write_text(json.dumps(doc))
+    tampered = CompileCache(str(tmp_path), baseline_path=str(alt))
+    assert (tampered.key_for("_segment_body", args).fingerprint()
+            != real.fingerprint())
+
+
+# ---------------------------------------------------------------------------
+# warm bring-up: zero compiles, bit-identical decode
+# ---------------------------------------------------------------------------
+
+def test_warm_engine_zero_compiles_bit_identical_solo(params, tmp_path):
+    cache = CompileCache(str(tmp_path))
+    with compile_count_guard() as guard:
+        cold = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                              compile_cache=cache)
+    assert cold.aot is not None and not cold.aot.hit
+    assert cold.aot.source == "compile"
+    guard.assert_single_compile("_segment_body")   # the miss is 1 trace
+
+    # second bring-up on the same store: a pure load — ZERO trace events
+    with compile_count_guard() as guard:
+        eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                             compile_cache=cache)
+        out = decode_all(eng, REQS)
+    guard.assert_zero_compiles()
+    assert eng.aot.hit and eng.aot.source == "cache"
+    assert cache.hits == 1 and cache.misses == 1
+    for s, (prompt, mt) in REQS.items():
+        assert out[s] == solo(params, prompt, mt), f"slot {s} diverged"
+
+
+@needs_8dev
+def test_warm_engine_bit_identical_sharded(params, tmp_path):
+    """The 2×4 dp×tp pool through the cache: the mesh is part of the key
+    (a solo artifact must not serve the sharded engine), and the warm
+    sharded engine's greedy tokens stay bit-identical to solo
+    generate()."""
+    cache = CompileCache(str(tmp_path))
+    cold = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                          mesh_spec=MESH_2x4, compile_cache=cache)
+    assert not cold.aot.hit
+    solo_fp = CompileCache(str(tmp_path)).key_for(
+        "_segment_body", (jnp.zeros((1,)),)).fingerprint()
+    assert cold.aot.fingerprint != solo_fp
+
+    with compile_count_guard() as guard:
+        eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                             mesh_spec=MESH_2x4, compile_cache=cache)
+        out = decode_all(eng, REQS)
+    guard.assert_zero_compiles()
+    assert eng.aot.hit
+    for s, (prompt, mt) in REQS.items():
+        assert out[s] == solo(params, prompt, mt), f"slot {s} diverged"
+
+
+def test_warm_trainer_zero_compiles_bit_equal_loss(tmp_path):
+    from kubeoperator_tpu.workloads.train import TrainConfig, Trainer
+
+    cfg = TrainConfig(batch_size=8, image_size=32, num_classes=10,
+                      depth=18, warmup_steps=2, total_steps=10)
+    cache = CompileCache(str(tmp_path))
+
+    def one_step(with_cache):
+        tr = Trainer(cfg, compile_cache=cache if with_cache else None)
+        state = tr.init_state()
+        images, labels = tr.synthetic_batch()
+        state, metrics = tr.train_step(state, images, labels)
+        return tr, float(metrics["loss"])
+
+    _, live_loss = one_step(False)          # the oracle: no cache at all
+    cold, cold_loss = one_step(True)
+    assert not cold.aot.hit and cold_loss == live_loss
+
+    # warm: build trainer/state OUTSIDE the guard (init_state's one-shot
+    # jit legitimately traces), step INSIDE — the step is a pure load
+    tr = Trainer(cfg, compile_cache=cache)
+    state = tr.init_state()
+    images, labels = tr.synthetic_batch()
+    with compile_count_guard() as guard:
+        state, metrics = tr.train_step(state, images, labels)
+    guard.assert_zero_compiles()
+    assert tr.aot.hit
+    assert float(metrics["loss"]) == live_loss
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: corrupt / version-skewed artifacts fall back
+# ---------------------------------------------------------------------------
+
+def _single_entry_dir(cache):
+    rows = cache.entries()
+    assert len(rows) == 1
+    return os.path.join(cache.root, rows[0]["name"], rows[0]["fingerprint"])
+
+
+def test_corrupted_artifact_falls_back_and_records_miss(params, tmp_path):
+    cache = CompileCache(str(tmp_path))
+    SlotPoolEngine(CFG, params, slots=4, segment=3, compile_cache=cache)
+    entry = _single_entry_dir(cache)
+    with open(os.path.join(entry, "artifact.bin"), "wb") as fh:
+        fh.write(b"\x00not a pickle")
+
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    assert not eng.aot.hit, "a corrupt artifact must never count as a hit"
+    assert cache.misses == 2 and cache.hits == 0
+    out = decode_all(eng, {0: ([5, 6, 7], 6)})
+    assert out[0] == solo(params, [5, 6, 7], 6)
+    # the corrupt entry was quarantined and a fresh artifact written back:
+    # the NEXT bring-up hits again
+    nxt = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    assert nxt.aot.hit
+
+
+def test_version_mismatched_artifact_falls_back(params, tmp_path):
+    cache = CompileCache(str(tmp_path))
+    SlotPoolEngine(CFG, params, slots=4, segment=3, compile_cache=cache)
+    entry = _single_entry_dir(cache)
+    meta_path = os.path.join(entry, "meta.json")
+    with open(meta_path, encoding="utf-8") as fh:
+        meta = json.load(fh)
+    meta["key"]["jax_version"] = "0.0.1"
+    with open(meta_path, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    assert not eng.aot.hit
+    assert cache.misses == 2
+    # deserializing a pickle whose versions don't match ours must never
+    # have been attempted — the quarantined dir proves the meta gate fired
+    assert any(".corrupt-" in d for d in os.listdir(os.path.dirname(entry)))
+
+
+def test_pickle_never_loaded_for_hlo_entries(params, tmp_path):
+    """A meta kind other than "executable" (the HLO fallback) is not
+    deserialized — the consult recompiles instead of unpickling
+    arbitrary bytes under the wrong kind."""
+    cache = CompileCache(str(tmp_path))
+    SlotPoolEngine(CFG, params, slots=4, segment=3, compile_cache=cache)
+    entry = _single_entry_dir(cache)
+    meta_path = os.path.join(entry, "meta.json")
+    with open(meta_path, encoding="utf-8") as fh:
+        meta = json.load(fh)
+    meta["kind"] = "hlo"
+    with open(meta_path, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    assert not eng.aot.hit and eng.aot.source in ("compile", "hlo_fallback")
+
+
+# ---------------------------------------------------------------------------
+# concurrency: two engines, one cache dir, single writer per entry
+# ---------------------------------------------------------------------------
+
+def test_concurrent_bringup_race_free(params, tmp_path):
+    results, errors = {}, []
+
+    def bring_up(tag):
+        try:
+            cache = CompileCache(str(tmp_path))
+            eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                                 compile_cache=cache)
+            results[tag] = (eng, cache)
+        except Exception as e:  # noqa: BLE001 — surfaced via the assert
+            errors.append((tag, e))
+
+    threads = [threading.Thread(target=bring_up, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # whoever lost the publish race discarded its copy: exactly one
+    # published entry, no temp dirs left behind
+    cache = CompileCache(str(tmp_path))
+    assert len(cache.entries()) == 1
+    leftovers = [d for d in os.listdir(os.path.join(cache.root,
+                                                    "_segment_body"))
+                 if ".tmp-" in d]
+    assert leftovers == []
+    # and both engines decode correctly regardless of who won
+    for tag, (eng, _) in results.items():
+        out = decode_all(eng, {0: ([5, 6, 7], 6)})
+        assert out[0] == solo(params, [5, 6, 7], 6), f"engine {tag}"
+
+
+# ---------------------------------------------------------------------------
+# control plane: warm catalog, purge refusal, status, metrics
+# ---------------------------------------------------------------------------
+
+def test_warm_catalog_then_all_hits(tmp_path):
+    cache = CompileCache(str(tmp_path))
+    rows = warm(cache, ["serve-smoke"])
+    assert rows[0]["entry"] == "serve-smoke"
+    assert rows[0]["function"] == "_segment_body"
+    assert rows[0]["hit"] is False
+    again = warm(CompileCache(str(tmp_path)), ["serve-smoke"])
+    assert again[0]["hit"] is True
+    assert again[0]["fingerprint"] == rows[0]["fingerprint"]
+    with pytest.raises(KeyError, match="no-such-entry"):
+        warm(cache, ["no-such-entry"])
+
+
+def test_purge_refuses_in_use_entries(params, tmp_path):
+    cache = CompileCache(str(tmp_path))
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    fp = eng.aot.fingerprint
+    out = cache.purge()
+    assert out["removed"] == [] and out["refused"] == [fp]
+
+    # the refusal is cross-process: a FRESH cache object (no in-process
+    # set) still sees the live pid marker
+    other = CompileCache(str(tmp_path))
+    out = other.purge(fp)
+    assert out["refused"] == [fp]
+    out = other.purge(fp, force=True)
+    assert out["removed"] == [fp]
+    assert other.entries() == []
+
+
+def test_status_and_metrics_flow(params, tmp_path):
+    from kubeoperator_tpu.telemetry.metrics import REGISTRY
+
+    cache = CompileCache(str(tmp_path))
+    SlotPoolEngine(CFG, params, slots=4, segment=3, compile_cache=cache)
+    st = cache.status()
+    assert st["root"] == str(tmp_path)
+    assert st["count"] == 1 and st["misses"] == 1 and st["hits"] == 0
+    assert st["total_bytes"] > 0
+    text = REGISTRY.render()
+    assert 'ko_aot_cache_misses_total{fn="_segment_body"}' in text
+    assert "ko_aot_bringup_seconds_bucket" in text
+
+
+def test_serve_trace_carries_aot_event(params, tmp_path):
+    """The batcher annotates in-flight request traces with the engine's
+    bring-up outcome, so `ko trace --serve` answers "did this replica
+    warm-start?" per request."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        ServeTracer, ServeTraceStore,
+    )
+    from kubeoperator_tpu.workloads.serving import ContinuousBatcher
+
+    cache = CompileCache(str(tmp_path))
+    SlotPoolEngine(CFG, params, slots=4, segment=3, compile_cache=cache)
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=3,
+                         compile_cache=cache)
+    store = ServeTraceStore()
+    cb = ContinuousBatcher(eng, tracer=ServeTracer(store))
+    out = cb.submit([5, 6, 7], 6)
+    assert out == solo(params, [5, 6, 7], 6)
+    recs = store.records()
+    assert recs, "submit must leave a finished request trace"
+    events = [e for sp in recs[-1].spans for e in sp.get("events", ())
+              if e.get("name") == "aot"]
+    assert events and events[0]["hit"] is True
+    assert "seconds" in events[0]
+
+
+# ---------------------------------------------------------------------------
+# the checked-in bring-up artifact: warm >= 5x faster than cold
+# ---------------------------------------------------------------------------
+
+def test_bringup_artifact_holds_the_line():
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MULTICHIP_serving_r04.json")
+    with open(path, encoding="utf-8") as fh:
+        art = json.load(fh)
+    ab = art["bringup_ab"]
+    assert ab["cold"]["compiles"] >= 1
+    assert ab["warm"]["compiles"] == 0, \
+        "warm bring-up must perform ZERO compiles"
+    assert ab["speedup"] >= 5.0, \
+        f"warm bring-up must be >=5x faster than cold, got {ab['speedup']}"
+    assert art["autoscale_replay"]["warm_breach_close_s"] \
+        < art["autoscale_replay"]["cold_breach_close_s"]

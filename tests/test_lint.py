@@ -35,8 +35,11 @@ PKG = os.path.join(REPO, "kubeoperator_tpu")
 GOLDEN = {
     "bad_host_loop.py": {"KO101", "KO102"},
     "bad_donation.py": {"KO110", "KO111"},
-    "bad_retrace.py": {"KO112"},
+    # the per-iteration jit wraps an opaque parameter, so it is also
+    # invisible to the KO140 fingerprint (KO141)
+    "bad_retrace.py": {"KO112", "KO141"},
     "bad_closure.py": {"KO113"},
+    "bad_cache_key.py": {"KO141"},
     "bad_unpinned.py": {"KO120"},
     "bad_page_write.py": {"KO121"},
     "bad_collective_loop.py": {"KO130"},
