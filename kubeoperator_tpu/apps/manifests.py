@@ -299,10 +299,13 @@ data:
         "targets": [{{"expr": "sum(ko_serve_kv_pages_used)"}},
                     {{"expr": "sum(ko_serve_kv_pages_used) by (shard)", "legendFormat": "shard {{{{shard}}}}"}},
                     {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}},
-      {{"title": "SLO burn rate (by slo, fast/slow window)", "type": "timeseries", "gridPos": {{"x":12,"y":24,"w":12,"h":8}},
-        "targets": [{{"expr": "ko_slo_burn_rate", "legendFormat": "{{{{slo}}}} {{{{window}}}}"}},
-                    {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment"}},
+      {{"title": "SLO burn rate (by slo, fast/slow window, tenant)", "type": "timeseries", "gridPos": {{"x":12,"y":24,"w":12,"h":8}},
+        "targets": [{{"expr": "ko_slo_burn_rate", "legendFormat": "{{{{slo}}}} {{{{window}}}} {{{{tenant}}}}"}},
+                    {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment {{{{tenant}}}}"}},
                     {{"expr": "sum(rate(ko_serve_requests_requeued_total[5m])) by (reason)", "legendFormat": "requeued {{{{reason}}}}"}}]}},
+      {{"title": "QoS: sheds by tenant/reason, preemptions by victim tenant", "type": "timeseries", "gridPos": {{"x":0,"y":56,"w":24,"h":8}},
+        "targets": [{{"expr": "sum(rate(ko_serve_shed_total[5m])) by (tenant, reason)", "legendFormat": "shed {{{{tenant}}}} {{{{reason}}}}"}},
+                    {{"expr": "sum(rate(ko_serve_preemptions_total[5m])) by (tenant)", "legendFormat": "preempt {{{{tenant}}}}"}}]}},
       {{"title": "TTFT decomposition: queue vs device vs host-blocked", "type": "timeseries", "gridPos": {{"x":0,"y":32,"w":12,"h":8}},
         "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_ttft_seconds_bucket[5m])) by (le))"}},
                     {{"expr": "histogram_quantile(0.95, sum(rate(ko_serve_segment_device_seconds_bucket[5m])) by (le))"}},

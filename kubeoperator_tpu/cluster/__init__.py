@@ -5,8 +5,8 @@ page-handoff workers."""
 
 from kubeoperator_tpu.cluster.disagg import PrefillWorker, aligned_prefix
 from kubeoperator_tpu.cluster.gateway import (
-    POLICIES, AggregateStats, ServeGateway,
+    POLICIES, PRIORITIES, QOS_MODES, AggregateStats, ServeGateway, ShedError,
 )
 
-__all__ = ["POLICIES", "AggregateStats", "PrefillWorker", "ServeGateway",
-           "aligned_prefix"]
+__all__ = ["POLICIES", "PRIORITIES", "QOS_MODES", "AggregateStats",
+           "PrefillWorker", "ServeGateway", "ShedError", "aligned_prefix"]

@@ -40,17 +40,140 @@ replica as block-table page lists (``engine.import_prefix``) before the
 request is submitted — so the decode replica's admission sees a prefix
 hit and its in-flight decodes stop losing segment time to other
 tenants' prefills.
+
+Multi-tenant QoS (round 16): construct with ``tenants={name: {rate,
+burst, weight, priority, deadline_s}}`` and ``submit`` grows tenant
+identity + a priority class (``latency`` | ``batch``). Requests then
+flow through the gateway's own per-tenant queues instead of straight
+into a replica:
+
+* **admission** — a per-tenant token bucket (``rate`` req/s refill,
+  ``burst`` capacity). Below saturation an over-rate tenant merely
+  borrows (its bucket goes into debt, floored at ``-burst``); above
+  saturation (cluster backlog at ``shed_after``) its requests are
+  deliberately **shed** with a ``ShedError`` carrying ``retry_after_s``
+  — the time the bucket needs to refill back to one token — instead of
+  queueing without bound. A request whose ``deadline_s`` is shorter
+  than that refill sheds as ``deadline``; one that out-waits its
+  deadline in the queue sheds as ``expired`` at dispatch.
+* **weighted-fair dequeue** — the dispatcher serves tenant queues by
+  virtual time (cost ``prompt+max_tokens`` over ``weight``), with
+  latency-class heads strictly ahead of batch-class heads, and it
+  dispatches batch work only into replica room (backlog below
+  ``spill_after``) so one tenant's burst cannot bury the replica
+  queues FIFO-style. Latency-class requests bypass the room gate and
+  enter their replica's queue at the *head*.
+* **priority preemption** — a latency-class request routed to a
+  replica with zero free slots may evict the newest batch-class
+  in-flight victim (``ContinuousBatcher.preempt``, the drain protocol
+  narrowed to one slot). The victim re-enters the gateway queue,
+  re-routes, and re-prefills — greedy decode is deterministic and
+  sampling (seed, position)-keyed, so its reply stays bit-identical
+  to an undisturbed solo ``generate()``.
+
+Without ``tenants`` the gateway is exactly the pre-QoS router: submit
+routes and delegates directly, nothing is shed, nothing preempts.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Sequence
 
 from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.workloads.serving import _Pending
 
 POLICIES = ("sticky_prefix", "round_robin", "least_loaded")
+PRIORITIES = ("latency", "batch")
+QOS_MODES = ("fair", "fifo")
+
+#: bounded per-tenant latency/TTFT sample windows (p95 estimation)
+_SAMPLE_WINDOW = 512
+
+
+class ShedError(RuntimeError):
+    """Deliberate overload rejection: the gateway refused to queue this
+    request. ``retry_after_s`` is the contract — the client should back
+    off at least that long (the tenant's token bucket will have
+    refilled to one token by then). ``reason`` is ``rate`` (over the
+    tenant's admission rate at saturation), ``deadline`` (the required
+    backoff already exceeds the request's deadline), or ``expired``
+    (the request out-waited its deadline in the gateway queue)."""
+
+    def __init__(self, tenant: str, reason: str, retry_after_s: float):
+        super().__init__(
+            f"shed for tenant {tenant!r} ({reason}): retry after "
+            f"{retry_after_s:.3f}s")
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class _Tenant:
+    """Per-tenant QoS state, all mutated under the gateway lock: the
+    token bucket, the weighted-fair queue + virtual time, and the
+    observability the per-tenant SLO verdicts read."""
+
+    __slots__ = ("name", "rate", "burst", "weight", "priority",
+                 "deadline_s", "tokens", "refilled_at", "vtime", "queue",
+                 "submitted", "finished", "shed", "preempted",
+                 "ttft_samples", "latency_samples")
+
+    def __init__(self, name: str, spec: dict | None = None):
+        spec = spec or {}
+        self.name = name
+        self.rate = float(spec.get("rate", float("inf")))
+        self.burst = float(spec.get("burst", float("inf")))
+        self.weight = float(spec.get("weight", 1.0))
+        self.priority = spec.get("priority", "latency")
+        self.deadline_s = spec.get("deadline_s")
+        if self.rate <= 0 or self.burst <= 0:
+            raise ValueError(f"tenant {name!r}: rate and burst must be > 0")
+        if self.weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        if self.priority not in PRIORITIES:
+            raise ValueError(f"tenant {name!r}: priority must be one of "
+                             f"{PRIORITIES}, got {self.priority!r}")
+        self.tokens = self.burst
+        self.refilled_at = time.monotonic()
+        self.vtime = 0.0
+        self.queue: deque = deque()
+        self.submitted = 0
+        self.finished = 0
+        self.shed: dict[str, int] = {}
+        self.preempted = 0
+        self.ttft_samples: deque = deque(maxlen=_SAMPLE_WINDOW)
+        self.latency_samples: deque = deque(maxlen=_SAMPLE_WINDOW)
+
+    def refill(self, now: float) -> None:
+        if self.rate == float("inf"):
+            self.tokens = self.burst
+            return
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.refilled_at) * self.rate)
+        self.refilled_at = now
+
+    def spend(self) -> None:
+        if self.rate == float("inf"):
+            return
+        # debt floored at -burst: a 10x burst pays back at most one full
+        # bucket of backoff, it is not locked out for the burst's length
+        self.tokens = max(self.tokens - 1.0, -self.burst)
+
+    def retry_after(self) -> float:
+        """Seconds until the bucket refills back to one token."""
+        if self.rate == float("inf") or self.tokens >= 1.0:
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+def _p95(samples) -> float | None:
+    if not samples:
+        return None
+    vals = sorted(samples)
+    return vals[min(len(vals) - 1, int(0.95 * len(vals)))]
 
 
 class AggregateStats:
@@ -134,7 +257,9 @@ class ServeGateway:
     def __init__(self, batchers: Sequence[Any], *,
                  policy: str = "sticky_prefix", affinity_pages: int = 1,
                  spill_after: int | None = None, prefill_worker: Any = None,
-                 handoff_min_pages: int = 1):
+                 handoff_min_pages: int = 1,
+                 tenants: dict[str, dict] | None = None,
+                 qos: str = "fair", shed_after: int | None = None):
         if not batchers:
             raise ValueError("ServeGateway needs at least one batcher")
         if policy not in POLICIES:
@@ -143,6 +268,8 @@ class ServeGateway:
         if affinity_pages < 1:
             raise ValueError(f"affinity_pages must be >= 1, "
                              f"got {affinity_pages}")
+        if qos not in QOS_MODES:
+            raise ValueError(f"qos must be one of {QOS_MODES}, got {qos!r}")
         self.policy = policy
         self.affinity_pages = int(affinity_pages)
         self._page = int(getattr(batchers[0].engine, "page", 16))
@@ -164,6 +291,20 @@ class ServeGateway:
         self._handoff_pages = 0
         self._requeued_total = 0
         self._handed: list[set[tuple[int, ...]]] = [set() for _ in batchers]
+        # -- multi-tenant QoS state (all under _lock) -----------------------
+        self.qos = tenants is not None
+        self._qos_mode = qos
+        self._tenants: dict[str, _Tenant] = {
+            name: _Tenant(name, spec) for name, spec in (tenants or {}).items()
+        }
+        # saturation for deliberate shedding: the whole cluster's spill
+        # depth — beyond it queueing is unbounded latency, not buffering
+        self._shed_after = (int(shed_after) if shed_after is not None
+                            else len(batchers) * self._spill_after)
+        self._vclock = 0.0                  # weighted-fair virtual clock
+        self._fifo: deque = deque()         # qos="fifo" baseline queue
+        self._shed_total = 0
+        self._preempted_total = 0
         for r in self.replicas:
             r.batcher.requeue_sink = self._sink
             r.batcher.replica = r.index
@@ -174,14 +315,113 @@ class ServeGateway:
     # -- client side --------------------------------------------------------
     def submit(self, prompt_ids: Sequence[int], max_tokens: int,
                temperature: float = 0.0, seed: int = 0,
-               timeout: float | None = 300.0) -> list[int]:
+               timeout: float | None = 300.0, tenant: str | None = None,
+               priority: str | None = None,
+               deadline_s: float | None = None) -> list[int]:
         prompt = list(prompt_ids)
-        idx, decision = self._route(prompt)
-        tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
-        if self._prefill is not None:
-            self._maybe_handoff(idx, prompt)
-        return self.replicas[idx].batcher.submit(
-            prompt, max_tokens, temperature, seed, timeout=timeout)
+        if not self.qos:
+            # pre-QoS direct path: route and delegate (tenant identity is
+            # accepted but unenforced — nothing to admit against)
+            idx, decision = self._route(prompt)
+            tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+            if self._prefill is not None:
+                self._maybe_handoff(idx, prompt)
+            return self.replicas[idx].batcher.submit(
+                prompt, max_tokens, temperature, seed, timeout=timeout)
+        return self._submit_qos(prompt, int(max_tokens), float(temperature),
+                                int(seed), timeout, tenant or "default",
+                                priority, deadline_s)
+
+    def _validate(self, prompt: list[int], max_tokens: int) -> None:
+        """The batcher's submit-side validation, applied here because the
+        QoS path enters replicas through ``inject`` (which trusts its
+        caller). Every replica engine is homogeneous by construction."""
+        eng = self.replicas[0].batcher.engine
+        if not prompt:
+            raise ValueError("prompt_ids must be non-empty")
+        if len(prompt) + max_tokens > eng.max_total:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
+                f"exceed max_seq_len ({eng.max_total})")
+        if hasattr(eng, "pages_for"):
+            need = eng.pages_for(len(prompt), max_tokens)
+            if need > eng.max_request_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages but one dp shard only "
+                    f"has {eng.max_request_pages} allocatable "
+                    f"(pages={eng.pages}, page={eng.page}): "
+                    f"it could never be admitted")
+
+    def _tenant(self, name: str) -> _Tenant:
+        # unknown tenants get an unmetered default policy: identity and
+        # per-tenant observability always work, limits are opt-in
+        t = self._tenants.get(name)
+        if t is None:
+            # ko: lint-ok[KO201] caller holds _lock: every _tenant call site runs inside _gcond/_lock
+            t = self._tenants[name] = _Tenant(name)
+        return t
+
+    def _submit_qos(self, prompt: list[int], max_tokens: int,
+                    temperature: float, seed: int, timeout: float | None,
+                    tenant: str, priority: str | None,
+                    deadline_s: float | None) -> list[int]:
+        self._validate(prompt, max_tokens)
+        req = _Pending(prompt, max_tokens, temperature, seed)
+        with self._gcond:
+            t = self._tenant(tenant)
+            req.tenant = tenant
+            req.priority = priority if priority is not None else t.priority
+            if req.priority not in PRIORITIES:
+                raise ValueError(f"priority must be one of {PRIORITIES}, "
+                                 f"got {req.priority!r}")
+            req.deadline_s = (float(deadline_s) if deadline_s is not None
+                              else t.deadline_s)
+            t.refill(time.monotonic())
+            # fifo mode is the no-QoS baseline: per-tenant accounting
+            # only — admission never sheds, arrival order rules
+            if self._qos_mode == "fair" \
+                    and self._overloaded_locked() and t.tokens < 1.0:
+                retry = t.retry_after()
+                reason = ("deadline" if req.deadline_s is not None
+                          and retry >= req.deadline_s else "rate")
+                raise self._shed_locked(t, reason, retry)
+            t.spend()
+            t.submitted += 1
+            if max_tokens == 0:
+                # the batcher's mt==0 fast path, kept at the gateway so
+                # the reply (= the prompt) never burns queue time
+                t.finished += 1
+                return list(prompt)
+            if self._qos_mode == "fifo":
+                self._fifo.append(req)
+            else:
+                if not t.queue:
+                    # newly backlogged: forfeit idle credit so a tenant
+                    # can't hoard virtual time and starve the others
+                    t.vtime = max(t.vtime, self._vclock)
+                t.queue.append(req)
+            self._gcond.notify()
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        with self._lock:
+            t.finished += 1
+            if req.ttft_s is not None:
+                t.ttft_samples.append(req.ttft_s)
+            t.latency_samples.append(time.monotonic() - req.submitted_at)
+        return req.result
+
+    def _overloaded_locked(self) -> bool:
+        return self.backlog() >= self._shed_after
+
+    def _shed_locked(self, t: _Tenant, reason: str,
+                     retry_after_s: float) -> ShedError:
+        t.shed[reason] = t.shed.get(reason, 0) + 1
+        # ko: lint-ok[KO201] caller holds _lock: _shed_locked runs inside _submit_qos/_dispatch_one lock scopes
+        self._shed_total += 1
+        tm.SERVE_SHED.inc(tenant=t.name, reason=reason)
+        return ShedError(t.name, reason, retry_after_s)
 
     # -- routing ------------------------------------------------------------
     def _sticky_key(self, prompt: list[int]) -> int | None:
@@ -319,33 +559,172 @@ class ServeGateway:
     def _dispatch_loop(self) -> None:
         while True:
             with self._gcond:
-                while not self._gq or all(r.draining for r in self.replicas):
-                    self._gcond.wait()
-                batch = sorted(self._gq, key=lambda r: r.submitted_at)
+                batch, fresh = self._dispatch_wait_locked()
+            if batch:
+                self._reroute(batch)
+            for req in fresh:
+                self._dispatch_one(req)
+
+    def _dispatch_wait_locked(self) -> tuple[list, list]:
+        """Block until there is dispatchable work: requeue victims, or
+        QoS-queued requests with somewhere to go. Batch-class work parked
+        behind full replicas polls on a short timeout (nothing notifies
+        the gateway when a replica retires a request)."""
+        while True:
+            alive = not all(r.draining for r in self.replicas)
+            batch: list = []
+            if alive and self._gq:
+                batch = sorted(self._gq,
+                               key=lambda r: (r.submitted_at, r.seq))
                 self._gq.clear()
-            groups: dict[int, list] = {}
-            for i, req in enumerate(batch):
-                try:
-                    idx, decision = self._route(req.prompt_ids, requeue=True)
-                except RuntimeError:
-                    # lost the race with a concurrent drain_replica — park
-                    # the rest and wait for a readmit to wake us
-                    with self._gcond:
-                        self._gq.extend(batch[i:])
-                    break
-                tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
-                groups.setdefault(idx, []).append(req)
-            for idx, rs in groups.items():
-                # front=True: drained victims are the oldest requests in
-                # the cluster and re-enter ahead of fresh arrivals
-                self.replicas[idx].batcher.inject(rs, front=True)
+            fresh = self._dequeue_qos_locked() if alive else []
+            if batch or fresh:
+                return batch, fresh
+            parked = alive and (bool(self._fifo) or any(
+                t.queue for t in self._tenants.values()))
+            self._gcond.wait(0.005 if parked else None)
+
+    def _qos_room_locked(self) -> int:
+        """How many more requests the healthy replicas can absorb before
+        saturation — the dispatch budget for batch-class work, so one
+        tenant's burst queues HERE (where fairness and shedding apply),
+        not FIFO inside the replicas."""
+        return sum(max(0, self._spill_after - r.batcher.backlog())
+                   for r in self.replicas if not r.draining)
+
+    def _dequeue_qos_locked(self) -> list:
+        if not self.qos:
+            return []
+        room = self._qos_room_locked()
+        if self._qos_mode == "fifo":
+            out = []
+            while self._fifo and room > 0:
+                out.append(self._fifo.popleft())
+                room -= 1
+            return out
+        out = []
+        while True:
+            ready = [t for t in self._tenants.values() if t.queue]
+            pool = [t for t in ready if t.queue[0].priority == "latency"]
+            if not pool and room > 0:
+                pool = ready
+            if not pool:
+                return out
+            t = min(pool, key=lambda x: (x.vtime, x.name))
+            req = t.queue.popleft()
+            if req.priority == "batch":
+                room -= 1
+            # ko: lint-ok[KO201] caller holds _lock: _dequeue_qos_locked runs inside the dispatcher's _gcond wait scope
+            self._vclock = t.vtime
+            t.vtime += (len(req.prompt_ids) + req.max_tokens) / t.weight
+            out.append(req)
+
+    def _reroute(self, batch: list) -> None:
+        """The requeue path: drained/preempted victims re-route and
+        re-enter their new replica's queue at the head (they are the
+        oldest requests in the cluster)."""
+        groups: dict[int, list] = {}
+        for i, req in enumerate(batch):
+            try:
+                idx, decision = self._route(req.prompt_ids, requeue=True)
+            except RuntimeError:
+                # lost the race with a concurrent drain_replica — park
+                # the rest and wait for a readmit to wake us
+                with self._gcond:
+                    self._gq.extend(batch[i:])
+                break
+            tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+            groups.setdefault(idx, []).append(req)
+        for idx, rs in groups.items():
+            self.replicas[idx].batcher.inject(rs, front=True)
+
+    def _dispatch_one(self, req) -> None:
+        """Route one QoS-admitted request. Deadline-aware: a request
+        that out-waited its ``deadline_s`` in the gateway queue sheds
+        here (``expired``) instead of wasting a slot on a reply its
+        client has abandoned. The fifo baseline never sheds."""
+        if self._qos_mode == "fair" and req.deadline_s is not None and \
+                time.monotonic() - req.submitted_at > req.deadline_s:
+            with self._lock:
+                t = self._tenant(req.tenant)
+                t.refill(time.monotonic())
+                req.error = self._shed_locked(t, "expired",
+                                              max(t.retry_after(), 0.0))
+            req.done.set()
+            return
+        try:
+            idx, decision = self._route(req.prompt_ids)
+        except RuntimeError:
+            # every replica draining: park as a requeue victim; a
+            # readmit wakes the dispatcher and re-routes it
+            with self._gcond:
+                self._gq.append(req)
+            return
+        tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+        front = False
+        if req.priority == "latency" and self._qos_mode == "fair":
+            front = True        # latency class enters at the queue head
+            self._maybe_preempt(idx)
+        if self._prefill is not None:
+            self._maybe_handoff(idx, req.prompt_ids)
+        self.replicas[idx].batcher.inject([req], front=front)
+
+    def _maybe_preempt(self, idx: int) -> None:
+        """A latency-class request is about to land on replica ``idx``:
+        if the replica has zero free slots and a batch-class victim in
+        flight, evict the newest victim (least decode progress lost) so
+        the latency request admits next wave instead of waiting out a
+        whole batch decode."""
+        r = self.replicas[idx]
+        if r.batcher.free_slots() > 0:
+            return
+        victims = r.batcher.preemptible("batch")
+        if not victims:
+            return
+        slot, victim = victims[0]
+        try:
+            r.batcher.preempt([slot], reason="preempt")
+        except (TimeoutError, ValueError):
+            return              # the victim retired first — nothing lost
+        tm.SERVE_PREEMPTIONS.inc(tenant=victim.tenant)
+        with self._lock:
+            self._tenant(victim.tenant).preempted += 1
+            self._preempted_total += 1
 
     # -- observability -------------------------------------------------------
     def backlog(self) -> int:
-        """Cluster-wide queued + in-flight requests (gateway queue
-        included), same contract as ``ContinuousBatcher.backlog``."""
-        return (len(self._gq)
+        """Cluster-wide queued + in-flight requests (gateway requeue and
+        QoS tenant queues included), same contract as
+        ``ContinuousBatcher.backlog``."""
+        return (len(self._gq) + len(self._fifo)
+                + sum(len(t.queue) for t in self._tenants.values())
                 + sum(r.batcher.backlog() for r in self.replicas))
+
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant QoS state the monitor's tenant SLO dimension and
+        the scenario harness sample each beat: admission counters, shed
+        breakdown by reason, preemption victims, queue depth, and p95
+        TTFT/latency over the bounded sample windows (None before any
+        observation, the monitor's no-data convention)."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for name in sorted(self._tenants):
+                t = self._tenants[name]
+                out[name] = {
+                    "priority": t.priority,
+                    "weight": t.weight,
+                    "submitted": t.submitted,
+                    "finished": t.finished,
+                    "shed": dict(t.shed),
+                    "shed_total": sum(t.shed.values()),
+                    "preempted_total": t.preempted,
+                    "queue_depth": len(t.queue),
+                    "tokens": (None if t.rate == float("inf")
+                               else round(t.tokens, 3)),
+                    "ttft_p95_s": _p95(t.ttft_samples),
+                    "latency_p95_s": _p95(t.latency_samples),
+                }
+            return out
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -362,4 +741,8 @@ class ServeGateway:
                 "handoff_pages": self._handoff_pages,
                 "requeued_total": self._requeued_total,
                 "gateway_queue_depth": len(self._gq),
+                "qos": (self._qos_mode if self.qos else None),
+                "tenants": len(self._tenants),
+                "shed_total": self._shed_total,
+                "preempted_total": self._preempted_total,
             }

@@ -371,6 +371,21 @@ def cmd_scenario(args) -> int:
               f"chaos {r['chaos']['injected_total']} · "
               f"requeued {r['requeued_total']} · breaches {breaches} · "
               f"bit_exact {r['bit_exact']}")
+        for wname, w in r["workloads"].items():
+            # multi-tenant workloads: one verdict line per tenant, plus
+            # the shed/preemption tally the QoS gateway accumulated
+            for tname, tslos in sorted((w.get("tenant_slos") or {}).items()):
+                states = {s.get("state") for s in tslos.values()}
+                tverdict = ("breach" if "breach" in states
+                            else "ok" if "ok" in states else "no_data")
+                print(f"  {wname}/{tname}: {tverdict}")
+            if w.get("sheds", {}).get("total"):
+                sh = w["sheds"]
+                print(f"  {wname}: shed {sh['total']} "
+                      f"(retry-after on {sh['with_retry_after']}) "
+                      f"by_reason {sh['by_reason']}")
+            if w.get("preempted_total"):
+                print(f"  {wname}: preempted {w['preempted_total']}")
     if args.out:
         print(f"wrote {args.out}")
     if args.check and not artifact["ok"]:

@@ -18,7 +18,12 @@ Extensions over the bench-era version, all backward compatible:
   submit error);
 * the returned dict carries ``results`` so callers can check replies
   token-for-token (the replay's bit-exactness gate), not just count
-  throughput.
+  throughput;
+* ``tenants`` — per-request tenant labels forwarded to a QoS gateway's
+  ``submit``; with tenants given, a ``ShedError`` is *data*, not a
+  failure — shed indices land in the returned ``sheds`` dict (with the
+  gateway's reason + ``retry_after_s`` hint) and are excluded from the
+  reply assertions and the token count.
 """
 
 from __future__ import annotations
@@ -27,28 +32,42 @@ import threading
 import time
 from typing import Callable, Sequence
 
+from kubeoperator_tpu.cluster.gateway import ShedError
+
 
 def run_load(batcher, trace: Sequence[tuple[list[int], int]],
              stagger_s: float = 0.0, *,
              offsets: Sequence[float] | None = None,
              timeout: float = 120.0,
              on_result: Callable[[int, list[int], int, list[int]], None]
-             | None = None) -> dict:
+             | None = None,
+             tenants: Sequence[str] | None = None) -> dict:
     """Replay the trace with staggered client threads; aggregate tok/s
     counts only the NEW tokens each request asked for."""
     if offsets is not None and len(offsets) != len(trace):
         raise ValueError(f"offsets ({len(offsets)}) must match the trace "
                          f"({len(trace)})")
+    if tenants is not None and len(tenants) != len(trace):
+        raise ValueError(f"tenants ({len(tenants)}) must match the trace "
+                         f"({len(trace)})")
     results: dict[int, list[int]] = {}
+    sheds: dict[int, dict] = {}
     errors: list[Exception] = []
 
     def client(i, delay, prompt, max_tokens):
         time.sleep(delay)
         try:
-            got = batcher.submit(prompt, max_tokens, timeout=timeout)
+            if tenants is not None:
+                got = batcher.submit(prompt, max_tokens, timeout=timeout,
+                                     tenant=tenants[i])
+            else:
+                got = batcher.submit(prompt, max_tokens, timeout=timeout)
             results[i] = got
             if on_result is not None:
                 on_result(i, prompt, max_tokens, got)
+        except ShedError as e:      # a deliberate QoS verdict, not a crash
+            sheds[i] = {"tenant": e.tenant, "reason": e.reason,
+                        "retry_after_s": e.retry_after_s}
         except Exception as e:  # noqa: BLE001 — surfaced below
             errors.append(e)
 
@@ -64,10 +83,12 @@ def run_load(batcher, trace: Sequence[tuple[list[int], int]],
     wall = time.perf_counter() - t0
     if errors:
         raise errors[0]
-    tokens = sum(mt for _, mt in trace)
+    tokens = sum(mt for i, (_, mt) in enumerate(trace) if i not in sheds)
     for i, (prompt, mt) in enumerate(trace):
+        if i in sheds:
+            continue
         got = results[i]
         assert got[:len(prompt)] == list(prompt), f"request {i} lost prompt"
         assert len(got) == len(prompt) + mt, f"request {i} wrong length"
     return {"wall_s": wall, "tokens": tokens, "tok_s": tokens / wall,
-            "results": results}
+            "results": results, "sheds": sheds}

@@ -40,7 +40,7 @@ from kubeoperator_tpu.scenario.engines import (
     VOCAB, FakePagedEngine, FakeSlotEngine, fake_row,
 )
 from kubeoperator_tpu.scenario.spec import validate_spec
-from kubeoperator_tpu.scenario.traces import build_trace
+from kubeoperator_tpu.scenario.traces import build_trace_tenants
 from kubeoperator_tpu.services.monitor import (
     evaluate_slos, serve_history_point,
 )
@@ -70,20 +70,31 @@ class _Stage:
     through a ``ServeGateway`` over that many batcher+engine replicas
     (``router`` picks the policy) — same driver, same sampling, same
     verdict, because the gateway speaks the batcher's submit/stats
-    protocol."""
+    protocol. A ``tenants`` policy dict (round 16) also fronts the
+    stream with a gateway — even single-replica — in QoS mode, with
+    ``tenant_labels`` tagging each trace request and per-tenant
+    sub-points riding every history sample."""
 
     def __init__(self, name: str, espec: dict, slos: dict | None,
                  trace=None, offsets=None, replicas: int = 1,
-                 router: str = "sticky_prefix"):
+                 router: str = "sticky_prefix", tenants: dict | None = None,
+                 tenant_labels: list[str] | None = None,
+                 shed_after: int | None = None):
         self.name = name
         self.replicas = int(replicas)
+        self.tenant_labels = tenant_labels
         self.gateway = None
-        if self.replicas > 1:
+        if self.replicas > 1 or tenants:
             from kubeoperator_tpu.cluster import ServeGateway
             engines = [_build_engine(espec) for _ in range(self.replicas)]
             batchers = [ContinuousBatcher(e, stats=BatcherStats())
                         for e in engines]
-            self.gateway = ServeGateway(batchers, policy=router)
+            kw: dict = {}
+            if tenants:
+                kw["tenants"] = tenants
+                if shed_after is not None:
+                    kw["shed_after"] = int(shed_after)
+            self.gateway = ServeGateway(batchers, policy=router, **kw)
             self.engine = engines[0]        # paged-protocol sniffing only
             self.stats = self.gateway.stats
             self.batcher = self.gateway
@@ -118,6 +129,14 @@ class _Stage:
         edges the artifact can list."""
         snap = self.stats.snapshot()
         paged = hasattr(self.engine, "pages_for")
+        tenants = None
+        if self.gateway is not None and self.gateway.qos:
+            tenants = {
+                tname: {"ttft_p95_s": t["ttft_p95_s"],
+                        "latency_p95_s": t["latency_p95_s"],
+                        "queue_depth": t["queue_depth"]}
+                for tname, t in self.gateway.tenant_snapshot().items()
+                if t["submitted"]} or None
         self.points.append(serve_history_point(
             vt,
             ttft_p95_s=self.stats.ttft_quantile(0.95),
@@ -125,7 +144,8 @@ class _Stage:
                            if snap["requests_total"] else None),
             queue_depth=snap["queue_depth"],
             slot_occupancy=snap["slot_occupancy"],
-            kv_pages_used=snap["kv_pages_used"] if paged else None))
+            kv_pages_used=snap["kv_pages_used"] if paged else None,
+            tenants=tenants))
         block = evaluate_slos(self.slos, self.points,
                               fast_window=fast, slow_window=slow)
         self.breach_events.extend(block["events"])
@@ -145,14 +165,17 @@ class _Stage:
 
     def report(self, fast: int, slow: int) -> dict:
         block = self.verdict(fast, slow)
+        tenant_states = [s for tslos in (block.get("tenants") or {}).values()
+                         for s in tslos.values()]
         slo_ok = (not any(s.get("state") == "breach"
-                          for s in block["slos"].values())
+                          for s in list(block["slos"].values())
+                          + tenant_states)
                   and not any(e.get("to") == "breach"
                               for e in self.breach_events))
         snap = self.stats.snapshot()
         with self._lock:
             n_records = len(self.records)
-        return {
+        rep = {
             "requests": len(self.trace) if self.trace else n_records,
             "wall_s": round(self.out.get("wall_s", 0.0), 3),
             "tok_s": round(self.out.get("tok_s", 0.0), 1),
@@ -164,6 +187,29 @@ class _Stage:
             "slos": block["slos"],
             "breach_events": self.breach_events,
         }
+        if block.get("tenants"):
+            rep["tenant_slos"] = block["tenants"]
+        if self.gateway is not None and self.gateway.qos:
+            gsnap = self.gateway.snapshot()
+            sheds = self.out.get("sheds") or {}
+            rep["tenants"] = self.gateway.tenant_snapshot()
+            rep["shed_total"] = gsnap["shed_total"]
+            rep["preempted_total"] = gsnap["preempted_total"]
+            rep["sheds"] = {
+                "total": len(sheds),
+                "with_retry_after": sum(
+                    1 for s in sheds.values() if s["retry_after_s"] > 0),
+                "by_tenant": _count_by(sheds.values(), "tenant"),
+                "by_reason": _count_by(sheds.values(), "reason"),
+            }
+        return rep
+
+
+def _count_by(entries, key: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for e in entries:
+        out[e[key]] = out.get(e[key], 0) + 1
+    return out
 
 
 class _TrainLoop(threading.Thread):
@@ -317,11 +363,15 @@ def run_scenario(spec: dict) -> dict:
             trains.append(_TrainLoop(wname, float(w.get("step_s", 0.005)),
                                      chaos, hosts))
             continue
-        trace, arrivals = build_trace(w.get("trace", {}), beats)
+        trace, arrivals, labels = build_trace_tenants(w.get("trace", {}),
+                                                      beats)
         offsets = [b * beat_wall_s for b in arrivals]
         st = _Stage(wname, espec, w.get("serve_slos"), trace, offsets,
                     replicas=int(w.get("replicas", 1)),
-                    router=w.get("router", "sticky_prefix"))
+                    router=w.get("router", "sticky_prefix"),
+                    tenants=w.get("tenants"),
+                    tenant_labels=labels,
+                    shed_after=w.get("shed_after"))
         stages.append(st)
         if kind == "pipeline":
             st2 = _Stage(f"{wname}:stage2", espec, w.get("stage2_slos"))
@@ -343,7 +393,8 @@ def run_scenario(spec: dict) -> dict:
         def drive(st=st, chain=chain):
             try:
                 st.out = run_load(st.batcher, st.trace, offsets=st.offsets,
-                                  timeout=timeout, on_result=chain)
+                                  timeout=timeout, on_result=chain,
+                                  tenants=st.tenant_labels)
             except Exception as e:  # noqa: BLE001 — judged in the report
                 st.error = repr(e)
 

@@ -83,6 +83,14 @@ def _slo_errors(where: str, slos: Any) -> list[str]:
         return [f"{where}: serve_slos must be a mapping"]
     errs = []
     for k, v in slos.items():
+        if k == "tenants":
+            if not isinstance(v, dict) or not v:
+                errs.append(f"{where}.tenants: must be a non-empty mapping "
+                            f"of tenant -> SLO mapping")
+            else:
+                for tname, sub in v.items():
+                    errs += _slo_errors(f"{where}.tenants[{tname}]", sub)
+            continue
         if k not in SLO_SIGNALS:
             errs.append(f"{where}: unknown SLO {k!r} "
                         f"(supported: {sorted(SLO_SIGNALS)})")
@@ -152,9 +160,51 @@ def validate_spec(spec: Any) -> list[str]:
         tspec = w.get("trace", {})
         if not isinstance(tspec, dict):
             errs.append(f"{where}.trace: must be a mapping")
-        elif tspec.get("shape", "uniform") not in TRACE_SHAPES:
-            errs.append(f"{where}.trace.shape: must be one of "
-                        f"{TRACE_SHAPES}, got {tspec.get('shape')!r}")
+        else:
+            subs = tspec.get("tenants")
+            if subs is None:
+                tchecks = [(f"{where}.trace", tspec)]
+            elif not isinstance(subs, dict) or not subs:
+                errs.append(f"{where}.trace.tenants: must be a non-empty "
+                            f"mapping of tenant -> trace spec")
+                tchecks = []
+            else:
+                tchecks = [(f"{where}.trace.tenants[{t}]", s)
+                           for t, s in subs.items()]
+            for twhere, ts in tchecks:
+                if not isinstance(ts, dict):
+                    errs.append(f"{twhere}: must be a mapping")
+                elif ts.get("shape", "uniform") not in TRACE_SHAPES:
+                    errs.append(f"{twhere}.shape: must be one of "
+                                f"{TRACE_SHAPES}, got {ts.get('shape')!r}")
+        tenants = w.get("tenants")
+        if tenants is not None:
+            if not isinstance(tenants, dict) or not tenants:
+                errs.append(f"{where}.tenants: must be a non-empty mapping "
+                            f"of tenant -> QoS policy")
+            else:
+                from kubeoperator_tpu.cluster.gateway import PRIORITIES
+                for tname, pol in tenants.items():
+                    twhere = f"{where}.tenants[{tname}]"
+                    if not isinstance(pol, dict):
+                        errs.append(f"{twhere}: must be a mapping")
+                        continue
+                    if pol.get("priority", "latency") not in PRIORITIES:
+                        errs.append(
+                            f"{twhere}.priority: must be one of "
+                            f"{PRIORITIES}, got {pol.get('priority')!r}")
+                    for pk in ("rate", "burst", "weight", "deadline_s"):
+                        pv = pol.get(pk)
+                        if pv is not None and (
+                                not isinstance(pv, (int, float))
+                                or isinstance(pv, bool) or pv <= 0):
+                            errs.append(f"{twhere}.{pk}: must be a positive "
+                                        f"number, got {pv!r}")
+        sa = w.get("shed_after")
+        if sa is not None and (not isinstance(sa, int)
+                               or isinstance(sa, bool) or sa < 1):
+            errs.append(f"{where}.shed_after: must be a positive integer, "
+                        f"got {sa!r}")
         errs += _slo_errors(f"{where}.serve_slos", w.get("serve_slos"))
         if kind == "pipeline":
             errs += _slo_errors(f"{where}.stage2_slos", w.get("stage2_slos"))
@@ -279,6 +329,123 @@ SCENARIOS: dict[str, dict] = {
         "chaos": [
             {"beat": 3, "kind": "revoke_slice"},
             {"beat": 7, "kind": "restore_slice"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "noisy_neighbor": {
+        "name": "noisy_neighbor",
+        "description": "two well-behaved latency tenants share the gateway "
+                       "with a rate-limited batch tenant that bursts 10x "
+                       "its share mid-replay; QoS sheds the neighbor with "
+                       "retry-after hints while the victims' per-tenant "
+                       "SLO verdicts stay ok, under flaky health probes",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "replicas": 2, "router": "sticky_prefix",
+             "shed_after": 8,
+             "tenants": {
+                 "alice": {"priority": "latency", "weight": 2.0},
+                 "bob": {"priority": "latency", "weight": 2.0},
+                 "mallory": {"priority": "batch", "rate": 2.0,
+                             "burst": 4.0, "weight": 0.5},
+             },
+             "trace": {"tenants": {
+                 "alice": {"shape": "uniform", "requests": 10,
+                           "prefix_len": 16},
+                 "bob": {"shape": "uniform", "requests": 10,
+                         "prefix_len": 16},
+                 "mallory": {"shape": "burst", "requests": 40,
+                             "bursts": [2, 3], "share": 0.9,
+                             "prefix_len": 16},
+             }},
+             "serve_slos": {
+                 "ttft_p95_ms": 8000, "queue_depth_max": 96,
+                 "tenants": {
+                     "alice": {"ttft_p95_ms": 4000},
+                     "bob": {"ttft_p95_ms": 4000},
+                 }}},
+        ],
+        "chaos": [
+            {"beat": 5, "kind": "flake", "pattern": "healthz", "rate": 0.3},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "thundering_herd": {
+        "name": "thundering_herd",
+        "description": "three rate-limited tenants burst on the same beat; "
+                       "admission sheds the excess with retry-after "
+                       "instead of queue-collapsing, weighted-fair dequeue "
+                       "interleaves the survivors, and a host dies "
+                       "mid-herd",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "replicas": 2, "router": "sticky_prefix",
+             "shed_after": 8,
+             "tenants": {
+                 "ann": {"priority": "latency", "rate": 5.0, "burst": 6.0},
+                 "beth": {"priority": "latency", "rate": 5.0, "burst": 6.0},
+                 "carol": {"priority": "latency", "rate": 5.0, "burst": 6.0},
+             },
+             "trace": {"tenants": {
+                 "ann": {"shape": "burst", "requests": 16, "bursts": [2],
+                         "share": 0.8, "prefix_len": 16},
+                 "beth": {"shape": "burst", "requests": 16, "bursts": [2],
+                          "share": 0.8, "prefix_len": 16},
+                 "carol": {"shape": "burst", "requests": 16, "bursts": [2],
+                           "share": 0.8, "prefix_len": 16},
+             }},
+             "serve_slos": {
+                 "ttft_p95_ms": 8000,
+                 "tenants": {
+                     "ann": {"ttft_p95_ms": 6000},
+                     "beth": {"ttft_p95_ms": 6000},
+                     "carol": {"ttft_p95_ms": 6000},
+                 }}},
+        ],
+        "chaos": [
+            {"beat": 3, "kind": "kill_host", "ip": "10.0.0.2"},
+            {"beat": 6, "kind": "revive", "ip": "10.0.0.2"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "priority_inversion": {
+        "name": "priority_inversion",
+        "description": "a batch tenant floods every decode slot with "
+                       "long-running work before a latency tenant's first "
+                       "request arrives; priority preemption evicts the "
+                       "newest batch victims (bit-identical requeue) so "
+                       "latency TTFT stays flat, under probe latency chaos",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "replicas": 1, "router": "sticky_prefix",
+             "tenants": {
+                 "builder": {"priority": "batch", "weight": 0.5},
+                 "chat": {"priority": "latency", "weight": 2.0},
+             },
+             "trace": {"tenants": {
+                 "builder": {"shape": "burst", "requests": 24,
+                             "bursts": [0], "share": 1.0, "prefix_len": 16},
+                 "chat": {"shape": "uniform", "requests": 8,
+                          "prefix_len": 16},
+             }},
+             "serve_slos": {
+                 "ttft_p95_ms": 8000,
+                 "tenants": {
+                     "chat": {"ttft_p95_ms": 4000},
+                 }}},
+        ],
+        "chaos": [
+            {"beat": 4, "kind": "latency", "pattern": "healthz",
+             "base_s": 0.0005, "jitter_s": 0.001},
         ],
         "slo_windows": {"fast": 4, "slow": 8},
     },

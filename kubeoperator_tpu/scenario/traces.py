@@ -44,7 +44,8 @@ def make_trace(n: int,
 
 def make_prefix_trace(n: int, prefix_len: int = 64,
                       mix: tuple[tuple[int, int], ...] = PREFIX_TAIL,
-                      groups: int = 1) -> list[tuple[list[int], int]]:
+                      groups: int = 1,
+                      group0: int = 0) -> list[tuple[list[int], int]]:
     """Shared-prefix long-tail trace: every request opens with the same
     ``prefix_len``-token system prompt (page-aligned when prefix_len is a
     multiple of the page size), then a short unique tail. The first
@@ -55,10 +56,13 @@ def make_prefix_trace(n: int, prefix_len: int = 64,
     (request ``i`` belongs to group ``i % groups``) — the multi-tenant
     working set the cluster gateway's sticky-prefix router partitions
     across replicas. groups=1 is exactly the round-8 single-tenant
-    trace."""
+    trace. ``group0`` offsets the group numbering so two traces built
+    with disjoint offsets share NO system prompt — how per-tenant
+    sub-traces get tenant-distinct working sets."""
     if groups < 1:
         raise ValueError(f"groups ({groups}) must be >= 1")
-    systems = [[(7 * j + 131 * g) % VOCAB + 1 for j in range(prefix_len)]
+    systems = [[(7 * j + 131 * (g + group0)) % VOCAB + 1
+                for j in range(prefix_len)]
                for g in range(groups)]
     out = []
     for i in range(n):
@@ -143,7 +147,8 @@ def build_trace(tspec: dict, beats: int
     prefix_len = int(tspec.get("prefix_len", 0))
     if prefix_len:
         trace = make_prefix_trace(n, prefix_len,
-                                  groups=int(tspec.get("prefix_groups", 1)))
+                                  groups=int(tspec.get("prefix_groups", 1)),
+                                  group0=int(tspec.get("group0", 0)))
     else:
         trace = make_trace(n)
     if shape == "uniform":
@@ -159,6 +164,38 @@ def build_trace(tspec: dict, beats: int
     else:
         raise ValueError(f"unknown trace shape {shape!r}")
     return trace, arrivals
+
+
+def build_trace_tenants(tspec: dict, beats: int
+                        ) -> tuple[list[tuple[list[int], int]], list[int],
+                                   list[str] | None]:
+    """Like :func:`build_trace` but multi-tenant aware: a ``"tenants"``
+    key in the trace spec maps tenant name -> sub-trace spec; each
+    tenant's stream is built independently (with a tenant-distinct
+    ``group0`` prefix offset unless the sub-spec pins one), then the
+    streams merge by arrival beat (stable sort — within a beat, tenants
+    interleave in sorted-name order, deterministically).
+
+    Returns ``(trace, arrivals, tenant_labels)`` where ``tenant_labels``
+    parallels the trace (one tenant name per request) or is ``None`` for
+    a single-tenant spec — the harness passes it straight to the load
+    driver's per-request ``tenants`` argument."""
+    sub_specs = tspec.get("tenants")
+    if not sub_specs:
+        trace, arrivals = build_trace(tspec, beats)
+        return trace, arrivals, None
+    merged: list[tuple[int, tuple[list[int], int], str]] = []
+    off = 0                           # cumulative: disjoint group ranges
+    for tname in sorted(sub_specs):
+        sub = dict(sub_specs[tname])
+        sub.setdefault("group0", off)
+        off += int(sub.get("prefix_groups", 1) or 1)
+        trace, arrivals = build_trace(sub, beats)
+        merged.extend(zip(arrivals, trace, [tname] * len(trace)))
+    merged.sort(key=lambda x: x[0])   # stable: name order within a beat
+    return ([req for _, req, _ in merged],
+            [beat for beat, _, _ in merged],
+            [tname for _, _, tname in merged])
 
 
 TRACE_SHAPES = ("uniform", "diurnal", "burst")

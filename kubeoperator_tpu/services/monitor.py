@@ -61,6 +61,11 @@ QUERIED_METRICS = {
     "ko_gateway_requests_routed_total": "jax-serve",
     "ko_gateway_prefix_affinity_ratio": "jax-serve",
     "ko_gateway_handoff_pages_total": "jax-serve",
+    # multi-tenant QoS (round 16): deliberate overload sheds (by tenant and
+    # reason) and priority preemptions of batch-class victims (by victim
+    # tenant) — served off the gateway process's /metrics like the rest
+    "ko_serve_shed_total": "jax-serve",
+    "ko_serve_preemptions_total": "jax-serve",
     # multi-chip training (round 10): step time, MFU, and the collective
     # attribution the train jobs publish on --metrics-port
     "ko_train_step_seconds_bucket": "jax-train",
@@ -115,6 +120,13 @@ PROMQL = {
         "sum(rate(ko_gateway_requests_routed_total[5m])) by (policy)",
     "gateway_affinity_ratio": "avg(ko_gateway_prefix_affinity_ratio)",
     "gateway_handoff_rate": "sum(rate(ko_gateway_handoff_pages_total[5m]))",
+    # multi-tenant QoS (round 16): who is being shed (and why — rate vs
+    # deadline vs expired tells config error from genuine saturation) and
+    # whose batch traffic is paying for latency-class slots
+    "serve_shed_rate":
+        "sum(rate(ko_serve_shed_total[5m])) by (tenant, reason)",
+    "serve_preemption_rate":
+        "sum(rate(ko_serve_preemptions_total[5m])) by (tenant)",
     # training plane (round 10): the fsdp/pipeline jobs' step-time p95,
     # fleet MFU, and where the collective seconds go by family — the same
     # split bench_multichip attributes per config
@@ -193,19 +205,32 @@ def serve_history_point(time: Any, *, ttft_p95_s: float | None = None,
                         latency_p95_s: float | None = None,
                         queue_depth: float | None = None,
                         slot_occupancy: float | None = None,
-                        kv_pages_used: float | None = None) -> dict:
+                        kv_pages_used: float | None = None,
+                        tenants: dict[str, dict] | None = None) -> dict:
     """One monitor-history point built by an *external* producer (the
     scenario replay harness) using exactly the keys ``SLO_SIGNALS`` maps,
     so ``evaluate_slos`` judges a replay the same way it judges the live
     beat's persisted history. ``None`` means "no data this tick" — the
     monitor's own convention for a cluster without jax-serve, which the
-    burn-rate math already skips instead of counting as a breach."""
-    return {"time": time,
-            "serve_ttft_p95": ttft_p95_s,
-            "serve_latency_p95": latency_p95_s,
-            "serve_queue_depth": queue_depth,
-            "serve_slot_occupancy": slot_occupancy,
-            "serve_kv_pages_used": kv_pages_used}
+    burn-rate math already skips instead of counting as a breach.
+
+    ``tenants`` (round 16) attaches per-tenant sub-points keyed by tenant
+    name, each ``{"ttft_p95_s": ..., "latency_p95_s": ..., "queue_depth":
+    ...}``; the key is added to the point only when provided, so single-
+    tenant history stays byte-identical to the pre-QoS shape."""
+    point = {"time": time,
+             "serve_ttft_p95": ttft_p95_s,
+             "serve_latency_p95": latency_p95_s,
+             "serve_queue_depth": queue_depth,
+             "serve_slot_occupancy": slot_occupancy,
+             "serve_kv_pages_used": kv_pages_used}
+    if tenants is not None:
+        point["tenants"] = {
+            str(name): {"serve_ttft_p95": sub.get("ttft_p95_s"),
+                        "serve_latency_p95": sub.get("latency_p95_s"),
+                        "serve_queue_depth": sub.get("queue_depth")}
+            for name, sub in tenants.items()}
+    return point
 
 
 def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
@@ -220,7 +245,17 @@ def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
     re-judging the fast window without it, so the beat needs no cross-tick
     state. A history shorter than a burn window leaves that window
     ``no_data`` (no spurious breach edge on a cluster's first beats);
-    attainment is still reported over whatever known points exist."""
+    attainment is still reported over whatever known points exist.
+
+    A ``"tenants"`` key in the spec maps tenant name -> sub-spec; each is
+    judged over only the points carrying that tenant's sub-point, so a
+    tenant that just arrived has a short sub-history and stays ``no_data``
+    until a full window exists — the same short-history guard, extended
+    per tenant (no spurious first-beat breach edges). Tenant verdicts land
+    in ``result["tenants"][name]`` and tenant breach-edge events gain a
+    ``"tenant"`` key in the shared ``events`` list."""
+    spec = dict(spec)
+    tenant_spec = spec.pop("tenants", None) or {}
     slos: dict[str, dict] = {}
     events: list[dict] = []
     for name in sorted(spec):
@@ -265,7 +300,22 @@ def evaluate_slos(spec: dict, points: list[dict], fast_window: int = 12,
             "burn_rate": {"fast": burn_fast, "slow": burn_slow},
             "state": state,
         }
-    return {"slos": slos, "events": events}
+    result: dict = {"slos": slos, "events": events}
+    if tenant_spec:
+        tenants: dict[str, dict] = {}
+        for tname in sorted(tenant_spec):
+            sub_points = [dict(p["tenants"][tname], time=p.get("time"))
+                          for p in points
+                          if tname in (p.get("tenants") or {})]
+            sub = evaluate_slos(tenant_spec[tname], sub_points,
+                                fast_window=fast_window,
+                                slow_window=slow_window)
+            for ev in sub["events"]:
+                ev["tenant"] = tname
+                events.append(ev)
+            tenants[tname] = sub["slos"]
+        result["tenants"] = tenants
+    return result
 
 
 def urllib_transport(method: str, url: str, headers: dict, timeout: float) -> tuple[int, str]:
@@ -490,6 +540,21 @@ class ClusterMonitor:
         gateway_affinity = prom.scalar_or_none(
             PROMQL["gateway_affinity_ratio"])
         gateway_handoff = prom.scalar_or_none(PROMQL["gateway_handoff_rate"])
+        # multi-tenant QoS: {} marks "no QoS-enabled gateway deployed"
+        try:
+            serve_shed_rates = {
+                "{}/{}".format(r.get("metric", {}).get("tenant", "?"),
+                               r.get("metric", {}).get("reason", "?")):
+                    float(r["value"][1])
+                for r in prom.query(PROMQL["serve_shed_rate"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            serve_shed_rates = {}
+        try:
+            serve_preempt_rates = {
+                r.get("metric", {}).get("tenant", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["serve_preemption_rate"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            serve_preempt_rates = {}
         try:
             gateway_by_policy = {
                 r.get("metric", {}).get("policy", "?"): float(r["value"][1])
@@ -532,6 +597,8 @@ class ClusterMonitor:
             "serve_kv_pages_used": serve_pages,
             "serve_prefix_hit_rate": serve_hit_rate,
             "serve_requeued_rate": serve_requeued,
+            "serve_shed_by_tenant": serve_shed_rates,
+            "serve_preemption_by_tenant": serve_preempt_rates,
             "gateway_routed_rate": gateway_rate,
             "gateway_routed_by_policy": gateway_by_policy,
             "gateway_affinity_ratio": gateway_affinity,
@@ -607,17 +674,28 @@ class ClusterMonitor:
             cfg.get("serve_slos") or {}, points,
             fast_window=int(cfg.get("slo_fast_window", 12)),
             slow_window=int(cfg.get("slo_slow_window", 72)))
-        for name, s in block["slos"].items():
-            if s.get("attainment") is not None:
-                tm.SLO_TARGET_RATIO.set(s["attainment"], slo=name)
-            for win in ("fast", "slow"):
-                burn = (s.get("burn_rate") or {}).get(win)
-                if burn is not None:
-                    tm.SLO_BURN_RATE.set(burn, slo=name, window=win)
+        # tenant="" is the cluster-wide verdict; per-tenant sub-verdicts
+        # (round 16) publish the same gauges with the tenant label set
+        def _publish(slos: dict, tenant: str) -> None:
+            for name, s in slos.items():
+                if s.get("attainment") is not None:
+                    tm.SLO_TARGET_RATIO.set(s["attainment"], slo=name,
+                                            tenant=tenant)
+                for win in ("fast", "slow"):
+                    burn = (s.get("burn_rate") or {}).get(win)
+                    if burn is not None:
+                        tm.SLO_BURN_RATE.set(burn, slo=name, window=win,
+                                             tenant=tenant)
+
+        _publish(block["slos"], "")
+        for tname, tslos in (block.get("tenants") or {}).items():
+            _publish(tslos, tname)
         for ev in block["events"]:
             log.warning(
-                "slo %s %s -> %s on %s (burn_fast=%s value=%s target=%s)",
-                ev["slo"], ev["from"], ev["to"], self.cluster.name,
+                "slo %s%s %s -> %s on %s (burn_fast=%s value=%s target=%s)",
+                ev["slo"],
+                " tenant=" + ev["tenant"] if ev.get("tenant") else "",
+                ev["from"], ev["to"], self.cluster.name,
                 ev["burn_fast"], ev["value"], ev["target"])
         return block
 

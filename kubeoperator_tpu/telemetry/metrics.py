@@ -569,14 +569,16 @@ def record_aot_event(fn: str, *, hit: bool, seconds: float,
 SLO_TARGET_RATIO = REGISTRY.gauge(
     "ko_slo_target_ratio",
     "Fraction of the sliding window meeting the SLO target (1.0 = fully "
-    "attained), per configured serve SLO.",
-    labels=("slo",))
+    "attained), per configured serve SLO and tenant (tenant=\"\" is the "
+    "cluster-wide verdict).",
+    labels=("slo", "tenant"))
 SLO_BURN_RATE = REGISTRY.gauge(
     "ko_slo_burn_rate",
-    "Error-budget burn rate per configured serve SLO and window "
-    "(fast | slow); 1.0 burns the whole budget within the objective "
-    "period, sustained fast burn >1.0 is a page.",
-    labels=("slo", "window"))
+    "Error-budget burn rate per configured serve SLO, window "
+    "(fast | slow) and tenant (tenant=\"\" is the cluster-wide verdict); "
+    "1.0 burns the whole budget within the objective period, sustained "
+    "fast burn >1.0 is a page.",
+    labels=("slo", "window", "tenant"))
 
 # -- scenario-replay families (scenario/harness.py) -------------------------
 # Set by the replay harness when a scenario finishes: the verdict of
@@ -635,6 +637,24 @@ GATEWAY_HANDOFF_PAGES = REGISTRY.counter(
     "ko_gateway_handoff_pages_total",
     "Whole KV pages shipped from disaggregated prefill workers into "
     "decode replicas' prefix caches as block-table page lists.")
+
+# -- multi-tenant QoS families (cluster/gateway.py, round 16) ---------------
+# Set by the gateway's tenant admission and preemption paths, on the
+# process-global REGISTRY like the other gateway families.
+SERVE_SHED = REGISTRY.counter(
+    "ko_serve_shed_total",
+    "Requests deliberately rejected by the gateway's QoS admission, by "
+    "tenant and reason (rate = over the tenant's token bucket at cluster "
+    "saturation, deadline = the required backoff exceeds the request's "
+    "deadline, expired = the request out-waited its deadline queued). "
+    "Every shed carries a retry_after_s hint.",
+    labels=("tenant", "reason"))
+SERVE_PREEMPTIONS = REGISTRY.counter(
+    "ko_serve_preemptions_total",
+    "Batch-class in-flight requests evicted mid-decode so a latency-class "
+    "request could take the slot, by victim tenant (victims requeue and "
+    "re-prefill with bit-identical replies).",
+    labels=("tenant",))
 
 
 declare_serve_metrics(REGISTRY)

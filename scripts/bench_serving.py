@@ -214,6 +214,95 @@ def bench_cluster(requests: int = 60, replicas: int = 4, slots: int = 8,
     }
 
 
+def bench_qos(victim_requests: int = 10, burst_factor: int = 10,
+              replicas: int = 2, slots: int = 8, segment: int = 8,
+              page: int = 16, prefix_len: int = 32,
+              step_s: float = 0.0002, dispatch_s: float = 0.0005,
+              prefill_s: float = 0.02, stagger_s: float = 0.02,
+              max_total: int = 256, shed_after: int = 6) -> dict:
+    """Round 16: noisy-neighbor A/B through the QoS gateway — QoS on
+    (admission + weighted-fair dequeue + priority preemption) vs plain
+    FIFO, SAME replicas, SAME aggregate KV HBM, SAME trace. A latency
+    tenant ("victim") sends a steady stream; a rate-limited batch tenant
+    ("neighbor") bursts ``burst_factor``× the victim's volume all at
+    once. Three arms:
+
+    * ``solo`` — QoS gateway, victim stream only: the undisturbed TTFT
+      p95 baseline;
+    * ``qos`` — victim + burst with QoS on: admission sheds the
+      neighbor's excess with ``retry_after_s`` hints, fair dequeue +
+      latency-class preemption keep the victim's TTFT near solo;
+    * ``fifo`` — same load, ``qos="fifo"``: pure arrival order, no
+      shed/preempt/fairness — the queue-collapse baseline.
+
+    The tier-1 guard pins the qos arm's victim TTFT p95 at <20% over
+    solo while every shed carries a positive retry-after."""
+    from kubeoperator_tpu.cluster import ServeGateway
+
+    n_neighbor = victim_requests * burst_factor
+    victim_trace = make_prefix_trace(victim_requests, prefix_len)
+    neighbor_trace = make_prefix_trace(n_neighbor, prefix_len, group0=1)
+    trace = victim_trace + neighbor_trace
+    labels = (["victim"] * victim_requests + ["neighbor"] * n_neighbor)
+    # victim staggers across the window; the whole burst lands just
+    # after the victim's second request, mid-stream
+    offsets = ([i * stagger_s for i in range(victim_requests)]
+               + [2 * stagger_s] * n_neighbor)
+    policies = {
+        "victim": {"priority": "latency", "weight": 2.0},
+        "neighbor": {"priority": "batch", "rate": 2.0, "burst": 4.0,
+                     "weight": 0.5},
+    }
+
+    def arm(qos_mode: str, include_neighbor: bool = True) -> dict:
+        engines = [FakePagedEngine(
+            slots=slots, segment=segment, max_total=max_total, page=page,
+            step_s=step_s, dispatch_s=dispatch_s, prefill_s=prefill_s)
+            for _ in range(replicas)]
+        batchers = [ContinuousBatcher(e, stats=BatcherStats())
+                    for e in engines]
+        gw = ServeGateway(batchers, tenants=policies, qos=qos_mode,
+                          shed_after=shed_after)
+        n = len(trace) if include_neighbor else victim_requests
+        r = run_load(gw, trace[:n], offsets=offsets[:n],
+                     tenants=labels[:n])
+        snap = gw.tenant_snapshot()
+        sheds = r["sheds"]
+        return {
+            "mode": qos_mode,
+            "neighbor_requests": n - victim_requests,
+            "wall_s": round(r["wall_s"], 3),
+            "victim_ttft_p95_s": snap["victim"]["ttft_p95_s"],
+            "victim_finished": snap["victim"]["finished"],
+            "neighbor_finished": snap.get("neighbor", {}).get("finished", 0),
+            "shed_total": len(sheds),
+            "sheds_with_retry_after": sum(
+                1 for s in sheds.values() if s["retry_after_s"] > 0),
+            "shed_by_tenant": {
+                t: sum(1 for s in sheds.values() if s["tenant"] == t)
+                for t in {s["tenant"] for s in sheds.values()}},
+            "preempted_total": gw.snapshot()["preempted_total"],
+        }
+
+    solo = arm("fair", include_neighbor=False)
+    qos = arm("fair")
+    fifo = arm("fifo")
+    base = max(solo["victim_ttft_p95_s"] or 0.0, 1e-9)
+    return {
+        "victim_requests": victim_requests,
+        "burst_factor": burst_factor,
+        "replicas": replicas,
+        "shed_after": shed_after,
+        "solo": solo,
+        "qos": qos,
+        "fifo": fifo,
+        "victim_degradation": round(
+            (qos["victim_ttft_p95_s"] or 0.0) / base, 3),
+        "fifo_degradation": round(
+            (fifo["victim_ttft_p95_s"] or 0.0) / base, 3),
+    }
+
+
 def bench_tracing_overhead(requests: int, slots: int, segment: int,
                            step_s: float, dispatch_s: float,
                            prefill_s: float, stagger_s: float,
@@ -463,6 +552,13 @@ def main() -> None:
     ap.add_argument("--prefix-capacity", type=int, default=24,
                     help="cluster mode: per-replica prefix-cache entries "
                          "(LRU) — one replica's tenant share, not all")
+    ap.add_argument("--qos", action="store_true",
+                    help="noisy-neighbor A/B: QoS gateway (admission + "
+                         "fair dequeue + preemption) vs FIFO at equal HBM "
+                         "under a 10x batch-tenant burst (cost model)")
+    ap.add_argument("--burst-factor", type=int, default=10,
+                    help="qos mode: neighbor burst volume as a multiple "
+                         "of the victim stream")
     ap.add_argument("--tracing-overhead", action="store_true",
                     help="A/B the continuous engine with the serve tracer "
                          "off vs on (round 9: must stay under 5%% tok/s)")
@@ -523,6 +619,40 @@ def main() -> None:
                     f"rr ttft={result['round_robin']['mean_ttft_s']}s "
                     f"hits={result['round_robin']['prefix_hits']} | "
                     f"gain={result['ttft_gain']}x"),
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+        return
+    if args.qos:
+        result = bench_qos(burst_factor=args.burst_factor,
+                           replicas=args.replicas)
+        print(json.dumps(result))
+        if args.out:
+            qos, solo = result["qos"], result["solo"]
+            artifact = {
+                "rc": 0,
+                "ok": (result["victim_degradation"] < 1.2
+                       and qos["shed_total"] > 0
+                       and qos["sheds_with_retry_after"]
+                       == qos["shed_total"]),
+                "skipped": False,
+                "burst_factor": result["burst_factor"],
+                "replicas": result["replicas"],
+                "victim_degradation": result["victim_degradation"],
+                "fifo_degradation": result["fifo_degradation"],
+                "solo": solo,
+                "qos": qos,
+                "fifo": result["fifo"],
+                "tail": (
+                    f"solo p95={solo['victim_ttft_p95_s']}s | "
+                    f"qos p95={qos['victim_ttft_p95_s']}s "
+                    f"({result['victim_degradation']}x) "
+                    f"shed={qos['shed_total']} "
+                    f"retry-after={qos['sheds_with_retry_after']} "
+                    f"preempt={qos['preempted_total']} | "
+                    f"fifo p95={result['fifo']['victim_ttft_p95_s']}s "
+                    f"({result['fifo_degradation']}x)"),
             }
             with open(args.out, "w") as f:
                 json.dump(artifact, f, indent=1)

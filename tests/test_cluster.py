@@ -420,6 +420,68 @@ def test_cluster_serving_artifact_checked_in():
 
 
 # ---------------------------------------------------------------------------
+# multi-tenant QoS A/B (round 16): noisy neighbor, shed contract
+# ---------------------------------------------------------------------------
+
+def test_qos_noisy_neighbor_guard():
+    """Tier-1 guard on the QoS A/B: under a 6x batch-tenant burst the
+    latency victim's TTFT p95 stays within 20% of its solo baseline
+    (admission sheds the excess, every shed carrying a positive
+    retry-after), while the FIFO control on the same load collapses the
+    victim's tail — the number that justifies the whole gateway."""
+    bs = _bench_mod()
+    out = bs.bench_qos(victim_requests=8, burst_factor=6, replicas=2)
+    assert out["victim_degradation"] < 1.2, out
+    assert out["qos"]["shed_total"] > 0, out
+    assert out["qos"]["sheds_with_retry_after"] == out["qos"]["shed_total"]
+    assert out["qos"]["shed_by_tenant"] == \
+        {"neighbor": out["qos"]["shed_total"]}
+    assert out["qos"]["victim_finished"] == 8
+    # the control arm proves the mechanism matters: no shedding, and the
+    # victim's tail degrades past anything the QoS arm is allowed
+    assert out["fifo"]["shed_total"] == 0
+    assert out["fifo_degradation"] > out["victim_degradation"]
+
+
+def test_qos_artifact_checked_in():
+    """MULTICHIP_serving_r05.json is the QoS A/B's number of record:
+    present, ok, and holding the same <20%-degradation + retry-after
+    contract the live bench is pinned to."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MULTICHIP_serving_r05.json")
+    with open(path, encoding="utf-8") as fh:
+        art = json.load(fh)
+    assert art["ok"] is True and art["rc"] == 0
+    assert art["victim_degradation"] < 1.2
+    assert art["qos"]["shed_total"] > 0
+    assert art["qos"]["sheds_with_retry_after"] == art["qos"]["shed_total"]
+    assert art["fifo"]["shed_total"] == 0
+
+
+def test_scenario_spec_validates_qos_keys():
+    from kubeoperator_tpu.scenario.spec import SCENARIOS, validate_spec
+    base = {"name": "x", "beats": 4, "workloads": [
+        {"kind": "serving", "replicas": 2,
+         "tenants": {"a": {"priority": "latency", "rate": 2.0,
+                           "burst": 4.0, "weight": 2.0}},
+         "trace": {"shape": "tenants", "tenants": {
+             "a": {"shape": "uniform", "requests": 4}}}}]}
+    assert validate_spec(base) == []
+    bad_prio = dict(base, workloads=[dict(
+        base["workloads"][0], tenants={"a": {"priority": "urgent"}})])
+    assert any("priority" in e for e in validate_spec(bad_prio))
+    bad_rate = dict(base, workloads=[dict(
+        base["workloads"][0], tenants={"a": {"rate": -1.0}})])
+    assert any("rate" in e for e in validate_spec(bad_rate))
+    bad_shed = dict(base, workloads=[dict(
+        base["workloads"][0], shed_after=0)])
+    assert any("shed_after" in e for e in validate_spec(bad_shed))
+    # the catalog's three adversarial QoS scenarios validate clean
+    for name in ("noisy_neighbor", "thundering_herd", "priority_inversion"):
+        assert validate_spec(SCENARIOS[name]) == [], name
+
+
+# ---------------------------------------------------------------------------
 # scenario spec: replicas/router keys
 # ---------------------------------------------------------------------------
 

@@ -794,6 +794,147 @@ def test_drain_readmit_matches_solo_sharded_engine(params):
     assert cb.stats.snapshot()["requests_requeued_total"] == 2
 
 
+# ---------------------------------------------------------------------------
+# per-slot preemption (round 16): the drain protocol without the fence
+# ---------------------------------------------------------------------------
+
+def test_preempt_slots_requeue_without_fence():
+    """``preempt([slots])`` evicts exactly the named slots' in-flight
+    requests mid-decode: victims requeue at the queue head (counted,
+    reason-labelled), the freed slots return to the admission pool
+    IMMEDIATELY — no shard fence, no ``readmit`` needed — and every
+    reply, preempted or not, stays bit-identical to the cost model's
+    solo oracle."""
+    bs = _bench_mod()
+    eng = _gated_paged_engine(bs, expect=4, slots=4, dp=2, segment=2,
+                              max_total=24, page=8, step_s=0.0,
+                              dispatch_s=0.0, prefill_s=0.0)
+    cb = ContinuousBatcher(eng)
+    reqs = [[1, 2, 3, 4, 5], [7, 8, 9], [2, 2, 2, 2], [11, 12, 13, 14, 15]]
+    MT = 12
+    results, errors = {}, []
+
+    def client(i):
+        try:
+            results[i] = cb.submit(reqs[i], MT, timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    _spin(lambda: eng.admitted + len(cb._queue) >= 4, msg="4 enqueued")
+    eng.gate.release()
+    _spin(eng.all_admitted.is_set, msg="all 4 admitted")
+
+    # direct submits default to latency class: nothing is batch-preemptible,
+    # and the victim list orders newest admission first via (ts, seq)
+    assert cb.preemptible("batch") == []
+    rows = cb.preemptible("latency")
+    assert len(rows) == 4
+    keys = [(r.submitted_at, r.seq) for _s, r in rows]
+    assert keys == sorted(keys, reverse=True)
+
+    with pytest.raises(ValueError, match="unknown slots"):
+        cb.preempt([99])
+
+    got = {}
+    pt = threading.Thread(target=lambda: got.__setitem__(
+        "ids", cb.preempt([0, 1], timeout=30.0)))
+    pt.start()
+    _spin(lambda: cb._ctl or got, msg="preempt handshake queued")
+    eng.gate.release()            # let the worker reach the handshake
+    pt.join(30)
+    assert "ids" in got and len(got["ids"]) == 2
+    assert cb.stats.snapshot()["requests_requeued_total"] == 2
+    assert '{reason="preempt"}' in cb.stats.prometheus()
+    # no fence: the freed slots are admittable at once, so the worker
+    # re-admits both victims on its own — no readmit() handshake
+    _spin(lambda: eng.admitted >= 6, msg="victims re-admitted unfenced")
+
+    eng.hold = False
+    eng.gate.release()
+    for t in threads:
+        t.join(30)
+    assert not errors and len(results) == 4
+    for i, prompt in enumerate(reqs):
+        want = [int(x) for x in bs.fake_row(prompt, len(prompt) + MT)]
+        assert results[i] == want, f"request {i} lost or corrupted tokens"
+    s = cb.stats.snapshot()
+    assert s["errors_total"] == 0 and s["queue_depth"] == 0
+    # preempting now-empty slots is a no-op, not an error
+    assert cb.preempt([0, 1], timeout=30.0) == []
+
+
+def test_preempt_matches_solo_sharded_engine(params):
+    """Preempt mid-decode on the real 2x4-mesh engine: the evicted
+    requests re-prefill from scratch on re-admission and every reply —
+    preempted or undisturbed — stays bit-identical to solo generate().
+    The gateway's priority preemption rides this exact op, so this pins
+    ISSUE 16's acceptance on real sharded KV, not just the cost model."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2,
+                         mesh_spec=MESH_2x4)
+    gate = threading.Semaphore(0)
+    hold = {"on": True}
+    segs, admitted = [0], [0]
+    orig_seg, orig_admit = eng.run_segment, eng.admit
+
+    def gated_segment():
+        if hold["on"]:
+            assert gate.acquire(timeout=60), "segment gate starved"
+        orig_seg()
+        segs[0] += 1
+
+    def counting_admit(entries):
+        out = orig_admit(entries)
+        admitted[0] += len(entries)
+        return out
+
+    eng.run_segment = gated_segment
+    eng.admit = counting_admit
+    cb = ContinuousBatcher(eng)
+    reqs = [([1, 2, 3, 4, 5], 8), ([7, 8, 9], 10), ([2, 2, 2, 2], 12),
+            ([11, 12, 13, 14, 15, 16], 9)]
+    results, errors = {}, []
+
+    def client(i):
+        prompt, mt = reqs[i]
+        try:
+            results[i] = cb.submit(prompt, mt, timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    _spin(lambda: admitted[0] + len(cb._queue) >= 4, msg="4 enqueued")
+    gate.release()
+    _spin(lambda: admitted[0] >= 4, timeout=120.0, msg="all 4 admitted")
+    s0 = segs[0]
+    gate.release()
+    _spin(lambda: segs[0] > s0, timeout=120.0, msg="mid-decode segment")
+    # smallest request wants 8 tokens, <= 2 segments x 2 decoded: all live
+
+    got = {}
+    pt = threading.Thread(target=lambda: got.__setitem__(
+        "ids", cb.preempt([1, 2], reason="preempt", timeout=120.0)))
+    pt.start()
+    _spin(lambda: cb._ctl or got, msg="preempt handshake queued")
+    gate.release()
+    pt.join(120)
+    assert "ids" in got and len(got["ids"]) == 2   # one victim per shard
+    # no fence, no readmit: the worker re-admits the victims on its own
+    hold["on"] = False
+    gate.release()
+    for t in threads:
+        t.join(120)
+    assert not errors and len(results) == 4
+    for i, (prompt, mt) in enumerate(reqs):
+        assert results[i] == solo(params, prompt, mt), (
+            f"request {i} diverged from solo after preemption")
+    assert cb.stats.snapshot()["requests_requeued_total"] == 2
+
+
 def test_paged_cost_model_equal_hbm_win():
     """Round-8 acceptance guard on the injected-latency cost model: at
     EQUAL KV HBM (dense_slots × max_seq_len cached tokens) the paged

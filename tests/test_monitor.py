@@ -288,7 +288,8 @@ def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
     assert s["state"] == "breach" and s["burn_rate"]["fast"] >= 1.0
     assert [(e["from"], e["to"])
             for e in block["events"]] == [("no_data", "breach")]
-    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast") >= 1.0
+    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast",
+                                  tenant="") >= 1.0
 
     t.ttft_s = 0.1                            # recovered: 100ms
     mon.monitor_tick(platform, transport=t)
@@ -299,8 +300,10 @@ def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
     assert s["state"] == "ok" and s["met"] is True
     assert [(e["from"], e["to"]) for e in block["events"]] == [("breach", "ok")]
     assert s["burn_rate"]["fast"] == 0.0
-    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast") == 0.0
-    assert tm.SLO_TARGET_RATIO.value(slo="ttft_p95_ms") == s["attainment"]
+    assert tm.SLO_BURN_RATE.value(slo="ttft_p95_ms", window="fast",
+                                  tenant="") == 0.0
+    assert tm.SLO_TARGET_RATIO.value(slo="ttft_p95_ms",
+                                     tenant="") == s["attainment"]
     # history carried the whole walk for the dashboard charts
     hist = platform.store.find(mon.MonitorSnapshot, scoped=False,
                                name="demo:history")[0]
@@ -393,6 +396,60 @@ def test_evaluate_slos_burst_then_idle_tail_holds_last_verdict():
     assert s["state"] == "no_data"            # unjudged, not green
     assert s["burn_rate"]["fast"] is None
     assert block["events"] == []              # no spurious ok/recovery edge
+
+
+def _tpts(n, **tenant_ttft_s):
+    """n points, each carrying per-tenant serving sub-points."""
+    return [mon.serve_history_point(
+        f"t{i}", ttft_p95_s=0.1,
+        tenants={name: {"ttft_p95_s": v}
+                 for name, v in tenant_ttft_s.items()})
+        for i in range(n)]
+
+
+def test_evaluate_slos_tenant_dimension():
+    """A ``tenants`` sub-map in the spec judges each tenant over its own
+    sub-history: one tenant can breach while the cluster-wide SLO and
+    its neighbours stay green, and the breach edge lands in the shared
+    events list tagged with the tenant's name."""
+    spec = {"ttft_p95_ms": 500,
+            "tenants": {"alice": {"ttft_p95_ms": 200},
+                        "bob": {"ttft_p95_ms": 200}}}
+    block = mon.evaluate_slos(spec, _tpts(3, alice=0.1, bob=9.9),
+                              fast_window=3, slow_window=6)
+    assert block["slos"]["ttft_p95_ms"]["state"] == "ok"   # cluster-wide
+    a = block["tenants"]["alice"]["ttft_p95_ms"]
+    b = block["tenants"]["bob"]["ttft_p95_ms"]
+    assert a["state"] == "ok" and a["value"] == 100.0
+    assert b["state"] == "breach" and b["burn_rate"]["fast"] >= 1.0
+    assert [(e["tenant"], e["to"]) for e in block["events"]] \
+        == [("bob", "breach")]
+    # the caller's spec dict is not mutated by the tenant recursion
+    assert "tenants" in spec
+
+
+def test_evaluate_slos_tenant_short_history_is_no_data():
+    """The short-history guard extends per tenant: a tenant that only
+    just arrived (or never did) is unjudgeable, never a spurious
+    first-beat breach — even when its few readings are terrible."""
+    pts = [mon.serve_history_point(f"t{i}", ttft_p95_s=0.1)
+           for i in range(3)]
+    pts += _tpts(2, late=9.9)           # tenant appears on beats 3-4 only
+    spec = {"tenants": {"late": {"ttft_p95_ms": 200},
+                        "ghost": {"ttft_p95_ms": 200}}}
+    block = mon.evaluate_slos(spec, pts, fast_window=3, slow_window=6)
+    late = block["tenants"]["late"]["ttft_p95_ms"]
+    assert late["state"] == "no_data" and late["burn_rate"]["fast"] is None
+    assert late["value"] == 9900.0 and late["met"] is False   # raw reading
+    ghost = block["tenants"]["ghost"]["ttft_p95_ms"]
+    assert ghost["state"] == "no_data" and ghost["value"] is None
+    assert block["events"] == []        # no edges from either tenant
+    # one more breaching beat fills late's window: the verdict fires now
+    block = mon.evaluate_slos(spec, pts + _tpts(1, late=9.9),
+                              fast_window=3, slow_window=6)
+    assert block["tenants"]["late"]["ttft_p95_ms"]["state"] == "breach"
+    assert [(e["tenant"], e["from"], e["to"]) for e in block["events"]] \
+        == [("late", "no_data", "breach")]
 
 
 def test_evaluate_slos_uneven_spacing_burn_is_per_point_not_per_time():

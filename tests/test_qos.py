@@ -1,0 +1,367 @@
+"""Multi-tenant QoS at the gateway (round 16): per-tenant token-bucket
+admission with deliberate overload shedding (ShedError + retry_after_s),
+weighted-fair dequeue, latency-over-batch priority, preemption of
+batch-class victims with bit-exact requeued replies, the qos="fifo"
+no-QoS baseline that never sheds, the (submitted_at, seq) requeue-order
+tiebreak, and the per-tenant trace builder's disjoint prefix groups."""
+
+import threading
+import time
+
+import pytest
+
+from kubeoperator_tpu.cluster import (
+    PRIORITIES, QOS_MODES, ServeGateway, ShedError,
+)
+from kubeoperator_tpu.scenario.engines import FakePagedEngine, fake_row
+from kubeoperator_tpu.scenario.traces import build_trace_tenants
+from kubeoperator_tpu.workloads.serving import (
+    BatcherStats, ContinuousBatcher, _Pending,
+)
+
+
+def _spin(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting for {msg}"
+        time.sleep(0.001)
+
+
+class _GatedEngine(FakePagedEngine):
+    """FakePagedEngine whose ``run_segment`` consumes one semaphore
+    permit per dispatch while ``hold`` is set — the worker thread steps
+    segment-by-segment so "mid-decode" is a sequenced fact, not a race
+    (the same gating idiom as test_continuous's drain tests)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.gate = threading.Semaphore(0)
+        self.hold = True
+        self.admitted = 0
+
+    def admit(self, entries):          # worker thread, lock NOT held
+        out = super().admit(entries)
+        self.admitted += len(entries)
+        return out
+
+    def run_segment(self):
+        if self.hold:
+            assert self.gate.acquire(timeout=30), "segment gate starved"
+        super().run_segment()
+
+
+def _gated_gateway(tenants, *, qos="fair", shed_after=None, slots=4):
+    eng = _GatedEngine(slots=slots, segment=2, max_total=24, page=8,
+                       step_s=0.0, dispatch_s=0.0, prefill_s=0.0)
+    cb = ContinuousBatcher(eng, stats=BatcherStats())
+    gw = ServeGateway([cb], tenants=tenants, qos=qos, shed_after=shed_after)
+    return eng, cb, gw
+
+
+def _release_and_join(eng, threads, timeout=30.0):
+    eng.hold = False
+    eng.gate.release()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "client thread stuck"
+
+
+# ---------------------------------------------------------------------------
+# admission: shed reasons, retry-after contract
+# ---------------------------------------------------------------------------
+
+def test_shed_reasons_and_retry_after_contract():
+    """At saturation a tenant over its admission rate is shed with a
+    positive ``retry_after_s`` (the bucket's refill horizon); when that
+    backoff already exceeds the request's deadline the reason upgrades
+    to ``deadline``. Admitted requests still finish bit-exact."""
+    eng, cb, gw = _gated_gateway(
+        {"lim": {"rate": 0.5, "burst": 1.0}}, shed_after=1)
+    results, errors = {}, []
+
+    def client(i, tenant):
+        try:
+            results[i] = gw.submit([1, 2, 3], 8, tenant=tenant, timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(0, "free"))]
+    threads[0].start()
+    _spin(lambda: gw.backlog() >= 1, msg="filler in flight")
+
+    # lim's single bucket token admits exactly one request at saturation
+    threads.append(threading.Thread(target=client, args=(1, "lim")))
+    threads[1].start()
+    _spin(lambda: gw.tenant_snapshot()["lim"]["submitted"] == 1,
+          msg="lim's one token spent")
+
+    with pytest.raises(ShedError) as exc:
+        gw.submit([4, 5], 6, tenant="lim")
+    assert exc.value.reason == "rate" and exc.value.tenant == "lim"
+    assert 0.0 < exc.value.retry_after_s <= 2.0   # (1 - tokens) / rate
+
+    with pytest.raises(ShedError) as exc:
+        gw.submit([4, 5], 6, tenant="lim", deadline_s=0.05)
+    assert exc.value.reason == "deadline"
+    assert exc.value.retry_after_s > 0.05         # backoff > deadline
+
+    _release_and_join(eng, threads)
+    assert not errors
+    for i, prompt in ((0, [1, 2, 3]), (1, [1, 2, 3])):
+        want = [int(x) for x in fake_row(prompt, len(prompt) + 8)]
+        assert results[i] == want, f"admitted request {i} diverged"
+    assert gw.snapshot()["shed_total"] == 2
+    lim = gw.tenant_snapshot()["lim"]
+    assert lim["shed"] == {"rate": 1, "deadline": 1}
+    assert lim["submitted"] == 1 and lim["finished"] == 1
+    assert isinstance(exc.value, RuntimeError)    # client except-clauses
+
+
+def test_expired_deadline_sheds_in_gateway_queue():
+    """A request that out-waits its own deadline parked in the gateway
+    queue is shed as ``expired`` at dispatch instead of burning a slot
+    on a reply its client abandoned."""
+    eng, cb, gw = _gated_gateway(
+        {"bulk": {"priority": "batch"}}, slots=2)
+    gw._spill_after = 1                 # room 0 while the filler is live
+    gw._shed_after = 10 ** 6            # admission itself never sheds here
+    errors, results = [], {}
+
+    def client(i, **kw):
+        try:
+            results[i] = gw.submit([1, 2, 3], 8, timeout=60.0, **kw)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    t0 = threading.Thread(target=client, args=(0,), kwargs={"tenant": "bulk"})
+    t0.start()
+    _spin(lambda: eng.admitted >= 1, msg="filler admitted")
+    t1 = threading.Thread(target=client, args=(1,),
+                          kwargs={"tenant": "bulk", "deadline_s": 0.02})
+    t1.start()
+    _spin(lambda: gw.tenant_snapshot()["bulk"]["queue_depth"] == 1,
+          msg="doomed request parked behind the saturated replica")
+    time.sleep(0.05)                    # out-wait the 20 ms deadline
+    _release_and_join(eng, [t0, t1])
+    assert len(errors) == 1 and isinstance(errors[0], ShedError)
+    assert errors[0].reason == "expired"
+    assert gw.tenant_snapshot()["bulk"]["shed"] == {"expired": 1}
+    assert 0 in results and 1 not in results
+
+
+def test_fifo_baseline_never_sheds():
+    """qos="fifo" is the A/B control: per-tenant accounting still works
+    but admission never sheds and nothing preempts — the same overload
+    that sheds under "fair" just queues."""
+    eng, cb, gw = _gated_gateway(
+        {"lim": {"rate": 0.5, "burst": 1.0}}, qos="fifo", shed_after=1)
+    results, errors = {}, []
+
+    def client(i):
+        try:
+            results[i] = gw.submit([1, 2, 3], 6, tenant="lim", timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(0,))]
+    threads[0].start()
+    _spin(lambda: gw.backlog() >= 1, msg="first request in flight")
+    for i in (1, 2, 3):                 # would all shed under "fair"
+        threads.append(threading.Thread(target=client, args=(i,)))
+        threads[-1].start()
+    _spin(lambda: gw.tenant_snapshot()["lim"]["submitted"] == 4,
+          msg="all four admitted despite an empty bucket")
+    _release_and_join(eng, threads)
+    assert not errors and len(results) == 4
+    want = [int(x) for x in fake_row([1, 2, 3], 9)]
+    assert all(r == want for r in results.values())
+    snap = gw.snapshot()
+    assert snap["qos"] == "fifo"
+    assert snap["shed_total"] == 0 and snap["preempted_total"] == 0
+    lim = gw.tenant_snapshot()["lim"]
+    assert lim["finished"] == 4 and lim["shed"] == {}
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair dequeue and priority classes (white-box: dispatcher asleep)
+# ---------------------------------------------------------------------------
+
+def _queued(gw, tenant, n, *, priority, cost=(4, 8)):
+    """Park n pre-stamped requests directly in a tenant's QoS queue
+    WITHOUT notifying the dispatcher (it stays blocked in its wait), so
+    the dequeue order can be observed synchronously under the lock."""
+    plen, mt = cost
+    t = gw._tenants[tenant]
+    for _ in range(n):
+        req = _Pending(list(range(1, plen + 1)), mt, 0.0, 0)
+        req.tenant, req.priority = tenant, priority
+        t.queue.append(req)
+
+
+def test_weighted_fair_dequeue_interleaves_by_weight():
+    """Two backlogged batch tenants at weights 2:1 and equal request
+    cost dequeue in the exact virtual-time order — tenant "a" gets two
+    dispatch slots for every one of "b", never a starving tail."""
+    eng, cb, gw = _gated_gateway({
+        "a": {"priority": "batch", "weight": 2.0},
+        "b": {"priority": "batch", "weight": 1.0},
+    })
+    with gw._lock:
+        _queued(gw, "a", 4, priority="batch")
+        _queued(gw, "b", 4, priority="batch")
+        order = [r.tenant for r in gw._dequeue_qos_locked()]
+    assert order == ["a", "b", "a", "a", "b", "a", "b", "b"]
+
+
+def test_latency_class_dequeues_before_batch_and_ignores_room():
+    """With the replicas saturated (zero dispatch room) batch-class work
+    stays parked at the gateway, but latency-class requests still flow —
+    the room budget only meters the class that can afford to wait."""
+    eng, cb, gw = _gated_gateway({
+        "chat": {"priority": "latency"},
+        "bulk": {"priority": "batch"},
+    })
+    with gw._lock:
+        _queued(gw, "chat", 2, priority="latency")
+        _queued(gw, "bulk", 2, priority="batch")
+        gw._spill_after = 0             # room 0: replicas "saturated"
+        first = [r.tenant for r in gw._dequeue_qos_locked()]
+        assert first == ["chat", "chat"]
+        assert len(gw._tenants["bulk"].queue) == 2
+        gw._spill_after = 8             # room frees -> batch drains
+        second = [r.tenant for r in gw._dequeue_qos_locked()]
+        assert second == ["bulk", "bulk"]
+
+
+# ---------------------------------------------------------------------------
+# priority preemption, end to end on the cost model
+# ---------------------------------------------------------------------------
+
+def test_latency_request_preempts_batch_victim_bit_exact():
+    """A latency-class arrival finding zero free slots evicts the newest
+    batch-class victim; the victim re-prefills from scratch after its
+    requeue and BOTH replies stay bit-identical to the cost model's solo
+    oracle — preemption moves latency, never tokens."""
+    eng, cb, gw = _gated_gateway({
+        "bulk": {"priority": "batch"},
+        "chat": {"priority": "latency", "weight": 2.0},
+    }, slots=2)
+    reqs = {0: ([1, 2, 3, 4], 12, "bulk"), 1: ([7, 8, 9], 12, "bulk"),
+            2: ([5, 5, 5], 6, "chat")}
+    results, errors = {}, []
+
+    def client(i):
+        prompt, mt, tenant = reqs[i]
+        try:
+            results[i] = gw.submit(prompt, mt, tenant=tenant, timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    _spin(lambda: eng.admitted + len(cb._queue) >= 2, msg="2 enqueued")
+    eng.gate.release()
+    _spin(lambda: eng.admitted >= 2, msg="both bulk admitted")
+    assert cb.free_slots() == 0
+    victims = cb.preemptible("batch")
+    assert len(victims) == 2            # newest admission first
+    assert victims[0][1].seq > victims[1][1].seq
+
+    threads.append(threading.Thread(target=client, args=(2,)))
+    threads[2].start()
+    # the dispatcher blocks inside preempt() until the worker (parked on
+    # the segment gate) reaches the control handshake
+    _spin(lambda: cb._ctl, msg="preempt handshake queued")
+    _release_and_join(eng, threads)
+    assert not errors and len(results) == 3
+    for i, (prompt, mt, _tenant) in reqs.items():
+        want = [int(x) for x in fake_row(prompt, len(prompt) + mt)]
+        assert results[i] == want, f"request {i} diverged after preemption"
+    snap = gw.snapshot()
+    assert snap["preempted_total"] == 1 and snap["shed_total"] == 0
+    ts = gw.tenant_snapshot()
+    assert ts["bulk"]["preempted_total"] == 1
+    assert ts["chat"]["finished"] == 1 and ts["chat"]["preempted_total"] == 0
+    assert cb.stats.snapshot()["requests_requeued_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# requeue determinism: the (submitted_at, seq) tiebreak
+# ---------------------------------------------------------------------------
+
+def test_seq_tiebreaks_equal_submitted_at():
+    """``time.monotonic`` ties on coarse clocks: requests stamped in the
+    same tick still sort in submission order via the process-wide ``seq``
+    counter, so every requeue path re-routes deterministically."""
+    ps = [_Pending([1], 2, 0.0, 0) for _ in range(6)]
+    for p in ps:                        # force the pathological tie
+        p.submitted_at = ps[0].submitted_at
+    assert [p.seq for p in ps] == sorted(p.seq for p in ps)
+    shuffled = ps[::2] + ps[1::2]
+    assert sorted(shuffled,
+                  key=lambda r: (r.submitted_at, r.seq)) == ps
+    # the preemption victim order is the same key reversed: newest first
+    assert sorted(shuffled, key=lambda r: (r.submitted_at, r.seq),
+                  reverse=True) == ps[::-1]
+
+
+# ---------------------------------------------------------------------------
+# validation + defaults
+# ---------------------------------------------------------------------------
+
+def test_qos_validation_and_default_tenant_policy():
+    eng = FakePagedEngine(slots=2, segment=2, max_total=24, page=8,
+                          step_s=0.0, dispatch_s=0.0, prefill_s=0.0)
+    cb = ContinuousBatcher(eng, stats=BatcherStats())
+    with pytest.raises(ValueError, match="qos"):
+        ServeGateway([cb], tenants={}, qos="nope")
+    for bad in ({"rate": 0.0}, {"burst": -1.0}, {"weight": 0.0},
+                {"priority": "urgent"}):
+        with pytest.raises(ValueError):
+            ServeGateway([ContinuousBatcher(
+                FakePagedEngine(slots=2, segment=2, max_total=24, page=8,
+                                step_s=0.0, dispatch_s=0.0, prefill_s=0.0),
+                stats=BatcherStats())], tenants={"t": bad})
+    assert set(QOS_MODES) == {"fair", "fifo"}
+    assert set(PRIORITIES) == {"latency", "batch"}
+
+    gw = ServeGateway([cb], tenants={})
+    with pytest.raises(ValueError, match="priority"):
+        gw.submit([1, 2], 2, tenant="x", priority="urgent")
+    # unknown tenants get an unmetered default policy: identity and
+    # accounting always work, limits are opt-in
+    assert gw.submit([1, 2], 0, tenant="nobody") == [1, 2]   # mt==0 path
+    got = gw.submit([1, 2, 3], 4, tenant="nobody", timeout=30.0)
+    assert got == [int(x) for x in fake_row([1, 2, 3], 7)]
+    nb = gw.tenant_snapshot()["nobody"]
+    assert nb["submitted"] == 2 and nb["finished"] == 2
+    assert nb["tokens"] is None         # unmetered bucket
+    assert nb["latency_p95_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# per-tenant traces: disjoint prefix groups, merged arrival order
+# ---------------------------------------------------------------------------
+
+def test_build_trace_tenants_disjoint_prefixes_sorted_arrivals():
+    tspec = {
+        "shape": "tenants",
+        "tenants": {
+            "alice": {"shape": "uniform", "requests": 4, "prefix_len": 8,
+                      "prefix_groups": 2},
+            "bob": {"shape": "burst", "requests": 4, "prefix_len": 8,
+                    "prefix_groups": 1, "bursts": [1], "burst_share": 1.0},
+        },
+    }
+    trace, arrivals, labels = build_trace_tenants(tspec, beats=4)
+    assert len(trace) == len(arrivals) == len(labels) == 8
+    assert sorted(arrivals) == list(arrivals)
+    assert set(labels) == {"alice", "bob"}
+    by_tenant = {}
+    for (prompt, _mt), label in zip(trace, labels):
+        by_tenant.setdefault(label, set()).add(tuple(prompt[:8]))
+    # cumulative group0 offsets keep the system prompts disjoint, so one
+    # tenant's prefix pages can never alias another's cache entries
+    assert not (by_tenant["alice"] & by_tenant["bob"])
+    assert len(by_tenant["alice"]) == 2 and len(by_tenant["bob"]) == 1
