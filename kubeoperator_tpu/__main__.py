@@ -40,7 +40,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from kubeoperator_tpu.api.app import ensure_admin, run_server
     from kubeoperator_tpu.services import (
-        autoscaler, backups, healing, ldap_auth, monitor,
+        autoscaler, backups, healing, ldap_auth, monitor, rollout,
     )
     from kubeoperator_tpu.services.platform import Platform
 
@@ -52,6 +52,7 @@ def main(argv: list[str] | None = None) -> int:
         ldap_auth.schedule(platform)
         healing.schedule(platform)
         autoscaler.schedule(platform)
+        rollout.schedule(platform)
     try:
         run_server(platform, host=args.host, port=args.port)
     finally:

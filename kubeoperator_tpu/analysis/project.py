@@ -34,11 +34,12 @@ _SERIES_SUFFIXES = ("_bucket", "_sum", "_count")
 
 #: README sections whose metric tables must equal the registry
 _TABLE_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
-                   "## Scenario replay", "## AOT compile cache")
+                   "## Scenario replay", "## Model lifecycle",
+                   "## AOT compile cache")
 #: README sections whose inline ko_* mentions must be registered
 _MENTION_SECTIONS = ("## Observability", "## Serving", "## Cluster serving",
                      "## Scheduling", "## Scenario replay",
-                     "## AOT compile cache")
+                     "## Model lifecycle", "## AOT compile cache")
 
 
 class ProjectRule(Rule):

@@ -774,6 +774,58 @@ async def aot_status(request: web.Request) -> web.Response:
         return CompileCache().status()
     return web.json_response(await _sync(request, _status))
 
+async def rollout_list(request: web.Request) -> web.Response:
+    from kubeoperator_tpu.services import rollout as rollout_svc
+    platform: Platform = request.app["platform"]
+    rows = await _sync(request, rollout_svc.rollout_status, platform)
+    visible = await _sync(request, visible_cluster_names, request)
+    if visible is not None:
+        rows = [r for r in rows if r["cluster"] in visible]
+    return web.json_response(rows)
+
+async def rollout_get(request: web.Request) -> web.Response:
+    """``GET /api/v1/rollouts/{id}`` — one rollout's full persisted
+    record (phase, cursor, per-replica versions, canary streaks, audit
+    history) by rollout id."""
+    from kubeoperator_tpu.services import rollout as rollout_svc
+    platform: Platform = request.app["platform"]
+    ro = await _sync(request, rollout_svc.get_rollout, platform,
+                     request.match_info["id"])
+    if ro is None:
+        return json_error(404, "no such rollout")
+    visible = await _sync(request, visible_cluster_names, request)
+    if visible is not None and ro.get("cluster") not in visible:
+        return json_error(404, "no such rollout")
+    return web.json_response(ro)
+
+async def rollout_start(request: web.Request) -> web.Response:
+    require_admin(request)
+    from kubeoperator_tpu.services import rollout as rollout_svc
+    platform: Platform = request.app["platform"]
+    body = await request.json()
+    try:
+        ro = await _sync(
+            request, rollout_svc.start_rollout, platform,
+            body["cluster"], body["model"], body["to_version"],
+            from_version=body.get("from_version", "v0"),
+            replicas=body.get("replicas"),
+            canary_beats=int(body.get("canary_beats", 3)),
+            breach_beats=int(body.get("breach_beats", 2)))
+    except (KeyError, ValueError) as e:
+        return json_error(400, str(e))
+    return web.json_response(ro, status=201)
+
+async def rollout_abort(request: web.Request) -> web.Response:
+    require_admin(request)
+    from kubeoperator_tpu.services import rollout as rollout_svc
+    platform: Platform = request.app["platform"]
+    try:
+        ro = await _sync(request, rollout_svc.abort_rollout, platform,
+                         request.match_info["cluster"])
+    except ValueError as e:
+        return json_error(400, str(e))
+    return web.json_response(ro)
+
 
 # ---------------------------------------------------------------------------
 # hosts
@@ -1226,6 +1278,10 @@ def create_app(platform: Platform) -> web.Application:
     r.add_get("/api/v1/dashboard/{item}", dashboard)
     r.add_get("/api/v1/autoscale/status", autoscale_status)
     r.add_get("/api/v1/aot/status", aot_status)
+    r.add_get("/api/v1/rollouts", rollout_list)
+    r.add_get("/api/v1/rollouts/{id}", rollout_get)
+    r.add_post("/api/v1/rollouts", rollout_start)
+    r.add_post("/api/v1/rollouts/{cluster}/abort", rollout_abort)
     r.add_get("/api/v1/logs", search_system_logs)
     r.add_get("/api/v1/events", search_cluster_events)
 

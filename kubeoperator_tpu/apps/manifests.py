@@ -321,7 +321,12 @@ data:
       {{"title": "AOT cache: hit/miss rate, bring-up p95", "type": "timeseries", "gridPos": {{"x":0,"y":48,"w":24,"h":8}},
         "targets": [{{"expr": "sum(rate(ko_aot_cache_hits_total[5m])) by (fn)", "legendFormat": "hits {{{{fn}}}}"}},
                     {{"expr": "sum(rate(ko_aot_cache_misses_total[5m])) by (fn)", "legendFormat": "misses {{{{fn}}}}"}},
-                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_aot_bringup_seconds_bucket[5m])) by (le, outcome))", "legendFormat": "bring-up p95 {{{{outcome}}}}"}}]}}
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_aot_bringup_seconds_bucket[5m])) by (le, outcome))", "legendFormat": "bring-up p95 {{{{outcome}}}}"}}]}},
+      {{"title": "Model rollouts: phase per model, start/complete/rollback rates", "type": "timeseries", "gridPos": {{"x":0,"y":64,"w":24,"h":8}},
+        "targets": [{{"expr": "max(ko_rollout_phase) by (model)", "legendFormat": "phase {{{{model}}}}"}},
+                    {{"expr": "sum(rate(ko_rollout_started_total[5m])) by (model)", "legendFormat": "started {{{{model}}}}"}},
+                    {{"expr": "sum(rate(ko_rollout_completed_total[5m])) by (model)", "legendFormat": "completed {{{{model}}}}"}},
+                    {{"expr": "sum(rate(ko_rollout_rolled_back_total[5m])) by (model)", "legendFormat": "rolled back {{{{model}}}}"}}]}}
     ]}}
 ---
 apiVersion: v1

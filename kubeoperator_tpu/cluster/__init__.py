@@ -1,12 +1,20 @@
 """Cluster-tier serving: the ``ServeGateway`` routing tier over N
 batcher replicas (sticky prefix hashing + load spill-over + gateway-
-level requeue on replica loss) and the disaggregated prefill→decode
-page-handoff workers."""
+level requeue on replica loss), the disaggregated prefill→decode
+page-handoff workers, and the live model lifecycle (replica groups,
+zero-downtime weight rollouts with SLO-canary judging and automatic
+rollback, refcounted base-weight page sharing)."""
 
 from kubeoperator_tpu.cluster.disagg import PrefillWorker, aligned_prefix
 from kubeoperator_tpu.cluster.gateway import (
-    POLICIES, PRIORITIES, QOS_MODES, AggregateStats, ServeGateway, ShedError,
+    DEFAULT_MODEL, POLICIES, PRIORITIES, QOS_MODES, AggregateStats,
+    ServeGateway, ShedError, UnknownModelError,
+)
+from kubeoperator_tpu.cluster.lifecycle import (
+    ROLLOUT_PHASES, TERMINAL_PHASES, ModelRollout, RolloutError, WeightPool,
 )
 
-__all__ = ["POLICIES", "PRIORITIES", "QOS_MODES", "AggregateStats",
-           "PrefillWorker", "ServeGateway", "ShedError", "aligned_prefix"]
+__all__ = ["DEFAULT_MODEL", "POLICIES", "PRIORITIES", "QOS_MODES",
+           "ROLLOUT_PHASES", "TERMINAL_PHASES", "AggregateStats",
+           "ModelRollout", "PrefillWorker", "RolloutError", "ServeGateway",
+           "ShedError", "UnknownModelError", "WeightPool", "aligned_prefix"]

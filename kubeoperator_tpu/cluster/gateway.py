@@ -73,6 +73,22 @@ into a replica:
 
 Without ``tenants`` the gateway is exactly the pre-QoS router: submit
 routes and delegates directly, nothing is shed, nothing preempts.
+
+Model identity (round 17): construct with ``models=`` (one
+``model_id@version`` string per batcher) and replicas join **replica
+groups** keyed by model id. ``submit(..., model=)`` routes within that
+group only — sticky-prefix hashing runs over the group's stable member
+list, spill stays inside the group, and an unregistered model raises a
+typed ``UnknownModelError`` carrying the available identities instead
+of crashing or silently cross-routing. A bare model id spans every
+version of the group (how live traffic keeps flowing mid-rollout, when
+the group is briefly split across two weight versions); a full
+``model_id@version`` pins the exact cohort (how the canary judge and
+tests address one side of a rollout). ``set_replica_version`` is the
+rollout controller's commit point: version is replica metadata, group
+membership never changes, so the sticky home mapping is stable across
+an entire rollout. Without ``models=`` every replica lands in one
+``default`` group and the gateway is exactly the pre-model router.
 """
 
 from __future__ import annotations
@@ -88,6 +104,10 @@ from kubeoperator_tpu.workloads.serving import _Pending
 POLICIES = ("sticky_prefix", "round_robin", "least_loaded")
 PRIORITIES = ("latency", "batch")
 QOS_MODES = ("fair", "fifo")
+
+#: model identity for gateways constructed without ``models=`` — one
+#: group, version v0, byte-compatible with every pre-model caller
+DEFAULT_MODEL = "default@v0"
 
 #: bounded per-tenant latency/TTFT sample windows (p95 estimation)
 _SAMPLE_WINDOW = 512
@@ -109,6 +129,21 @@ class ShedError(RuntimeError):
         self.tenant = tenant
         self.reason = reason
         self.retry_after_s = retry_after_s
+
+
+class UnknownModelError(LookupError):
+    """Typed rejection for ``submit(model=...)`` naming a model (or a
+    ``model@version`` cohort) no replica serves. ``available`` carries
+    the full ``model_id@version`` identities currently registered, so a
+    client can discover the fleet from the rejection itself — the same
+    machine-actionable contract as ``ShedError.retry_after_s``."""
+
+    def __init__(self, model: str | None, available: Sequence[str]):
+        avail = sorted(available)
+        super().__init__(
+            f"unknown model {model!r}: available models are {avail}")
+        self.model = model
+        self.available = avail
 
 
 class _Tenant:
@@ -236,14 +271,35 @@ class AggregateStats:
 
 
 class _Replica:
-    """One routing target: index is the sticky hash's stable identity."""
+    """One routing target: index is the sticky hash's stable identity.
+    ``model`` (the replica-group key) is fixed for the replica's life;
+    ``version`` is mutable metadata a rollout rewrites between drain and
+    readmit — the canary cohort label, never a routing-stability input."""
 
-    __slots__ = ("index", "batcher", "draining")
+    __slots__ = ("index", "batcher", "draining", "model", "version")
 
-    def __init__(self, index: int, batcher: Any):
+    def __init__(self, index: int, batcher: Any,
+                 model: str = DEFAULT_MODEL):
         self.index = index
         self.batcher = batcher
         self.draining = False
+        self.model, self.version = _split_identity(model)
+
+    @property
+    def identity(self) -> str:
+        return f"{self.model}@{self.version}"
+
+
+def _split_identity(model: str) -> tuple[str, str]:
+    """``model_id@version`` → (model_id, version); a bare id gets v0."""
+    if "@" in model:
+        mid, _, ver = model.partition("@")
+    else:
+        mid, ver = model, "v0"
+    if not mid or not ver:
+        raise ValueError(f"bad model identity {model!r}: want "
+                         f"'model_id' or 'model_id@version'")
+    return mid, ver
 
 
 class ServeGateway:
@@ -259,7 +315,8 @@ class ServeGateway:
                  spill_after: int | None = None, prefill_worker: Any = None,
                  handoff_min_pages: int = 1,
                  tenants: dict[str, dict] | None = None,
-                 qos: str = "fair", shed_after: int | None = None):
+                 qos: str = "fair", shed_after: int | None = None,
+                 models: Sequence[str] | None = None):
         if not batchers:
             raise ValueError("ServeGateway needs at least one batcher")
         if policy not in POLICIES:
@@ -270,6 +327,10 @@ class ServeGateway:
                              f"got {affinity_pages}")
         if qos not in QOS_MODES:
             raise ValueError(f"qos must be one of {QOS_MODES}, got {qos!r}")
+        if models is not None and len(models) != len(batchers):
+            raise ValueError(f"models must name one model_id@version per "
+                             f"batcher: got {len(models)} for "
+                             f"{len(batchers)} batchers")
         self.policy = policy
         self.affinity_pages = int(affinity_pages)
         self._page = int(getattr(batchers[0].engine, "page", 16))
@@ -279,7 +340,15 @@ class ServeGateway:
                              else 2 * int(batchers[0].engine.slots))
         self._prefill = prefill_worker
         self._handoff_min_pages = int(handoff_min_pages)
-        self.replicas = [_Replica(i, b) for i, b in enumerate(batchers)]
+        self.replicas = [
+            _Replica(i, b, models[i] if models is not None else DEFAULT_MODEL)
+            for i, b in enumerate(batchers)]
+        # replica groups keyed by model id: the member list is fixed at
+        # construction (sticky hashing needs a stable modulus), versions
+        # within it churn as rollouts rewrite them
+        self._groups: dict[str, list[_Replica]] = {}
+        for r in self.replicas:
+            self._groups.setdefault(r.model, []).append(r)
         self.stats = AggregateStats([b.stats for b in batchers])
         self._lock = threading.Lock()
         self._gcond = threading.Condition(self._lock)
@@ -317,12 +386,14 @@ class ServeGateway:
                temperature: float = 0.0, seed: int = 0,
                timeout: float | None = 300.0, tenant: str | None = None,
                priority: str | None = None,
-               deadline_s: float | None = None) -> list[int]:
+               deadline_s: float | None = None,
+               model: str | None = None) -> list[int]:
         prompt = list(prompt_ids)
+        model = self._resolve_model(model)
         if not self.qos:
             # pre-QoS direct path: route and delegate (tenant identity is
             # accepted but unenforced — nothing to admit against)
-            idx, decision = self._route(prompt)
+            idx, decision = self._route(prompt, model=model)
             tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
             if self._prefill is not None:
                 self._maybe_handoff(idx, prompt)
@@ -330,7 +401,26 @@ class ServeGateway:
                 prompt, max_tokens, temperature, seed, timeout=timeout)
         return self._submit_qos(prompt, int(max_tokens), float(temperature),
                                 int(seed), timeout, tenant or "default",
-                                priority, deadline_s)
+                                priority, deadline_s, model)
+
+    def _resolve_model(self, model: str | None) -> str | None:
+        """Validate a submit's model selector against the registered
+        groups. None stays None when there is exactly one group (the
+        pre-model fleet — routing ignores model entirely); with several
+        groups an unnamed submit is ambiguous and gets the same typed
+        rejection as an unknown name."""
+        if model is None:
+            if len(self._groups) == 1:
+                return None
+            raise UnknownModelError(model, self._identities())
+        mid, _, ver = model.partition("@")
+        group = self._groups.get(mid)
+        if group is None or (ver and all(r.version != ver for r in group)):
+            raise UnknownModelError(model, self._identities())
+        return model
+
+    def _identities(self) -> list[str]:
+        return sorted({r.identity for r in self.replicas})
 
     def _validate(self, prompt: list[int], max_tokens: int) -> None:
         """The batcher's submit-side validation, applied here because the
@@ -364,9 +454,11 @@ class ServeGateway:
     def _submit_qos(self, prompt: list[int], max_tokens: int,
                     temperature: float, seed: int, timeout: float | None,
                     tenant: str, priority: str | None,
-                    deadline_s: float | None) -> list[int]:
+                    deadline_s: float | None,
+                    model: str | None = None) -> list[int]:
         self._validate(prompt, max_tokens)
         req = _Pending(prompt, max_tokens, temperature, seed)
+        req.model = model
         with self._gcond:
             t = self._tenant(tenant)
             req.tenant = tenant
@@ -443,13 +535,32 @@ class ServeGateway:
     def _saturated(self, r: _Replica) -> bool:
         return r.batcher.backlog() >= self._spill_after
 
-    def _route(self, prompt: list[int], requeue: bool = False
-               ) -> tuple[int, str]:
+    def _members_locked(self, model: str | None) -> list[_Replica]:
+        """The routing universe for a submit's model selector: the whole
+        fleet (no selector / single group), one model's group (bare id),
+        or one version cohort (full identity). A cohort emptied by a
+        concurrent rollout commit re-raises the typed rejection — the
+        caller asked for a version that no longer exists."""
+        if model is None:
+            return self.replicas
+        mid, _, ver = model.partition("@")
+        members = self._groups.get(mid, [])
+        if ver:
+            members = [r for r in members if r.version == ver]
+        if not members:
+            raise UnknownModelError(model, self._identities())
+        return members
+
+    def _route(self, prompt: list[int], requeue: bool = False,
+               model: str | None = None) -> tuple[int, str]:
         with self._lock:
-            healthy = [r for r in self.replicas if not r.draining]
+            members = self._members_locked(model)
+            healthy = [r for r in members if not r.draining]
             if not healthy:
                 raise RuntimeError(
-                    "no healthy replicas: every gateway replica is draining")
+                    "no healthy replicas: every gateway replica "
+                    f"{'in group ' + model + ' ' if model else ''}"
+                    "is draining")
             if self.policy == "round_robin":
                 r = healthy[self._rr % len(healthy)]
                 self._rr += 1
@@ -461,7 +572,10 @@ class ServeGateway:
             if key is None:
                 r = min(healthy, key=self._load_key)
                 return self._picked(r.index, "least_loaded", requeue)
-            home = self.replicas[key % len(self.replicas)]
+            # the sticky modulus is the group's full member list (not
+            # just healthy, not just this version cohort) so the home
+            # mapping survives drains AND rollout version churn
+            home = members[key % len(members)]
             others = [r for r in healthy if r is not home]
             if not home.draining and (not self._saturated(home)
                                       or not others):
@@ -526,9 +640,17 @@ class ServeGateway:
         stops immediately), then drain every dp shard — its in-flight
         requests and stranded queue flow through the requeue sink into
         the gateway queue and re-route to healthy replicas. Returns the
-        requeued request ids."""
+        requeued request ids.
+
+        Idempotent under concurrency: the ``draining`` flag is the drain
+        claim, taken atomically under the gateway lock. A second caller
+        racing the first (the rollout beat vs a revoke_slice chaos
+        drain) loses the claim and returns ``[]`` immediately — the
+        victims belong to whoever won, so they requeue exactly once."""
         r = self.replicas[index]
         with self._gcond:
+            if r.draining:
+                return []
             r.draining = True
         dp = max(1, int(getattr(r.batcher.engine, "dp", 1)))
         ids = r.batcher.drain(range(dp), reason=reason, timeout=timeout)
@@ -545,6 +667,37 @@ class ServeGateway:
         with self._gcond:
             r.draining = False
             self._gcond.notify()
+
+    def set_replica_version(self, index: int, version: str) -> None:
+        """Rewrite one replica's version label — the rollout
+        controller's commit point, called between ``drain_replica`` and
+        ``readmit_replica`` once the new weights are installed. Group
+        membership (the sticky modulus) is untouched."""
+        if not version:
+            raise ValueError("version must be non-empty")
+        with self._lock:
+            self.replicas[index].version = str(version)
+
+    def model_snapshot(self) -> dict:
+        """Replica-group topology for the rollout controller and the
+        ``/api/v1/rollouts`` view: per model id, the member replicas
+        with their current version + draining flag, and the version →
+        indices cohort map the canary judge labels verdicts by."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for mid in sorted(self._groups):
+                members = self._groups[mid]
+                versions: dict[str, list[int]] = {}
+                for r in members:
+                    versions.setdefault(r.version, []).append(r.index)
+                out[mid] = {
+                    "replicas": [{"index": r.index, "version": r.version,
+                                  "draining": r.draining}
+                                 for r in members],
+                    "versions": {v: sorted(ix)
+                                 for v, ix in sorted(versions.items())},
+                }
+            return out
 
     # -- gateway requeue path -----------------------------------------------
     def _sink(self, reqs: list) -> None:
@@ -626,7 +779,9 @@ class ServeGateway:
         groups: dict[int, list] = {}
         for i, req in enumerate(batch):
             try:
-                idx, decision = self._route(req.prompt_ids, requeue=True)
+                idx, decision = self._route(req.prompt_ids, requeue=True,
+                                            model=getattr(req, "model",
+                                                          None))
             except RuntimeError:
                 # lost the race with a concurrent drain_replica — park
                 # the rest and wait for a readmit to wake us
@@ -653,7 +808,8 @@ class ServeGateway:
             req.done.set()
             return
         try:
-            idx, decision = self._route(req.prompt_ids)
+            idx, decision = self._route(req.prompt_ids,
+                                        model=getattr(req, "model", None))
         except RuntimeError:
             # every replica draining: park as a requeue victim; a
             # readmit wakes the dispatcher and re-routes it
@@ -734,6 +890,7 @@ class ServeGateway:
             return {
                 "replicas": len(self.replicas),
                 "policy": self.policy,
+                "models": sorted({r.identity for r in self.replicas}),
                 "draining": [r.index for r in self.replicas if r.draining],
                 "routed": routed,
                 "affinity_ratio": (self._sticky_hits / self._sticky_total

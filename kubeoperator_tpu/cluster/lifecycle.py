@@ -1,0 +1,507 @@
+"""Live model lifecycle: zero-downtime weight rollouts over a gateway.
+
+A weight rollout is the defining Day-2 operation of an inference
+platform: replace the model a replica group serves — under full traffic
+— without failing a single request and without betting the fleet's SLO
+budget on the new version being good. Every mechanism this needs
+already exists in the stack; this module composes them into one
+resumable state machine:
+
+* **pre-warm** (round 15): the new version's executables compile into
+  the AOT artifact store before any replica drains, so each readmit is
+  a cache load — same topology ⇒ zero compile events on the serving
+  path (pinned by the bench guard and the scenario acceptance).
+* **drain → install → readmit, one replica at a time** (round 13): the
+  gateway's drain protocol requeues the victim replica's in-flight
+  requests bit-exact through the gateway queue; the weights swap while
+  the replica is out of rotation; readmit hands it back to the router
+  already wearing the new ``version`` label. The group is never
+  half-routed: every other replica keeps serving, and sticky homes are
+  hashed over the full member list so affinity survives the churn.
+* **canary window** (round 16): after each readmit the updated
+  replicas are judged as their *own cohort* — the monitor's SLO engine
+  evaluates them under the ``model@version`` cohort label (the same
+  per-tenant dimension the QoS verdicts use, surfacing as
+  ``ko_slo_*{tenant="model@version"}``). Only ``canary_beats``
+  consecutive all-ok verdicts advance the cursor to the next replica.
+* **rollback** (round 11): ``breach_beats`` consecutive breach
+  verdicts reverse the machine — updated replicas re-drain onto the
+  prior weights, newest first, with the same requeue guarantees. A
+  rollback step that itself fails parks the machine in ``failed`` for
+  operator escalation (the services/rollout.py beat raises an ERROR
+  notification); it never thrashes.
+
+Crash/chaos safety is structural: the machine advances at most one
+transition per ``tick`` and externalises its entire state as a plain
+JSON-safe ``record`` dict after every transition. A ``revoke_slice``
+or replica death mid-phase shows up as a lost drain claim (the
+gateway's ``draining`` flag, satellite-fixed to be an atomic
+once-only claim), which **pauses** the machine; healing replaces the
+victim, readmit clears the flag, and the next tick auto-resumes from
+the persisted record — re-running the interrupted step, which is
+idempotent by construction.
+
+``WeightPool`` rides along for the paged-pool half of the story: small
+per-tenant variants (LoRA adapters, task heads) are mostly base
+weights, so the pool stores weight pages refcounted by content
+fingerprint — N variants resident cost one copy of the shared base
+pages plus their private deltas, the same trick the KV page pool plays
+with shared prefixes. A rollout wired with a pool accounts its
+``shared_pages`` vs ``new_pages`` per install, making "the v2 adapter
+is 94% base" a measured number.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.utils.ids import short_id
+from kubeoperator_tpu.utils.logs import get_logger
+
+log = get_logger(__name__)
+
+#: every phase a rollout record can persist; order is the
+#: ``ko_rollout_phase`` gauge's value (index) so dashboards can plot
+#: the machine's position as a step chart
+ROLLOUT_PHASES = ("prewarm", "drain", "canary", "rollback",
+                  "completed", "rolled_back", "failed", "aborted")
+
+#: phases with no further transitions
+TERMINAL_PHASES = ("completed", "rolled_back", "failed", "aborted")
+
+#: capped audit trail length inside the persisted record
+_HISTORY_CAP = 64
+
+
+class RolloutError(RuntimeError):
+    """A rollout operation that cannot proceed: unknown model/version,
+    a second rollout for a group that already has one in flight, or a
+    resume against a gateway whose topology no longer matches the
+    record."""
+
+
+class WeightPool:
+    """Page-granular, content-addressed weight store with refcounted
+    sharing across variants.
+
+    A *variant* (``model@version``, an adapter, a task head) is a
+    sequence of weight-page fingerprints. ``acquire`` allocates only
+    fingerprints no resident variant already holds — the shared base
+    pages of a family of small variants are stored once — and
+    ``release`` frees a page only when its last holder leaves. The
+    capacity check makes exhaustion a typed, actionable error instead
+    of an OOM three layers down. All methods are thread-safe (the
+    rollout beat and a scenario's chaos arm may race)."""
+
+    def __init__(self, pages: int, page: int = 16):
+        if pages < 1:
+            raise ValueError(f"pages must be >= 1, got {pages}")
+        self.pages = int(pages)
+        self.page = int(page)
+        self._lock = threading.Lock()
+        self._refs: dict[Any, int] = {}        # fingerprint -> holders
+        self._variants: dict[str, tuple[int, tuple]] = {}
+
+    def acquire(self, variant: str, fingerprints: Sequence[Any] | None = None
+                ) -> dict:
+        """Make ``variant`` resident (or bump its refcount if it already
+        is). Returns ``{"new_pages", "shared_pages", "resident_pages"}``
+        for the acquisition. Raises ``RuntimeError`` when the new unique
+        pages would not fit — nothing is partially installed."""
+        with self._lock:
+            if variant in self._variants:
+                count, fps = self._variants[variant]
+                self._variants[variant] = (count + 1, fps)
+                return {"new_pages": 0, "shared_pages": len(fps),
+                        "resident_pages": len(self._refs)}
+            fps = tuple(fingerprints or ())
+            fresh = {f for f in fps if f not in self._refs}
+            if len(self._refs) + len(fresh) > self.pages:
+                raise RuntimeError(
+                    f"weight pool exhausted: variant {variant!r} needs "
+                    f"{len(fresh)} free pages, "
+                    f"{self.pages - len(self._refs)} available")
+            for f in fps:
+                self._refs[f] = self._refs.get(f, 0) + 1
+            self._variants[variant] = (1, fps)
+            return {"new_pages": len(fresh),
+                    "shared_pages": len(fps) - len(fresh),
+                    "resident_pages": len(self._refs)}
+
+    def release(self, variant: str) -> int:
+        """Drop one hold on ``variant``; returns the pages actually
+        freed (0 while other holders — or other variants sharing the
+        same base pages — remain). Unknown variants are a no-op: a
+        rollback may release a version a crashed install never
+        acquired."""
+        with self._lock:
+            if variant not in self._variants:
+                return 0
+            count, fps = self._variants[variant]
+            if count > 1:
+                self._variants[variant] = (count - 1, fps)
+                return 0
+            del self._variants[variant]
+            freed = 0
+            for f in fps:
+                left = self._refs[f] - 1
+                if left:
+                    self._refs[f] = left
+                else:
+                    del self._refs[f]
+                    freed += 1
+            return freed
+
+    def sharing_ratio(self) -> float:
+        """Logical pages (sum of every resident variant's size) over
+        physical pages stored — 1.0 means no sharing, N means the pool
+        is storing each byte once for N logical copies."""
+        with self._lock:
+            logical = sum(len(fps) for _, fps in self._variants.values())
+            return logical / len(self._refs) if self._refs else 1.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            logical = sum(len(fps) for _, fps in self._variants.values())
+            return {
+                "capacity_pages": self.pages,
+                "used_pages": len(self._refs),
+                "logical_pages": logical,
+                "sharing_ratio": (round(logical / len(self._refs), 3)
+                                  if self._refs else 1.0),
+                "variants": {v: len(fps)
+                             for v, (_, fps) in sorted(
+                                 self._variants.items())},
+            }
+
+
+class ModelRollout:
+    """Resumable per-group rollout state machine over a live
+    ``ServeGateway``.
+
+    The machine owns nothing but its ``record`` (a plain JSON-safe
+    dict): every collaborator is injected — the gateway for
+    drain/readmit/version labels, ``install(index, version)`` for the
+    actual weight swap, ``prewarm(version)`` for the AOT warm-up, an
+    optional ``WeightPool`` + per-version fingerprint map for page
+    sharing. ``tick(canary_ok=...)`` advances at most one transition
+    and is safe to call from any beat cadence; after any crash,
+    ``ModelRollout.resume(gateway, record, ...)`` continues exactly
+    where the persisted record says."""
+
+    def __init__(self, gateway: Any, model: str, to_version: str, *,
+                 install: Callable[[int, str], Any] | None = None,
+                 prewarm: Callable[[str], Any] | None = None,
+                 canary_beats: int = 3, breach_beats: int = 2,
+                 weight_pool: WeightPool | None = None,
+                 weight_pages: dict[str, Sequence[Any]] | None = None,
+                 rollout_id: str | None = None,
+                 _record: dict | None = None):
+        self.gateway = gateway
+        self._install = install
+        self._prewarm = prewarm
+        self._pool = weight_pool
+        self._pages = weight_pages or {}
+        if _record is not None:
+            self.record = _record
+            self._check_topology()
+            return
+        if canary_beats < 1 or breach_beats < 1:
+            raise ValueError("canary_beats and breach_beats must be >= 1")
+        topo = gateway.model_snapshot()
+        if model not in topo:
+            raise RolloutError(
+                f"unknown model {model!r}: gateway serves {sorted(topo)}")
+        members = [r["index"] for r in topo[model]["replicas"]]
+        from_versions = {str(r["index"]): r["version"]
+                         for r in topo[model]["replicas"]}
+        if all(v == to_version for v in from_versions.values()):
+            raise RolloutError(
+                f"model {model!r} is already entirely on {to_version!r}")
+        self.record = {
+            "id": rollout_id or short_id(8),
+            "model": model,
+            "to_version": to_version,
+            "from_versions": from_versions,
+            "members": members,
+            "phase": "prewarm",
+            "cursor": 0,
+            "updated": [],
+            "ok_streak": 0,
+            "breach_streak": 0,
+            "canary_beats": int(canary_beats),
+            "breach_beats": int(breach_beats),
+            "paused": False,
+            "pause_reason": None,
+            "prewarm": None,
+            "weights": None,
+            "error": None,
+            "history": [],
+        }
+        tm.ROLLOUT_STARTED.inc(model=model)
+        self._set_phase("prewarm", "started")
+
+    @classmethod
+    def resume(cls, gateway: Any, record: dict, *,
+               install: Callable[[int, str], Any] | None = None,
+               prewarm: Callable[[str], Any] | None = None,
+               weight_pool: WeightPool | None = None,
+               weight_pages: dict[str, Sequence[Any]] | None = None
+               ) -> "ModelRollout":
+        """Reattach a machine to its persisted record — the crash
+        recovery path. The record is adopted as-is (phase, cursor,
+        updated set); the next ``tick`` re-runs the interrupted step."""
+        return cls(gateway, record["model"], record["to_version"],
+                   install=install, prewarm=prewarm,
+                   weight_pool=weight_pool, weight_pages=weight_pages,
+                   _record=dict(record))
+
+    def _check_topology(self) -> None:
+        topo = self.gateway.model_snapshot()
+        model = self.record["model"]
+        if model not in topo:
+            raise RolloutError(
+                f"cannot resume rollout {self.record['id']}: gateway no "
+                f"longer serves model {model!r}")
+        members = [r["index"] for r in topo[model]["replicas"]]
+        if members != self.record["members"]:
+            raise RolloutError(
+                f"cannot resume rollout {self.record['id']}: group "
+                f"members changed {self.record['members']} -> {members}")
+
+    # -- record plumbing ----------------------------------------------------
+    @property
+    def phase(self) -> str:
+        return self.record["phase"]
+
+    @property
+    def done(self) -> bool:
+        return self.phase in TERMINAL_PHASES
+
+    def canary_cohort(self) -> str:
+        """The SLO cohort label for the updated replicas —
+        ``model@to_version``, the key the monitor's per-cohort verdict
+        dimension (and the ``ko_slo_*`` tenant label) judges them by."""
+        return f"{self.record['model']}@{self.record['to_version']}"
+
+    def status(self) -> dict:
+        out = dict(self.record)
+        out["cohort"] = self.canary_cohort()
+        out["done"] = self.done
+        return out
+
+    def _set_phase(self, phase: str, event: str, **extra: Any) -> None:
+        self.record["phase"] = phase
+        hist = self.record["history"]
+        hist.append({"phase": phase, "event": event, **extra})
+        del hist[:-_HISTORY_CAP]
+        tm.ROLLOUT_PHASE.set(float(ROLLOUT_PHASES.index(phase)),
+                             model=self.record["model"])
+        log.info("[rollout %s] %s -> %s (%s)", self.record["id"],
+                 self.record["model"], phase, event)
+
+    def _replica_state(self, index: int) -> dict:
+        topo = self.gateway.model_snapshot()[self.record["model"]]
+        for r in topo["replicas"]:
+            if r["index"] == index:
+                return r
+        raise RolloutError(f"replica {index} left the group mid-rollout")
+
+    # -- control ------------------------------------------------------------
+    def pause(self, reason: str) -> None:
+        """Freeze the machine (chaos handler / operator hold). The
+        paused record persists; ``tick`` auto-resumes once the blocking
+        replica is back in rotation, or ``resume_now`` forces it."""
+        if not self.record["paused"] and not self.done:
+            self.record["paused"] = True
+            self.record["pause_reason"] = str(reason)
+            hist = self.record["history"]
+            hist.append({"phase": self.phase, "event": "paused",
+                         "reason": str(reason)})
+            del hist[:-_HISTORY_CAP]
+
+    def resume_now(self) -> None:
+        if self.record["paused"]:
+            self.record["paused"] = False
+            self.record["pause_reason"] = None
+            hist = self.record["history"]
+            hist.append({"phase": self.phase, "event": "resumed"})
+            del hist[:-_HISTORY_CAP]
+
+    def abort(self) -> str:
+        """Operator abort: nothing updated yet → ``aborted`` outright;
+        otherwise reverse through the ordinary rollback path so the
+        group converges back to the prior weights, never half-routed."""
+        if self.done:
+            return self.phase
+        self.record["paused"] = False
+        self.record["pause_reason"] = None
+        if not self.record["updated"] and self.phase in ("prewarm", "drain"):
+            self._set_phase("aborted", "abort")
+        else:
+            self._set_phase("rollback", "abort")
+        return self.phase
+
+    # -- the state machine --------------------------------------------------
+    def tick(self, canary_ok: bool | None = None) -> str:
+        """Advance at most one transition; returns the (new) phase.
+
+        ``canary_ok`` is the canary cohort's SLO verdict for this beat:
+        True (all cohort SLOs ok), False (breach), None (no data — the
+        cohort hasn't produced samples yet; neither advances nor counts
+        toward a breach). Outside the canary phase it is ignored."""
+        if self.done:
+            return self.phase
+        if self.record["paused"]:
+            if not self._unblocked():
+                return self.phase
+            self.resume_now()
+        phase = self.phase
+        if phase == "prewarm":
+            self._tick_prewarm()
+        elif phase == "drain":
+            self._tick_drain()
+        elif phase == "canary":
+            self._tick_canary(canary_ok)
+        elif phase == "rollback":
+            self._tick_rollback()
+        return self.phase
+
+    def _unblocked(self) -> bool:
+        """A paused machine may continue once its target replica is
+        back in rotation (healing readmitted it) — or immediately, if
+        the pause wasn't about a replica at all."""
+        if self.record["pause_reason"] != "replica_draining":
+            return True
+        idx = self._target_index()
+        return idx is None or not self._replica_state(idx)["draining"]
+
+    def _target_index(self) -> int | None:
+        if self.phase == "drain":
+            cursor = self.record["cursor"]
+            if cursor < len(self.record["members"]):
+                return self.record["members"][cursor]
+        if self.phase == "rollback" and self.record["updated"]:
+            return self.record["updated"][-1]
+        return None
+
+    def _tick_prewarm(self) -> None:
+        to = self.record["to_version"]
+        if self._prewarm is not None:
+            self.record["prewarm"] = self._prewarm(to)
+        if self._pool is not None:
+            got = self._pool.acquire(self.canary_cohort(),
+                                     self._pages.get(to))
+            self.record["weights"] = got
+        self._set_phase("drain", "prewarmed",
+                        result=self.record["prewarm"])
+
+    def _swap(self, index: int, version: str) -> None:
+        """Drain → install → relabel → readmit one replica. Raises on a
+        lost drain claim (``_Draining``) so the caller can pause; any
+        install failure propagates for the phase handler to judge."""
+        if self._replica_state(index)["draining"]:
+            raise _Draining(index)
+        self.gateway.drain_replica(index, reason="rollout")
+        try:
+            if self._install is not None:
+                self._install(index, version)
+            self.gateway.set_replica_version(index, version)
+        finally:
+            # readmit unconditionally: a failed install readmits on the
+            # OLD weights (set_replica_version never ran), keeping the
+            # group fully routed while the machine decides what's next
+            self.gateway.readmit_replica(index)
+
+    def _tick_drain(self) -> None:
+        idx = self.record["members"][self.record["cursor"]]
+        state = self._replica_state(idx)
+        if state["version"] == self.record["to_version"]:
+            # already swapped (a resumed record re-running the step, or
+            # healing rebuilt the replica straight onto the new weights)
+            if idx not in self.record["updated"]:
+                self.record["updated"].append(idx)
+            self.record["ok_streak"] = 0
+            self.record["breach_streak"] = 0
+            self._set_phase("canary", "already_updated", replica=idx)
+            return
+        try:
+            self._swap(idx, self.record["to_version"])
+        except _Draining:
+            self.pause("replica_draining")
+            return
+        except Exception as e:  # noqa: BLE001 — install is a plugin boundary
+            self.record["error"] = f"install {idx}: {e}"
+            self._set_phase("rollback", "install_failed", replica=idx,
+                            error=str(e))
+            return
+        self.record["updated"].append(idx)
+        self.record["ok_streak"] = 0
+        self.record["breach_streak"] = 0
+        self._set_phase("canary", "readmitted", replica=idx)
+
+    def _tick_canary(self, canary_ok: bool | None) -> None:
+        rec = self.record
+        if canary_ok is None:
+            return                      # no data: hold position
+        if canary_ok:
+            rec["ok_streak"] += 1
+            rec["breach_streak"] = 0
+            if rec["ok_streak"] < rec["canary_beats"]:
+                return
+            rec["cursor"] += 1
+            if rec["cursor"] >= len(rec["members"]):
+                if self._pool is not None:
+                    self._release_prior()
+                tm.ROLLOUT_COMPLETED.inc(model=rec["model"])
+                self._set_phase("completed", "all_replicas_ok")
+            else:
+                self._set_phase("drain", "canary_ok",
+                                next_replica=rec["members"][rec["cursor"]])
+            return
+        rec["breach_streak"] += 1
+        rec["ok_streak"] = 0
+        if rec["breach_streak"] >= rec["breach_beats"]:
+            self._set_phase("rollback", "canary_breach",
+                            breach_beats=rec["breach_streak"])
+
+    def _tick_rollback(self) -> None:
+        rec = self.record
+        if not rec["updated"]:
+            if self._pool is not None:
+                self._pool.release(self.canary_cohort())
+                rec["weights"] = None
+            tm.ROLLOUT_ROLLED_BACK.inc(model=rec["model"])
+            self._set_phase("rolled_back", "restored")
+            return
+        idx = rec["updated"][-1]           # newest first: least soak lost
+        prior = rec["from_versions"][str(idx)]
+        try:
+            self._swap(idx, prior)
+        except _Draining:
+            self.pause("replica_draining")
+            return
+        except Exception as e:  # noqa: BLE001 — rollback failing is terminal
+            rec["error"] = f"rollback {idx}: {e}"
+            self._set_phase("failed", "rollback_failed", replica=idx,
+                            error=str(e))
+            return
+        rec["updated"].pop()
+
+    def _release_prior(self) -> None:
+        """Completed: drop the pool holds on every prior version this
+        group no longer serves."""
+        for ver in set(self.record["from_versions"].values()):
+            if ver != self.record["to_version"]:
+                self._pool.release(f"{self.record['model']}@{ver}")
+
+
+class _Draining(Exception):
+    """Internal: the target replica is already out of rotation (chaos
+    or a concurrent drain owns it) — pause, don't fight."""
+
+    def __init__(self, index: int):
+        super().__init__(f"replica {index} is draining")
+        self.index = index

@@ -78,6 +78,9 @@ DEFAULTS: dict[str, Any] = {
     # autoscaler (ISSUE 11): the beat that acts on the SLO block. Opt-in
     # per deployment via the `autoscale` setting ("true"), like auto_heal.
     "autoscale_interval": 300,              # judge once per monitor beat
+    # rollout beat (ISSUE 17): resolves pending prewarm/install/restore
+    # executions and advances the weight-rollout state machine
+    "rollout_interval": 60,
     "autoscale_min_workers": 1,             # pool bounds (plain workers)
     "autoscale_max_workers": 8,
     "autoscale_step": 1,                    # workers added/removed per action

@@ -320,6 +320,41 @@ def cmd_autoscale(args) -> int:
     return 0
 
 
+def cmd_rollout(args) -> int:
+    """``ko rollout start|status|abort`` — live weight rollouts: staged
+    drain/readmit per replica, SLO-canary judged, automatic rollback."""
+    c = Client()
+    if args.action == "start":
+        body = {"cluster": args.cluster, "model": args.model,
+                "to_version": args.to_version}
+        if args.from_version:
+            body["from_version"] = args.from_version
+        if args.replicas is not None:
+            body["replicas"] = args.replicas
+        if args.canary_beats is not None:
+            body["canary_beats"] = args.canary_beats
+        if args.breach_beats is not None:
+            body["breach_beats"] = args.breach_beats
+        ro = c.call("POST", "/api/v1/rollouts", body)
+        print(f"rollout {ro['id']} started: {ro['model']} -> "
+              f"{ro['to_version']} on {args.cluster} "
+              f"(replicas {ro['members']}, phase {ro['phase']})")
+        return 0
+    if args.action == "abort":
+        ro = c.call("POST", f"/api/v1/rollouts/{args.cluster}/abort", {})
+        print(f"rollout {ro['id']} aborted (phase {ro['phase']})")
+        return 0
+    rows = c.call("GET", "/api/v1/rollouts")
+    for r in rows:
+        r["progress"] = f"{r['updated']}/{r['replicas']}"
+        r["canary"] = f"ok={r['ok_streak']} breach={r['breach_streak']}"
+        r["pending"] = r.get("pending_execution") or ""
+        r["error"] = r.get("error") or ""
+    table(rows, ["cluster", "id", "model", "to_version", "phase",
+                 "progress", "canary", "pending", "error"])
+    return 0
+
+
 def cmd_lint(args) -> int:
     # local static analysis — no controller, no login
     from kubeoperator_tpu.analysis.cli import run_lint
@@ -508,6 +543,30 @@ def build_parser(sub) -> None:
     scale = sub.add_parser("autoscale", help="SLO-driven autoscaler state")
     scale.add_argument("action", choices=("status",))
     scale.set_defaults(fn=cmd_autoscale)
+
+    roll = sub.add_parser(
+        "rollout", help="zero-downtime weight rollout with SLO-canary "
+                        "judging and automatic rollback")
+    roll.add_argument("action", choices=("start", "status", "abort"))
+    roll.add_argument("--cluster", default="",
+                      help="target cluster (start/abort)")
+    roll.add_argument("--model", default="",
+                      help="model id served by the gateway group")
+    roll.add_argument("--to-version", default="", dest="to_version",
+                      help="weight version to roll out")
+    roll.add_argument("--from-version", default="", dest="from_version",
+                      help="rollback target version (default: each "
+                           "replica's current version)")
+    roll.add_argument("--replicas", type=int, default=None,
+                      help="replica count to roll (default: the cluster's "
+                           "current worker sizing)")
+    roll.add_argument("--canary-beats", type=int, default=None,
+                      dest="canary_beats",
+                      help="consecutive ok beats to advance past a replica")
+    roll.add_argument("--breach-beats", type=int, default=None,
+                      dest="breach_beats",
+                      help="consecutive breach beats before rollback")
+    roll.set_defaults(fn=cmd_rollout)
 
     lint = sub.add_parser(
         "lint", help="static hot-path / control-plane analyzer")

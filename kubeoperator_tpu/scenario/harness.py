@@ -254,6 +254,126 @@ class _TrainLoop(threading.Thread):
                 "step_p95_s": round(p95, 4)}
 
 
+#: weight-page fingerprints for the scenario's cost-model variants: every
+#: version shares the same base pages and carries two private delta pages,
+#: so the WeightPool's sharing ratio is a measured number in the artifact
+_BASE_PAGES = tuple(f"base{i}" for i in range(12))
+
+
+def _variant_pages(version: str) -> list:
+    return list(_BASE_PAGES) + [f"{version}:d{j}" for j in range(2)]
+
+
+class _RolloutArm:
+    """One live ``ModelRollout`` machine driven beat-by-beat against a
+    stage's gateway. Each beat the arm samples the updated-replica
+    cohort into its own history (the ``model@version`` tenant-dimension
+    sub-points), re-judges with the SLO engine, and feeds the verdict
+    to ``tick`` — the monitor's canary discipline in miniature. An
+    ``inject_breach`` arm overrides the cohort's TTFT sample with a
+    breach-level value so the rollback path is exercised by a *real*
+    SLO verdict, not a stubbed boolean."""
+
+    def __init__(self, machine, stage: _Stage, slos: dict,
+                 inject_breach: bool, expect: str, entry: dict,
+                 fast: int, slow: int):
+        self.machine = machine
+        self.stage = stage
+        self.slos = dict(slos)
+        self.inject_breach = inject_breach
+        self.expect = expect
+        self.entry = entry
+        self.fast = fast
+        self.slow = slow
+        self.points: list[dict] = []
+        self.verdicts: list[str] = []
+        self.cohort_events: list[dict] = []
+        self.ticks = 0
+        self.paused_beats = 0
+
+    def _judge(self, vt: float) -> bool | None:
+        """Sample the cohort, re-judge, map to the tick verdict."""
+        cohort = self.machine.canary_cohort()
+        updated = self.machine.record["updated"]
+        stats = [self.stage.gateway.replicas[i].batcher.stats
+                 for i in updated]
+        ttfts = [t for t in (s.ttft_quantile(0.95) for s in stats)
+                 if t is not None]
+        snaps = [s.snapshot() for s in stats]
+        lats = [sn["latency_p95_s"] for sn in snaps if sn["requests_total"]]
+        if self.inject_breach:
+            # 10x the tightest cohort target, in seconds: a real breach
+            # for the SLO engine to flag, not a short-circuited boolean
+            target_ms = min((float(v.get("target", v))
+                             if isinstance(v, dict) else float(v))
+                            for v in self.slos.values())
+            ttfts = [target_ms / 1000.0 * 10.0]
+        if not ttfts and not lats:
+            return None                 # cohort has no samples yet: hold
+        self.points.append(serve_history_point(
+            vt,
+            ttft_p95_s=None, latency_p95_s=None, queue_depth=None,
+            slot_occupancy=None, kv_pages_used=None,
+            tenants={cohort: {
+                "ttft_p95_s": max(ttfts) if ttfts else None,
+                "latency_p95_s": max(lats) if lats else None,
+                "queue_depth": sum(sn["queue_depth"] for sn in snaps),
+            }}))
+        block = evaluate_slos({"tenants": {cohort: self.slos}},
+                              self.points, fast_window=self.fast,
+                              slow_window=self.slow)
+        # each re-judge reports only the edge the newest point introduced,
+        # so extending accumulates every distinct breach edge exactly once
+        self.cohort_events.extend(e for e in block["events"]
+                                  if e.get("tenant") == cohort)
+        states = [s.get("state")
+                  for s in (block.get("tenants") or {})
+                  .get(cohort, {}).values()]
+        if any(s == "breach" for s in states):
+            return False
+        if states and all(s == "ok" for s in states):
+            return True
+        return None
+
+    def beat(self, vt: float) -> None:
+        if self.machine.done:
+            return
+        self.ticks += 1
+        if self.machine.record["paused"]:
+            self.paused_beats += 1
+        verdict = None
+        if self.machine.phase == "canary":
+            verdict = self._judge(vt)
+            self.verdicts.append(
+                {True: "ok", False: "breach", None: "no_data"}[verdict])
+        self.machine.tick(verdict)
+
+    def finish(self, pool) -> list[str]:
+        """Fill the injection-log entry with the outcome; returns the
+        errors (expectation misses) to surface in the report."""
+        rec = self.machine.record
+        self.entry.update(
+            rollout_id=rec["id"],
+            phase=rec["phase"],
+            cohort=self.machine.canary_cohort(),
+            updated=list(rec["updated"]),
+            ticks=self.ticks,
+            paused_beats=self.paused_beats,
+            verdicts=self.verdicts,
+            cohort_breach_events=self.cohort_events,
+            weights=rec.get("weights"),
+            prewarm=rec.get("prewarm"),
+            expect=self.expect,
+        )
+        if pool is not None:
+            self.entry["weight_pool"] = pool.snapshot()
+        if rec["phase"] != self.expect:
+            return [f"rollout {rec['id']} ({self.entry['target']}): "
+                    f"expected terminal phase {self.expect!r}, got "
+                    f"{rec['phase']!r} (error: {rec.get('error')})"]
+        return []
+
+
 def _slice_of(ev: dict, spec: dict) -> dict:
     sl = ev.get("slice") if isinstance(ev.get("slice"), dict) \
         else spec.get("slice")
@@ -263,10 +383,41 @@ def _slice_of(ev: dict, spec: dict) -> dict:
 
 
 def _apply_chaos(ev: dict, chaos: ChaosExecutor, spec: dict,
-                 stages: list[_Stage], beat: int) -> dict:
+                 stages: list[_Stage], beat: int,
+                 rollouts: dict | None = None) -> dict:
     """Fire one scheduled fault; returns the injection-log entry."""
     kind = ev["kind"]
     entry: dict[str, Any] = {"beat": beat, "kind": kind}
+    if kind == "rollout":
+        from kubeoperator_tpu.cluster import ModelRollout, WeightPool
+        st = next(s for s in stages if s.gateway is not None)
+        model = ev.get("model", "default")
+        to_version = ev["to_version"]
+        entry["target"] = f"{model}@{to_version}"
+        if rollouts.get("pool") is None:
+            rollouts["pool"] = WeightPool(pages=64)
+        pool = rollouts["pool"]
+        # make the outgoing versions resident so the new variant's page
+        # sharing against the base weights is measurable
+        topo = st.gateway.model_snapshot()[model]
+        for ver in topo["versions"]:
+            variant = f"{model}@{ver}"
+            if variant not in pool.snapshot()["variants"]:
+                pool.acquire(variant, _variant_pages(ver))
+        machine = ModelRollout(
+            st.gateway, model, to_version,
+            prewarm=lambda v: {"version": v, "compiles": 0,
+                               "source": "aot-cache"},
+            canary_beats=int(ev.get("canary_beats", 1)),
+            breach_beats=int(ev.get("breach_beats", 2)),
+            weight_pool=pool,
+            weight_pages={to_version: _variant_pages(to_version)})
+        rollouts["live"].append(_RolloutArm(
+            machine, st, ev.get("slo") or {"ttft_p95_ms": 8000},
+            bool(ev.get("inject_breach")),
+            ev.get("expect", "completed"), entry,
+            rollouts["fast"], rollouts["slow"]))
+        return entry
     if kind == "flake":
         chaos.flake(ev["pattern"], float(ev["rate"]))
         entry["target"] = ev["pattern"]
@@ -402,6 +553,7 @@ def run_scenario(spec: dict) -> dict:
                                         name=f"ko-scenario-{wname}"))
 
     injections: list[dict] = []
+    rollouts: dict = {"pool": None, "live": [], "fast": fast, "slow": slow}
     probe_failures = 0
     for tr in trains:
         tr.start()
@@ -414,7 +566,8 @@ def run_scenario(spec: dict) -> dict:
     while beat < beats or (any(d.is_alive() for d in drivers)
                            and beat < beats * OVERTIME_FACTOR):
         for ev in by_beat.get(beat, ()):
-            injections.append(_apply_chaos(ev, chaos, spec, stages, beat))
+            injections.append(_apply_chaos(ev, chaos, spec, stages, beat,
+                                           rollouts))
         for ip in hosts:
             if chaos.run(Conn(ip=ip), f"healthz beat={beat}").rc != 0:
                 probe_failures += 1
@@ -424,9 +577,22 @@ def run_scenario(spec: dict) -> dict:
         vt = round((beat + 1) * beat_s, 3)
         for st in stages:
             st.sample(vt, fast, slow)
+        for arm in rollouts["live"]:
+            arm.beat(vt)
         beat += 1
     for d in drivers:
         d.join(timeout)
+    # a rollout started late in the window may still be mid-machine once
+    # the traffic drains — keep ticking (bounded) so the terminal phase,
+    # not a truncation, is the outcome of record
+    vt = beat * beat_s
+    extra = 0
+    while any(not a.machine.done for a in rollouts["live"]) \
+            and extra < beats * OVERTIME_FACTOR:
+        extra += 1
+        vt = round(vt + beat_s, 3)
+        for arm in rollouts["live"]:
+            arm.beat(vt)
     for tr in trains:
         tr.stop()
         tr.join(5.0)
@@ -434,9 +600,12 @@ def run_scenario(spec: dict) -> dict:
     workloads = {st.name: st.report(fast, slow) for st in stages}
     bit_exact = all(w["bit_exact"] for w in workloads.values())
     slo_ok = all(w["slo_ok"] for w in workloads.values())
+    rollout_errors: list[str] = []
+    for arm in rollouts["live"]:
+        rollout_errors += arm.finish(rollouts["pool"])
     errors = [w["error"] for w in workloads.values() if w["error"]] + \
         [f"driver still alive after {timeout}s"
-         for d in drivers if d.is_alive()]
+         for d in drivers if d.is_alive()] + rollout_errors
     ok = slo_ok and bit_exact and not errors
     verdict = "error" if errors else ("ok" if slo_ok else "breach")
     metrics.SCENARIO_RUNS.inc(scenario=name, verdict=verdict)
@@ -463,6 +632,14 @@ def run_scenario(spec: dict) -> dict:
         },
         "requeued_total": sum(w["requeued_total"]
                               for w in workloads.values()),
+        "rollouts": [
+            {"id": a.entry.get("rollout_id"),
+             "target": a.entry.get("target"),
+             "phase": a.machine.phase,
+             "expect": a.expect,
+             "paused_beats": a.paused_beats,
+             "ok": a.machine.phase == a.expect}
+            for a in rollouts["live"]],
         "bit_exact": bit_exact,
         "errors": errors,
     }

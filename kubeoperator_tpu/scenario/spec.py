@@ -55,6 +55,18 @@ A scenario is one dict (YAML on disk, plain dict in tests)::
       - {beat: 5, kind: kill_host, ip: 10.0.0.2}
       - {beat: 6, kind: revive, ip: 10.0.0.2}
       - {beat: 1, kind: fail_next, n: 2, pattern: healthz}
+      - {beat: 1, kind: rollout, model: default, to_version: v2,
+         canary_beats: 1, breach_beats: 2, slo: {ttft_p95_ms: 8000},
+         inject_breach: false, expect: completed}
+                                    # live weight rollout against the
+                                    #   gateway-fronted serving workload:
+                                    #   the harness ticks the machine one
+                                    #   transition per beat, judging the
+                                    #   updated-replica cohort with the
+                                    #   SLO engine; inject_breach feeds
+                                    #   the cohort breach-level samples to
+                                    #   prove rollback; expect is the
+                                    #   required terminal phase
     slo_windows: {fast: 4, slow: 8} # evaluate_slos windows, in beats
 
 ``validate_spec`` returns human-readable problems instead of raising so
@@ -70,7 +82,7 @@ from typing import Any
 from kubeoperator_tpu.scenario.traces import TRACE_SHAPES
 
 CHAOS_KINDS = ("flake", "latency", "fail_next", "kill_host", "revive",
-               "revoke_slice", "restore_slice")
+               "revoke_slice", "restore_slice", "rollout")
 WORKLOAD_KINDS = ("serving", "pipeline", "train")
 ENGINE_KINDS = ("paged", "dense")
 
@@ -131,6 +143,7 @@ def validate_spec(spec: Any) -> list[str]:
         errs.append("workloads: at least one workload is required")
         workloads = []
     serving = 0
+    gateway_fronted = False     # any serving workload routed by a gateway
     for i, w in enumerate(workloads):
         where = f"workloads[{i}]"
         if not isinstance(w, dict):
@@ -157,6 +170,8 @@ def validate_spec(spec: Any) -> list[str]:
         if kind == "pipeline" and reps > 1:
             errs.append(f"{where}.replicas: only serving workloads route "
                         f"through the gateway")
+        if kind == "serving" and (reps > 1 or w.get("tenants")):
+            gateway_fronted = True
         tspec = w.get("trace", {})
         if not isinstance(tspec, dict):
             errs.append(f"{where}.trace: must be a mapping")
@@ -245,6 +260,25 @@ def validate_spec(spec: Any) -> list[str]:
                 and not ev.get("slice"):
             errs.append(f"{where}: {kind} needs a slice block (spec-level "
                         f"'slice' or per-event {{slice, ips, shard}})")
+        if kind == "rollout":
+            tv = ev.get("to_version")
+            if not isinstance(tv, str) or not tv:
+                errs.append(f"{where}: rollout needs a non-empty "
+                            f"to_version string")
+            if not gateway_fronted:
+                errs.append(f"{where}: rollout needs a gateway-fronted "
+                            f"serving workload (replicas > 1 or tenants)")
+            for bk in ("canary_beats", "breach_beats"):
+                bv = ev.get(bk)
+                if bv is not None and (not isinstance(bv, int)
+                                       or isinstance(bv, bool) or bv < 1):
+                    errs.append(f"{where}.{bk}: must be a positive "
+                                f"integer, got {bv!r}")
+            if ev.get("expect") is not None \
+                    and ev["expect"] not in ("completed", "rolled_back"):
+                errs.append(f"{where}.expect: must be 'completed' or "
+                            f"'rolled_back', got {ev.get('expect')!r}")
+            errs += _slo_errors(f"{where}.slo", ev.get("slo"))
     sw = spec.get("slo_windows", {})
     if not isinstance(sw, dict):
         errs.append("slo_windows: must be a mapping of {fast, slow}")
@@ -329,6 +363,39 @@ SCENARIOS: dict[str, dict] = {
         "chaos": [
             {"beat": 3, "kind": "revoke_slice"},
             {"beat": 7, "kind": "restore_slice"},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "rollout_mid_burst": {
+        "name": "rollout_mid_burst",
+        "description": "live weight rollout (v0 -> v2) across three "
+                       "gateway replicas mid burst: one replica at a time, "
+                       "SLO-canary judged per model@version cohort; a "
+                       "slice revocation pauses the machine mid-rollout "
+                       "and the restore resumes it; a second "
+                       "injected-breach arm (v2 -> v3) proves automatic "
+                       "rollback — all with zero failed requests",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": dict(_ENGINE),
+        "hosts": list(_HOSTS),
+        "slice": dict(_SLICE),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "replicas": 3, "router": "sticky_prefix",
+             "trace": {"shape": "burst", "requests": 36, "bursts": [2, 3],
+                       "share": 0.6, "prefix_len": 32, "prefix_groups": 6},
+             "serve_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 64}},
+        ],
+        "chaos": [
+            {"beat": 1, "kind": "rollout", "model": "default",
+             "to_version": "v2", "canary_beats": 1, "breach_beats": 2,
+             "slo": {"ttft_p95_ms": 8000}, "expect": "completed"},
+            {"beat": 4, "kind": "revoke_slice"},
+            {"beat": 8, "kind": "restore_slice"},
+            {"beat": 9, "kind": "rollout", "model": "default",
+             "to_version": "v3", "canary_beats": 1, "breach_beats": 2,
+             "slo": {"ttft_p95_ms": 8000}, "inject_breach": True,
+             "expect": "rolled_back"},
         ],
         "slo_windows": {"fast": 4, "slow": 8},
     },

@@ -77,6 +77,13 @@ QUERIED_METRICS = {
     "ko_aot_cache_hits_total": "jax-serve",
     "ko_aot_cache_misses_total": "jax-serve",
     "ko_aot_bringup_seconds_bucket": "jax-serve",
+    # model lifecycle (round 17): rollout state-machine position and
+    # outcomes — the lifecycle controller runs inside the gateway/serving
+    # process, so these ride the same jax-serve /metrics endpoint
+    "ko_rollout_started_total": "jax-serve",
+    "ko_rollout_completed_total": "jax-serve",
+    "ko_rollout_rolled_back_total": "jax-serve",
+    "ko_rollout_phase": "jax-serve",
 }
 
 # The dashboard-snapshot PromQL, in one table so the exporter cross-check
@@ -146,6 +153,15 @@ PROMQL = {
     "aot_bringup_p95":
         "histogram_quantile(0.95, "
         "sum(rate(ko_aot_bringup_seconds_bucket[5m])) by (le))",
+    # model lifecycle (round 17): where each model's rollout machine sits
+    # (phase index — a flat line at 4 is converged, a sawtooth through 3
+    # means canaries keep breaching) and the start/complete/rollback
+    # outcome rates the Day-2 runbook alarms on
+    "rollout_phase": "max(ko_rollout_phase) by (model)",
+    "rollout_started_rate": "sum(rate(ko_rollout_started_total[5m]))",
+    "rollout_completed_rate": "sum(rate(ko_rollout_completed_total[5m]))",
+    "rollout_rolled_back_rate":
+        "sum(rate(ko_rollout_rolled_back_total[5m]))",
 }
 
 
@@ -575,6 +591,18 @@ class ClusterMonitor:
         aot_hit_rate = prom.scalar_or_none(PROMQL["aot_hit_rate"])
         aot_miss_rate = prom.scalar_or_none(PROMQL["aot_miss_rate"])
         aot_bringup_p95 = prom.scalar_or_none(PROMQL["aot_bringup_p95"])
+        # model lifecycle (round 17): {} marks "no rollout controller"
+        try:
+            rollout_phases = {
+                r.get("metric", {}).get("model", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["rollout_phase"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            rollout_phases = {}
+        rollout_started = prom.scalar_or_none(PROMQL["rollout_started_rate"])
+        rollout_completed = prom.scalar_or_none(
+            PROMQL["rollout_completed_rate"])
+        rollout_rolled_back = prom.scalar_or_none(
+            PROMQL["rollout_rolled_back_rate"])
         data = {
             "cluster": self.cluster.name,
             "status": self.cluster.status,
@@ -610,6 +638,10 @@ class ClusterMonitor:
             "aot_hit_rate": aot_hit_rate,
             "aot_miss_rate": aot_miss_rate,
             "aot_bringup_p95": aot_bringup_p95,
+            "rollout_phase_by_model": rollout_phases,
+            "rollout_started_rate": rollout_started,
+            "rollout_completed_rate": rollout_completed,
+            "rollout_rolled_back_rate": rollout_rolled_back,
             "time": iso_now(),
         }
         self._save_snapshot(data)

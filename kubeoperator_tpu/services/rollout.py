@@ -1,0 +1,366 @@
+"""Rollout beat: the control-plane half of the live model lifecycle.
+
+``cluster/lifecycle.py`` is the in-process mechanism — a resumable
+state machine driving a live ``ServeGateway`` (the scenario harness and
+the serve job run it there, where drain/readmit are direct calls).
+This beat is the same machine lifted to the deploy plane, where a
+"swap one replica's weights" step is not a function call but a tracked
+``DeployExecution`` through the ordinary operation engine, exactly how
+the autoscaler actuates:
+
+* each per-replica install (and each rollback restore) is one
+  ``create_execution(cluster, "scale")`` carrying a ``rollout`` param
+  block, emitted under the shared single-mutator guard
+  (services/mutation.py) — never while another mutation runs, at most
+  one desired-state change per cluster;
+* a pending execution is tracked to completion: SUCCESS advances the
+  persisted record (install → canary, restore → next restore);
+  FAILURE of an install starts the rollback **re-emission** (restore
+  the prior version, WARNING); FAILURE of a restore is terminal —
+  the record parks in ``failed`` and an **ERROR** notification
+  escalates to the operator, because desired state now needs a human;
+* the canary window reads the monitor's persisted SLO block — the
+  updated replicas' cohort verdict lives under the
+  ``model@version`` key of the per-cohort (tenant-dimension) verdicts,
+  ``ko_slo_*{tenant="model@version"}`` on the dashboard — and only
+  ``canary_beats`` consecutive all-ok beats advance the cursor;
+  ``breach_beats`` consecutive breaches reverse the machine.
+
+The record (a ``MonitorSnapshot`` sibling, ``<cluster>:rollout``) is
+the single source of truth: every transition persists before the next
+beat reads it, so a control-plane crash resumes mid-rollout exactly like
+the in-process machine resumes after chaos. ``ko rollout
+start/status/abort`` and ``GET /api/v1/rollouts/{id}`` are thin reads
+and writes over the same record.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeoperator_tpu.resources.entities import (
+    Cluster, DeployExecution, ExecutionState, Node,
+)
+from kubeoperator_tpu.services.healing import _current_sizing
+from kubeoperator_tpu.services.monitor import MonitorSnapshot
+from kubeoperator_tpu.services.mutation import execution_busy, mutation_slot
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.utils.ids import short_id
+from kubeoperator_tpu.utils.logs import get_logger
+
+ROLLOUT_PHASES = ("prewarm", "drain", "canary", "rollback", "completed",
+                  "rolled_back", "failed", "aborted")
+TERMINAL_PHASES = ("completed", "rolled_back", "failed", "aborted")
+
+log = get_logger(__name__)
+
+
+# -- persisted per-cluster record -------------------------------------------
+
+def _load_record(platform, cluster_name: str) -> MonitorSnapshot:
+    found = platform.store.find(MonitorSnapshot, scoped=False,
+                                name=f"{cluster_name}:rollout")
+    return found[0] if found else MonitorSnapshot(
+        project=cluster_name, name=f"{cluster_name}:rollout")
+
+
+def _save_record(platform, rec: MonitorSnapshot) -> None:
+    platform.store.save(rec)
+
+
+def _worker_count(platform, cluster: Cluster) -> int:
+    sizing = _current_sizing(platform, cluster)
+    if "worker_size" in sizing:
+        return int(sizing["worker_size"])
+    return sum(1 for n in platform.store.find(Node, scoped=False,
+                                              project=cluster.name)
+               if "master" not in n.roles)
+
+
+def _set_phase(ro: dict, phase: str, event: str, **extra: Any) -> None:
+    ro["phase"] = phase
+    ro.setdefault("history", []).append(
+        {"phase": phase, "event": event, **extra})
+    del ro["history"][:-64]
+    tm.ROLLOUT_PHASE.set(float(ROLLOUT_PHASES.index(phase)),
+                         model=ro["model"])
+
+
+def _cohort_verdict(platform, cluster_name: str,
+                    cohort: str) -> bool | None:
+    """The canary cohort's SLO verdict from the latest persisted monitor
+    snapshot: True (every cohort SLO ok), False (any breach), None (no
+    data — the cohort has no judged window yet). The beat never talks
+    to Prometheus itself, mirroring the autoscaler."""
+    found = platform.store.find(MonitorSnapshot, scoped=False,
+                                name=cluster_name)
+    block = (found[0].data.get("slo") if found else None) or {}
+    slos = (block.get("tenants") or {}).get(cohort) or {}
+    states = [s.get("state") for s in slos.values()]
+    if any(s == "breach" for s in states):
+        return False
+    if states and all(s == "ok" for s in states):
+        return True
+    return None
+
+
+# -- start / abort / status (the CLI + API surface) -------------------------
+
+def start_rollout(platform, cluster_name: str, model: str,
+                  to_version: str, *, from_version: str = "v0",
+                  replicas: int | None = None, canary_beats: int = 3,
+                  breach_beats: int = 2) -> dict:
+    """Create the persisted rollout record (phase ``prewarm``); the next
+    beat starts actuating. One rollout per cluster at a time — a second
+    start while one is live is refused, not queued (the operator should
+    abort or wait; silently queueing hides an in-flight mutation)."""
+    clusters = [c for c in platform.store.find(Cluster, scoped=False)
+                if c.name == cluster_name]
+    if not clusters:
+        raise ValueError(f"unknown cluster {cluster_name!r}")
+    if canary_beats < 1 or breach_beats < 1:
+        raise ValueError("canary_beats and breach_beats must be >= 1")
+    if not model or not to_version:
+        raise ValueError("model and to_version must be non-empty")
+    rec = _load_record(platform, cluster_name)
+    live = rec.data.get("rollout")
+    if live and live.get("phase") not in TERMINAL_PHASES:
+        raise ValueError(
+            f"cluster {cluster_name!r} already has rollout "
+            f"{live['id']} in phase {live['phase']!r}: abort it first")
+    n = replicas if replicas is not None \
+        else max(1, _worker_count(platform, clusters[0]))
+    ro = {
+        "id": short_id(8),
+        "cluster": cluster_name,
+        "model": model,
+        "to_version": to_version,
+        "from_versions": {str(i): from_version for i in range(n)},
+        "members": list(range(n)),
+        "phase": "prewarm",
+        "cursor": 0,
+        "updated": [],
+        "ok_streak": 0,
+        "breach_streak": 0,
+        "canary_beats": int(canary_beats),
+        "breach_beats": int(breach_beats),
+        "error": None,
+        "started_at": time.time(),
+        "history": [],
+    }
+    rec.data = {"rollout": ro, "pending": None, "pending_kind": None,
+                "pending_replica": None}
+    tm.ROLLOUT_STARTED.inc(model=model)
+    _set_phase(ro, "prewarm", "started")
+    _save_record(platform, rec)
+    log.warning("[%s] rollout %s: %s -> %s@%s over %d replicas",
+                cluster_name, ro["id"], model, model, to_version, n)
+    return dict(ro)
+
+
+def abort_rollout(platform, cluster_name: str) -> dict:
+    """Reverse (or cancel) the cluster's live rollout: nothing updated
+    yet → ``aborted`` outright, else the ordinary rollback path — the
+    group must converge back to the prior weights."""
+    rec = _load_record(platform, cluster_name)
+    ro = rec.data.get("rollout")
+    if not ro or ro.get("phase") in TERMINAL_PHASES:
+        raise ValueError(f"cluster {cluster_name!r} has no live rollout")
+    if not ro["updated"] and rec.data.get("pending_kind") != "install":
+        _set_phase(ro, "aborted", "abort")
+    else:
+        _set_phase(ro, "rollback", "abort")
+    _save_record(platform, rec)
+    return dict(ro)
+
+
+def rollout_status(platform, cluster_name: str | None = None
+                   ) -> list[dict[str, Any]]:
+    """One row per cluster that has (ever had) a rollout record — the
+    ``ko rollout status`` / API read path."""
+    rows: list[dict[str, Any]] = []
+    for cluster in platform.store.find(Cluster, scoped=False):
+        if cluster_name is not None and cluster.name != cluster_name:
+            continue
+        data = _load_record(platform, cluster.name).data
+        ro = data.get("rollout")
+        if not ro:
+            continue
+        rows.append({
+            "cluster": cluster.name,
+            "id": ro["id"],
+            "model": ro["model"],
+            "to_version": ro["to_version"],
+            "phase": ro["phase"],
+            "cursor": ro["cursor"],
+            "replicas": len(ro["members"]),
+            "updated": len(ro["updated"]),
+            "ok_streak": ro["ok_streak"],
+            "breach_streak": ro["breach_streak"],
+            "pending_execution": data.get("pending"),
+            "error": ro.get("error"),
+        })
+    return rows
+
+
+def get_rollout(platform, rollout_id: str) -> dict | None:
+    """Full record by rollout id (``GET /api/v1/rollouts/{id}``)."""
+    for rec in platform.store.find(MonitorSnapshot, scoped=False):
+        if not (rec.name or "").endswith(":rollout"):
+            continue
+        ro = rec.data.get("rollout")
+        if ro and ro.get("id") == rollout_id:
+            return {**ro, "pending_execution": rec.data.get("pending"),
+                    "pending_kind": rec.data.get("pending_kind")}
+    return None
+
+
+# -- the beat ---------------------------------------------------------------
+
+def _emit(platform, cluster: Cluster, ro: dict, kind: str,
+          replica: int | None, version: str) -> DeployExecution | None:
+    """One tracked weight-install execution under the mutation slot —
+    the current sizing plus a ``rollout`` param block the accelerator
+    step consumes (model, version, target replica). None = slot refused
+    or preflight rejected; the beat retries next tick."""
+    params = dict(_current_sizing(platform, cluster))
+    params["rollout"] = {"id": ro["id"], "model": ro["model"],
+                         "version": version, "replica": replica,
+                         "kind": kind}
+    with mutation_slot(platform, cluster) as claimed:
+        if not claimed:
+            return None
+        try:
+            ex = platform.create_execution(cluster.name, "scale", params)
+        except Exception as e:  # noqa: BLE001 — per-cluster boundary
+            log.warning("[%s] rollout %s emit refused: %s",
+                        cluster.name, kind, e)
+            return None
+        platform.start_execution(ex)
+    return ex
+
+
+def _resolve_pending(platform, cluster: Cluster, data: dict) -> bool:
+    """Track the in-flight execution. True = still pending (skip this
+    cluster); False = resolved, the beat may act again."""
+    exid = data.get("pending")
+    if not exid:
+        return False
+    ro = data["rollout"]
+    kind = data.get("pending_kind")
+    replica = data.get("pending_replica")
+    ex = platform.store.get(DeployExecution, exid, scoped=False)
+    state = ex.state if ex is not None else ExecutionState.FAILURE
+    if state in (ExecutionState.PENDING, ExecutionState.STARTED):
+        return True
+    data.update(pending=None, pending_kind=None, pending_replica=None)
+    if state == ExecutionState.SUCCESS:
+        if kind == "prewarm":
+            _set_phase(ro, "drain", "prewarmed")
+        elif kind == "install":
+            ro["updated"].append(replica)
+            ro["ok_streak"] = 0
+            ro["breach_streak"] = 0
+            _set_phase(ro, "canary", "readmitted", replica=replica)
+        elif kind == "restore":
+            if replica in ro["updated"]:
+                ro["updated"].remove(replica)
+            if not ro["updated"]:
+                tm.ROLLOUT_ROLLED_BACK.inc(model=ro["model"])
+                _set_phase(ro, "rolled_back", "restored")
+        return False
+    # FAILURE
+    if kind == "restore":
+        ro["error"] = f"restore of replica {replica} failed ({exid})"
+        _set_phase(ro, "failed", "rollback_failed", replica=replica)
+        platform.notify(
+            title=f"cluster {cluster.name}: rollout {ro['id']} rollback "
+                  f"FAILED — replica {replica} needs operator attention",
+            level="ERROR", project=cluster.name,
+            content={"rollout": ro["id"], "execution": exid,
+                     "replica": replica})
+        return False
+    ro["error"] = f"{kind} failed ({exid})"
+    _set_phase(ro, "rollback", f"{kind}_failed", replica=replica)
+    platform.notify(
+        title=f"cluster {cluster.name}: rollout {ro['id']} {kind} failed "
+              f"— rolling back to prior weights",
+        level="WARNING", project=cluster.name,
+        content={"rollout": ro["id"], "execution": exid,
+                 "replica": replica})
+    return False
+
+
+def rollout_tick(platform) -> list[str]:
+    """Advance every cluster's live rollout by at most one transition.
+    Returns ``"<cluster>:<phase>"`` per cluster acted on (tests)."""
+    actions: list[str] = []
+    for cluster in platform.store.find(Cluster, scoped=False):
+        rec = _load_record(platform, cluster.name)
+        ro = rec.data.get("rollout")
+        if not ro or ro["phase"] in TERMINAL_PHASES:
+            continue
+        if _resolve_pending(platform, cluster, rec.data):
+            _save_record(platform, rec)
+            continue
+        phase = ro["phase"]
+        if phase == "canary":
+            cohort = f"{ro['model']}@{ro['to_version']}"
+            verdict = _cohort_verdict(platform, cluster.name, cohort)
+            if verdict is True:
+                ro["ok_streak"] += 1
+                ro["breach_streak"] = 0
+                if ro["ok_streak"] >= ro["canary_beats"]:
+                    ro["cursor"] += 1
+                    if ro["cursor"] >= len(ro["members"]):
+                        tm.ROLLOUT_COMPLETED.inc(model=ro["model"])
+                        _set_phase(ro, "completed", "all_replicas_ok")
+                    else:
+                        _set_phase(ro, "drain", "canary_ok")
+            elif verdict is False:
+                ro["breach_streak"] += 1
+                ro["ok_streak"] = 0
+                if ro["breach_streak"] >= ro["breach_beats"]:
+                    _set_phase(ro, "rollback", "canary_breach")
+            actions.append(f"{cluster.name}:{ro['phase']}")
+            _save_record(platform, rec)
+            continue
+        if execution_busy(platform, cluster):
+            _save_record(platform, rec)
+            continue
+        if phase == "prewarm":
+            ex = _emit(platform, cluster, ro, "prewarm", None,
+                       ro["to_version"])
+            if ex is not None:
+                rec.data.update(pending=ex.id, pending_kind="prewarm",
+                                pending_replica=None)
+                actions.append(f"{cluster.name}:prewarm")
+        elif phase == "drain":
+            idx = ro["members"][ro["cursor"]]
+            ex = _emit(platform, cluster, ro, "install", idx,
+                       ro["to_version"])
+            if ex is not None:
+                rec.data.update(pending=ex.id, pending_kind="install",
+                                pending_replica=idx)
+                actions.append(f"{cluster.name}:drain")
+        elif phase == "rollback":
+            if not ro["updated"]:
+                tm.ROLLOUT_ROLLED_BACK.inc(model=ro["model"])
+                _set_phase(ro, "rolled_back", "restored")
+                actions.append(f"{cluster.name}:rolled_back")
+            else:
+                idx = ro["updated"][-1]     # newest first
+                prior = ro["from_versions"][str(idx)]
+                ex = _emit(platform, cluster, ro, "restore", idx, prior)
+                if ex is not None:
+                    rec.data.update(pending=ex.id, pending_kind="restore",
+                                    pending_replica=idx)
+                    actions.append(f"{cluster.name}:rollback")
+        _save_record(platform, rec)
+    return actions
+
+
+def schedule(platform) -> None:
+    platform.tasks.every(platform.config.get("rollout_interval", 60),
+                         "rollout", lambda: rollout_tick(platform))

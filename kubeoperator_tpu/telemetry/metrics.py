@@ -656,6 +656,33 @@ SERVE_PREEMPTIONS = REGISTRY.counter(
     "re-prefill with bit-identical replies).",
     labels=("tenant",))
 
+# -- model lifecycle families (cluster/lifecycle.py, round 17) --------------
+# Set by the rollout state machine driving zero-downtime weight rollouts
+# over the gateway's replica groups, on the process-global REGISTRY like
+# the other gateway-tier families.
+ROLLOUT_STARTED = REGISTRY.counter(
+    "ko_rollout_started_total",
+    "Weight rollouts started, by model id (one per rollout record, "
+    "counted when the state machine enters prewarm).",
+    labels=("model",))
+ROLLOUT_COMPLETED = REGISTRY.counter(
+    "ko_rollout_completed_total",
+    "Weight rollouts that converged onto the new version — every group "
+    "replica updated and its canary window judged all-ok — by model id.",
+    labels=("model",))
+ROLLOUT_ROLLED_BACK = REGISTRY.counter(
+    "ko_rollout_rolled_back_total",
+    "Weight rollouts reversed onto the prior weights after a sustained "
+    "canary-cohort SLO breach (or an operator abort past the first "
+    "replica), by model id.",
+    labels=("model",))
+ROLLOUT_PHASE = REGISTRY.gauge(
+    "ko_rollout_phase",
+    "Current rollout state-machine phase per model id, as the index into "
+    "(prewarm drain canary rollback completed rolled_back failed aborted) "
+    "— a step chart of the machine's position.",
+    labels=("model",))
+
 
 declare_serve_metrics(REGISTRY)
 declare_train_metrics(REGISTRY)

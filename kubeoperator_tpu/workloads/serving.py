@@ -107,6 +107,10 @@ class _Pending:
     tenant: str = "default"
     priority: str = "latency"
     deadline_s: float | None = None
+    # model routing (round 17): the gateway's replica-group selector,
+    # carried so requeue victims re-route inside their own group instead
+    # of leaking across models mid-rollout; None = the single-group fleet
+    model: str | None = None
     # first-token latency stamped by the worker at the TTFT observation,
     # so the gateway can aggregate TTFT per tenant without new plumbing
     ttft_s: float | None = None
@@ -679,10 +683,19 @@ class ContinuousBatcher:
             # ko: lint-ok[KO201] caller holds _cond: _apply_ctl_locked runs inside the worker's lock scope
             self._free = [s for s in self._free
                           if s // self._shard_slots not in shard_set]
+            # the stranded queue leaves through the sink exactly once:
+            # when this drain NEWLY completes full-shard coverage. A
+            # re-drain of already-fenced shards (the rollout beat racing
+            # a revoke_slice drain) finds covered_before True and must
+            # not ship the queue again — its contents were either already
+            # requeued or submitted after the fence and belong to the
+            # next readmit, not to a duplicate requeue.
+            covered_before = len(self._drained) == self._dp
             # ko: lint-ok[KO201] caller holds _cond: _apply_ctl_locked runs inside the worker's lock scope
             self._drained |= shard_set
             sink = self.requeue_sink
-            if sink is not None and len(self._drained) == self._dp:
+            if sink is not None and not covered_before \
+                    and len(self._drained) == self._dp:
                 reqs += list(self._queue)
                 self._queue.clear()
             reqs.sort(key=lambda r: (r.submitted_at, r.seq))  # submission order

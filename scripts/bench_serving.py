@@ -51,6 +51,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -300,6 +301,108 @@ def bench_qos(victim_requests: int = 10, burst_factor: int = 10,
             (qos["victim_ttft_p95_s"] or 0.0) / base, 3),
         "fifo_degradation": round(
             (fifo["victim_ttft_p95_s"] or 0.0) / base, 3),
+    }
+
+
+def bench_rollout(requests: int = 48, replicas: int = 3, slots: int = 8,
+                  segment: int = 8, page: int = 16, prefix_len: int = 32,
+                  step_s: float = 0.0002, dispatch_s: float = 0.0005,
+                  prefill_s: float = 0.01, stagger_s: float = 0.004,
+                  max_total: int = 256, cold_compile_s: float = 0.25,
+                  tick_s: float = 0.005) -> dict:
+    """Round 17: zero-downtime weight rollout A/B under live load —
+    AOT-prewarmed vs cold swap, SAME gateway shape, SAME shared-prefix
+    trace replayed through client threads while a ``ModelRollout``
+    walks the group from v0 to v2 one replica at a time (drain with
+    bit-exact requeue -> install -> readmit on the new version).
+
+    * ``prewarmed`` — the install loads a pre-compiled executable from
+      the AOT artifact cache and base weight pages are shared through
+      the ``WeightPool``, so each replica's out-of-rotation window is
+      just the drain handoff;
+    * ``cold`` — each install pays ``cold_compile_s`` of retrace/compile
+      stall while the replica is OUT of rotation, so the degraded
+      (N-1 replica) window is ``replicas`` compiles longer.
+
+    Both arms must finish every request (``run_load`` raises on any
+    client error and asserts replies token-for-token) — the
+    zero-failed-requests contract is the headline, the shorter degraded
+    window is the prewarm payoff. The tier-1 guard pins errors at 0 in
+    both arms and the prewarmed rollout strictly faster."""
+    from kubeoperator_tpu.cluster import ModelRollout, ServeGateway, WeightPool
+
+    trace = make_prefix_trace(requests, prefix_len)
+    base_pages = [f"base{i}" for i in range(12)]
+
+    def arm(mode: str) -> dict:
+        engines = [FakePagedEngine(
+            slots=slots, segment=segment, max_total=max_total, page=page,
+            step_s=step_s, dispatch_s=dispatch_s, prefill_s=prefill_s)
+            for _ in range(replicas)]
+        batchers = [ContinuousBatcher(e, stats=BatcherStats())
+                    for e in engines]
+        gw = ServeGateway(batchers, policy="sticky_prefix")
+        pool = WeightPool(pages=64)
+        pool.acquire("default@v0", base_pages)
+        installed: list[tuple[int, str]] = []
+
+        def install(index: int, version: str) -> None:
+            if mode == "cold":
+                time.sleep(cold_compile_s)      # retrace on new weights
+            installed.append((index, version))
+
+        machine = ModelRollout(
+            gw, "default", "v2",
+            install=install,
+            prewarm=lambda v: {"version": v, "compiles": 0,
+                               "source": "aot-cache" if mode == "prewarmed"
+                               else "cold"},
+            canary_beats=1, breach_beats=2,
+            weight_pool=pool,
+            weight_pages={"v2": base_pages + ["v2:d0", "v2:d1"]})
+        rollout_wall = [0.0]
+
+        def drive():
+            t0 = time.perf_counter()
+            while not machine.done:
+                machine.tick(True)
+                time.sleep(tick_s)
+            rollout_wall[0] = time.perf_counter() - t0
+
+        driver = threading.Thread(target=drive)
+        driver.start()
+        r = run_load(gw, trace, stagger_s)      # raises on ANY failure
+        driver.join()
+        snap = gw.snapshot()
+        return {
+            "mode": mode,
+            "wall_s": round(r["wall_s"], 3),
+            "tok_s": round(r["tok_s"], 1),
+            "mean_ttft_s": round(gw.stats.ttft_mean(), 4),
+            "rollout_s": round(rollout_wall[0], 3),
+            "phase": machine.record["phase"],
+            "installed": installed,
+            "models": snap["models"],
+            "requeued_total": snap["requeued_total"],
+            "errors_total": sum(
+                rep.batcher.stats.snapshot()["errors_total"]
+                for rep in gw.replicas),
+            "weights": machine.record.get("weights"),
+            "sharing_ratio": pool.snapshot()["sharing_ratio"],
+        }
+
+    prewarmed = arm("prewarmed")
+    cold = arm("cold")
+    return {
+        "requests": requests,
+        "replicas": replicas,
+        "cold_compile_s": cold_compile_s,
+        "prewarmed": prewarmed,
+        "cold": cold,
+        "rollout_speedup": round(
+            cold["rollout_s"] / max(prewarmed["rollout_s"], 1e-9), 2),
+        "zero_failed_requests": (prewarmed["errors_total"] == 0
+                                 and cold["errors_total"] == 0),
     }
 
 
@@ -559,6 +662,13 @@ def main() -> None:
     ap.add_argument("--burst-factor", type=int, default=10,
                     help="qos mode: neighbor burst volume as a multiple "
                          "of the victim stream")
+    ap.add_argument("--rollout", action="store_true",
+                    help="zero-downtime weight rollout A/B under live "
+                         "load: AOT-prewarmed vs cold swap through the "
+                         "gateway, one replica at a time (cost model)")
+    ap.add_argument("--cold-compile", type=float, default=0.25,
+                    help="rollout mode: injected retrace/compile stall "
+                         "per cold replica install")
     ap.add_argument("--tracing-overhead", action="store_true",
                     help="A/B the continuous engine with the serve tracer "
                          "off vs on (round 9: must stay under 5%% tok/s)")
@@ -653,6 +763,41 @@ def main() -> None:
                     f"preempt={qos['preempted_total']} | "
                     f"fifo p95={result['fifo']['victim_ttft_p95_s']}s "
                     f"({result['fifo_degradation']}x)"),
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+        return
+    if args.rollout:
+        result = bench_rollout(requests=args.requests,
+                               replicas=args.replicas,
+                               cold_compile_s=args.cold_compile)
+        print(json.dumps(result))
+        if args.out:
+            pw, cold = result["prewarmed"], result["cold"]
+            artifact = {
+                "rc": 0,
+                "ok": (result["zero_failed_requests"]
+                       and pw["phase"] == "completed"
+                       and cold["phase"] == "completed"
+                       and pw["rollout_s"] < cold["rollout_s"]),
+                "skipped": False,
+                "requests": result["requests"],
+                "replicas": result["replicas"],
+                "cold_compile_s": result["cold_compile_s"],
+                "rollout_speedup": result["rollout_speedup"],
+                "zero_failed_requests": result["zero_failed_requests"],
+                "prewarmed": pw,
+                "cold": cold,
+                "tail": (
+                    f"prewarmed rollout={pw['rollout_s']}s "
+                    f"ttft={pw['mean_ttft_s']}s "
+                    f"requeued={pw['requeued_total']} "
+                    f"shared={pw['weights']['shared_pages'] if pw['weights'] else 0} | "
+                    f"cold rollout={cold['rollout_s']}s "
+                    f"ttft={cold['mean_ttft_s']}s | "
+                    f"{result['rollout_speedup']}x faster swap, "
+                    f"errors=0 both arms"),
             }
             with open(args.out, "w") as f:
                 json.dump(artifact, f, indent=1)

@@ -1,0 +1,651 @@
+"""Live model lifecycle (round 17): multi-model routing, the atomic
+drain claim, the weight-page pool, and the ModelRollout state machine —
+zero-downtime weight rollouts with SLO-canary judging, automatic
+rollback, and chaos pause/resume.
+
+The signature property extends round 13's: a rollout is a sequence of
+drain/readmit cycles, so every reply delivered across one — disturbed
+or not — must stay bit-identical to solo generate(), and no request may
+fail. The cost-model engines make those checks exact and fast."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeoperator_tpu.cluster import (
+    DEFAULT_MODEL, ModelRollout, RolloutError, ServeGateway,
+    UnknownModelError, WeightPool,
+)
+from kubeoperator_tpu.cluster.lifecycle import ROLLOUT_PHASES
+from kubeoperator_tpu.scenario.engines import FakePagedEngine, fake_row
+from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.workloads.serving import BatcherStats, ContinuousBatcher
+
+
+def _cluster(n, *, models=None, slots=4, step_s=0.0):
+    engines = [FakePagedEngine(slots=slots, segment=2, max_total=24, page=8,
+                               step_s=step_s)
+               for _ in range(n)]
+    batchers = [ContinuousBatcher(e, stats=BatcherStats()) for e in engines]
+    return engines, ServeGateway(batchers, policy="sticky_prefix",
+                                 models=models)
+
+
+def _want(prompt, mt):
+    return [int(x) for x in fake_row(prompt, len(prompt) + mt)]
+
+
+def _spin(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting for {msg}"
+        time.sleep(0.001)
+
+
+def _gate_engine(eng):
+    """Gate an engine's segments behind a semaphore so 'mid-decode' is a
+    sequenced fact, not a sleep race (same choreography as round 13)."""
+    gate = threading.Semaphore(0)
+    hold = {"on": True}
+    orig = eng.run_segment
+
+    def gated():
+        if hold["on"]:
+            assert gate.acquire(timeout=30), "segment gate starved"
+        orig()
+
+    eng.run_segment = gated
+    return gate, hold
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed unknown-model rejection (mirrors ShedError's contract)
+# ---------------------------------------------------------------------------
+
+def test_unknown_model_error_message_lists_available():
+    e = UnknownModelError("gpt-5", ["llama@v1", "gemma@v2"])
+    assert str(e) == ("unknown model 'gpt-5': available models are "
+                      "['gemma@v2', 'llama@v1']")
+    assert e.model == "gpt-5"
+    assert e.available == ["gemma@v2", "llama@v1"]
+    assert isinstance(e, LookupError)   # typed, catchable, never a 500
+
+
+def test_gateway_rejects_unknown_model_typed():
+    _, gw = _cluster(2, models=["llama@v1", "llama@v1"])
+    with pytest.raises(UnknownModelError) as ei:
+        gw.submit([1, 2, 3], 4, model="gemma")
+    assert ei.value.available == ["llama@v1"]
+    # a known model id at an unserved version is just as unknown
+    with pytest.raises(UnknownModelError):
+        gw.submit([1, 2, 3], 4, model="llama@v9")
+    # no dispatcher activity for a rejected request
+    assert gw.stats.snapshot()["requests_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-model routing
+# ---------------------------------------------------------------------------
+
+def test_multi_model_groups_route_and_stay_bit_exact():
+    """Two models behind one gateway: submissions route within the named
+    group only, replies are bit-exact, and the default-model shorthand
+    is refused once more than one group exists."""
+    _, gw = _cluster(3, models=["llama@v1", "llama@v1", "gemma@v2"])
+    assert gw.snapshot()["models"] == ["gemma@v2", "llama@v1"]
+
+    got_l = gw.submit([5, 6, 7], 4, model="llama", timeout=30.0)
+    got_g = gw.submit([8, 9], 5, model="gemma@v2", timeout=30.0)
+    assert got_l == _want([5, 6, 7], 4)
+    assert got_g == _want([8, 9], 5)
+    routed = gw.snapshot()["routed"]
+    assert sum(routed.get("2", {}).values()) == 1     # gemma's one replica
+    assert sum(sum(d.values()) for k, d in routed.items()
+               if k in ("0", "1")) == 1               # llama group
+
+    # ambiguous: two groups, no model named
+    with pytest.raises(UnknownModelError):
+        gw.submit([1, 2], 3)
+
+
+def test_single_group_default_model_still_implicit():
+    """Round-13 compatibility: an un-labeled gateway serves DEFAULT_MODEL
+    and plain submit() keeps working unchanged."""
+    _, gw = _cluster(2)
+    assert gw.snapshot()["models"] == [DEFAULT_MODEL]
+    assert gw.submit([3, 1, 4], 4, timeout=30.0) == _want([3, 1, 4], 4)
+
+
+def test_model_snapshot_groups_versions_and_drains():
+    _, gw = _cluster(3, models=["llama@v1", "llama@v2", "gemma@v1"])
+    gw.drain_replica(0)
+    snap = gw.model_snapshot()
+    assert sorted(snap) == ["gemma", "llama"]
+    assert snap["llama"]["versions"] == {"v1": [0], "v2": [1]}
+    assert [r for r in snap["llama"]["replicas"] if r["index"] == 0
+            ][0]["draining"] is True
+    gw.set_replica_version(1, "v3")
+    assert gw.model_snapshot()["llama"]["versions"] == {"v1": [0],
+                                                        "v3": [1]}
+
+
+# ---------------------------------------------------------------------------
+# satellite: the drain claim is atomic and idempotent (no double drain)
+# ---------------------------------------------------------------------------
+
+def test_drain_claim_atomic_under_race_semaphore_choreography():
+    """Two concurrent drains of the same replica: exactly one claims the
+    victims, the loser gets [] immediately — and a sequential re-drain
+    of a draining replica is also []. The round-13 bug double-requeued
+    victims when healing and a rollout raced; the ``draining`` flag is
+    now the claim, taken under the gateway lock before any work."""
+    engines, gw = _cluster(2)
+    gate, hold = _gate_engine(engines[0])
+    # a request parked mid-decode on replica 0 = a victim to claim.
+    # sticky homes hash the first page; find a prompt homed on 0.
+    i = 0
+    while True:
+        cand = [(i + j) % 50 + 1 for j in range(8)]
+        if hash(tuple(cand)) % 2 == 0:
+            break
+        i += 1
+    got = {}
+    t = threading.Thread(target=lambda: got.__setitem__(
+        "r", gw.submit(cand, 12, timeout=60.0)), daemon=True)
+    t.start()
+    _spin(lambda: len(gw.replicas[0].batcher._track) == 1,
+          msg="request resident on replica 0")
+
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def racer(name):
+        barrier.wait(timeout=30)
+        results[name] = gw.drain_replica(0, reason="race", timeout=30.0)
+
+    r1 = threading.Thread(target=racer, args=("a",), daemon=True)
+    r2 = threading.Thread(target=racer, args=("b",), daemon=True)
+    r1.start(), r2.start()
+    # the claimer blocks on the drain handshake until the worker yields;
+    # feed segments so it can reach the fence between steps
+    feeder_stop = threading.Event()
+
+    def feeder():
+        while not feeder_stop.is_set():
+            gate.release()
+            time.sleep(0.002)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    r1.join(30.0), r2.join(30.0)
+    feeder_stop.set()
+    assert not r1.is_alive() and not r2.is_alive()
+    lens = sorted(len(v) for v in results.values())
+    assert lens == [0, 1], f"exactly one claim must win: {results}"
+    # third call while still draining: idempotent no-op
+    assert gw.drain_replica(0, reason="again") == []
+    # the victim re-routed and finished bit-exact on the healthy replica
+    hold["on"] = False
+    gate.release(50)
+    t.join(60.0)
+    assert got["r"] == _want(cand, 12)
+    assert gw.stats.snapshot()["requests_requeued_total"] == 1
+
+
+def test_batcher_coverage_fence_ships_stranded_queue_once():
+    """The serving-tier fence fix: the stranded queue ships through the
+    requeue sink exactly once — on the drain that NEWLY completes
+    full-shard coverage — and an idempotent re-drain of already-fenced
+    shards (a rollout racing a revoke_slice) must not ship it again.
+    Before the fix the coverage check ran after the fence update, so the
+    re-drain re-shipped whatever had been queued since."""
+    eng = FakePagedEngine(slots=4, dp=2, segment=2, max_total=24, page=8)
+    gate, hold = _gate_engine(eng)
+    cb = ContinuousBatcher(eng)
+    shipped = []
+    cb.requeue_sink = lambda reqs: shipped.append(list(reqs))
+
+    feeder_stop = threading.Event()
+
+    def feeder():
+        while not feeder_stop.is_set():
+            gate.release()
+            time.sleep(0.002)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    assert cb.drain([0], reason="rollout", timeout=30.0) == []
+    assert shipped == []                    # coverage incomplete: hold
+
+    # fill shard 1's two slots and strand a third request in the queue
+    threads = [threading.Thread(target=lambda p=p: cb.submit(
+        p, 8, timeout=60.0), daemon=True)
+        for p in ([1, 2, 3], [4, 5, 6], [7, 8, 9])]
+    for t in threads:
+        t.start()
+        time.sleep(0.005)       # distinct submitted_at stamps, in order
+    _spin(lambda: len(cb._track) == 2 and len(cb._queue) == 1,
+          msg="2 in flight on shard 1, 1 stranded in queue")
+
+    cb.drain([1], reason="rollout", timeout=30.0)
+    # one ship: both in-flight victims AND the stranded queue entry
+    assert [len(batch) for batch in shipped] == [3]
+    assert len(cb._queue) == 0
+    cb.drain([1], reason="rollout", timeout=30.0)   # re-drain: no re-ship
+    cb.drain([0, 1], reason="rollout", timeout=30.0)
+    assert [len(batch) for batch in shipped] == [3]
+
+    # the victims re-enter after readmit and finish bit-exact
+    feeder_stop.set()
+    hold["on"] = False
+    gate.release(50)
+    cb.readmit([0, 1])
+    cb.inject([r for batch in shipped for r in batch], front=True)
+    for t in threads:
+        t.join(30.0)
+    assert not any(t.is_alive() for t in threads)
+    assert cb.stats.snapshot()["errors_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# WeightPool: content-addressed sharing
+# ---------------------------------------------------------------------------
+
+def test_weight_pool_shares_base_pages_across_variants():
+    pool = WeightPool(pages=16)
+    base = [f"b{i}" for i in range(10)]
+    a = pool.acquire("m@v1", base + ["v1a", "v1b"])
+    assert a == {"new_pages": 12, "shared_pages": 0, "resident_pages": 12}
+    b = pool.acquire("m@v2", base + ["v2a", "v2b"])
+    assert b["new_pages"] == 2 and b["shared_pages"] == 10
+    assert pool.sharing_ratio() == pytest.approx(24 / 14)
+    # v1 leaves: only its private delta pages free, the base stays
+    assert pool.release("m@v1") == 2
+    assert pool.snapshot()["used_pages"] == 12
+    # releasing the last holder frees everything
+    assert pool.release("m@v2") == 12
+    assert pool.release("m@v2") == 0        # unknown variant: no-op
+
+
+def test_weight_pool_exhaustion_is_typed_and_atomic():
+    pool = WeightPool(pages=4)
+    pool.acquire("m@v1", ["a", "b", "c"])
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.acquire("m@v2", ["d", "e"])
+    # nothing partially installed
+    assert "m@v2" not in pool.snapshot()["variants"]
+    # repeat acquire of a resident variant refcounts, never re-allocates
+    again = pool.acquire("m@v1")
+    assert again["new_pages"] == 0 and again["shared_pages"] == 3
+    assert pool.release("m@v1") == 0        # one holder remains
+    assert pool.release("m@v1") == 3
+
+
+# ---------------------------------------------------------------------------
+# ModelRollout: the state machine
+# ---------------------------------------------------------------------------
+
+def _drive(machine, verdict=True, limit=64):
+    """Tick until terminal (the scenario harness's cadence), feeding a
+    constant canary verdict."""
+    for _ in range(limit):
+        if machine.done:
+            return machine.phase
+        machine.tick(canary_ok=verdict)
+    raise AssertionError(f"machine did not terminate: {machine.record}")
+
+
+def test_rollout_happy_path_under_live_load_zero_failures():
+    """The tentpole acceptance in miniature: a v0->v2 rollout across
+    three replicas while clients stream requests — every reply
+    bit-exact, zero errors, all replicas relabeled, one replica swapped
+    per canary pass."""
+    installs = []
+    _, gw = _cluster(3)
+    machine = ModelRollout(gw, "default", "v2",
+                           install=lambda i, v: installs.append((i, v)),
+                           prewarm=lambda v: {"version": v, "compiles": 0},
+                           canary_beats=2)
+    stop = threading.Event()
+    got, errors = {}, []
+
+    def client(k):
+        prompt = [k % 40 + 1, (3 * k) % 40 + 1, (7 * k) % 40 + 1]
+        try:
+            got[k] = (prompt, gw.submit(prompt, 6, timeout=60.0))
+        except Exception as e:  # noqa: BLE001 — judged below
+            errors.append(e)
+
+    def load():
+        k = 0
+        while not stop.is_set():
+            threading.Thread(target=client, args=(k,), daemon=True).start()
+            k += 1
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    assert _drive(machine, verdict=True) == "completed"
+    stop.set()
+    loader.join(10.0)
+    _spin(lambda: gw.backlog() == 0, msg="load drained")
+    assert installs == [(0, "v2"), (1, "v2"), (2, "v2")]
+    assert gw.snapshot()["models"] == ["default@v2"]
+    assert not errors
+    for prompt, reply in got.values():
+        assert reply == _want(prompt, 6)
+    assert gw.stats.snapshot()["errors_total"] == 0
+    assert machine.record["prewarm"] == {"version": "v2", "compiles": 0}
+    assert machine.canary_cohort() == "default@v2"
+
+
+def test_rollout_canary_breach_rolls_back_newest_first():
+    started = tm.ROLLOUT_STARTED.value(model="default")
+    rolled = tm.ROLLOUT_ROLLED_BACK.value(model="default")
+    installs = []
+    _, gw = _cluster(3)
+    machine = ModelRollout(gw, "default", "v2",
+                           install=lambda i, v: installs.append((i, v)),
+                           canary_beats=2, breach_beats=2)
+    machine.tick()                      # prewarm -> drain
+    machine.tick()                      # swap 0 -> canary
+    machine.tick(canary_ok=True)
+    machine.tick(canary_ok=True)        # streak 2 -> drain replica 1
+    machine.tick()                      # swap 1 -> canary
+    assert machine.record["updated"] == [0, 1]
+    machine.tick(canary_ok=False)
+    assert machine.phase == "canary"    # one breach beat is not a verdict
+    machine.tick(canary_ok=False)       # sustained -> rollback
+    assert machine.phase == "rollback"
+    assert _drive(machine) == "rolled_back"
+    # newest first: replica 1 restored before replica 0
+    assert installs == [(0, "v2"), (1, "v2"), (1, "v0"), (0, "v0")]
+    assert gw.snapshot()["models"] == ["default@v0"]
+    assert tm.ROLLOUT_STARTED.value(model="default") == started + 1
+    assert tm.ROLLOUT_ROLLED_BACK.value(model="default") == rolled + 1
+    # the phase gauge parked on the terminal phase's index
+    assert tm.ROLLOUT_PHASE.value(model="default") == float(
+        ROLLOUT_PHASES.index("rolled_back"))
+
+
+def test_rollout_install_failure_readmits_old_weights_then_rolls_back():
+    """A failed install never leaves the group half-routed: the replica
+    readmits on its OLD weights (version label untouched) and the
+    machine reverses. A restore that also fails parks in ``failed``."""
+    _, gw = _cluster(2)
+
+    def install(i, v):
+        raise RuntimeError(f"flash write failed on {i}")
+
+    machine = ModelRollout(gw, "default", "v2", install=install)
+    machine.tick()                      # prewarm -> drain
+    machine.tick()                      # install fails -> rollback
+    assert machine.phase == "rollback"
+    assert "flash write failed" in machine.record["error"]
+    assert gw.snapshot()["draining"] == []          # readmitted regardless
+    assert gw.snapshot()["models"] == ["default@v0"]
+    assert _drive(machine) == "rolled_back"         # nothing was updated
+
+    # rollback failure is terminal, not a retry storm
+    calls = {"n": 0}
+
+    def flaky(i, v):
+        calls["n"] += 1
+        if v == "v0":
+            raise RuntimeError("restore bricked")
+
+    _, gw2 = _cluster(2)
+    m2 = ModelRollout(gw2, "default", "v2", install=flaky, canary_beats=1)
+    m2.tick()                           # prewarm -> drain
+    m2.tick()                           # swap 0 -> canary
+    m2.tick(canary_ok=False)
+    m2.tick(canary_ok=False)            # -> rollback
+    m2.tick()                           # restore fails -> failed
+    assert m2.phase == "failed" and m2.done
+    assert "restore bricked" in m2.record["error"]
+
+
+def test_rollout_chaos_kill_mid_canary_pauses_then_heals_and_resumes():
+    """Satellite 3 (fast tier-1 variant): chaos kills the next target
+    replica mid-canary — in-flight victims requeue bit-exact, the
+    machine pauses instead of fighting the drain claim, healing
+    readmits, and the next tick auto-resumes to completion with zero
+    failed requests."""
+    engines, gw = _cluster(3)
+    gate, hold = _gate_engine(engines[1])
+    machine = ModelRollout(gw, "default", "v2", canary_beats=2)
+    machine.tick()                      # prewarm -> drain
+    machine.tick()                      # swap 0 -> canary
+    machine.tick(canary_ok=True)        # streak 1
+
+    # park a request mid-decode on replica 1 (the next target)
+    i = 0
+    while True:
+        cand = [(i + j) % 50 + 1 for j in range(8)]
+        if hash(tuple(cand)) % 3 == 1:
+            break
+        i += 1
+    got = {}
+    t = threading.Thread(target=lambda: got.__setitem__(
+        "r", gw.submit(cand, 12, timeout=60.0)), daemon=True)
+    t.start()
+    _spin(lambda: len(gw.replicas[1].batcher._track) == 1,
+          msg="request resident on replica 1")
+
+    # chaos revokes the slice backing replica 1
+    feeder_stop = threading.Event()
+
+    def feeder():
+        while not feeder_stop.is_set():
+            gate.release()
+            time.sleep(0.002)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    victims = gw.drain_replica(1, reason="slice_revoked", timeout=30.0)
+    feeder_stop.set()
+    assert len(victims) == 1
+    hold["on"] = False
+    gate.release(50)
+
+    machine.tick(canary_ok=True)        # streak 2 -> drain replica 1
+    machine.tick()                      # target draining -> pause
+    assert machine.record["paused"] is True
+    assert machine.record["pause_reason"] == "replica_draining"
+    phase_before = machine.phase
+    machine.tick()                      # still down: hold position
+    assert machine.record["paused"] and machine.phase == phase_before
+
+    gw.readmit_replica(1)               # healing brings the replacement
+    machine.tick()                      # auto-resume: swap 1 -> canary
+    assert machine.record["paused"] is False
+    assert machine.record["updated"] == [0, 1]
+    assert _drive(machine, verdict=True) == "completed"
+    t.join(30.0)
+    assert got["r"] == _want(cand, 12)              # victim never failed
+    assert gw.stats.snapshot()["errors_total"] == 0
+    assert gw.snapshot()["models"] == ["default@v2"]
+
+
+def test_rollout_resumes_from_persisted_record():
+    """Crash recovery: the record round-trips through JSON mid-rollout
+    and a fresh machine resumes exactly where it stopped; a resume
+    against a changed group is a typed refusal."""
+    _, gw = _cluster(3)
+    machine = ModelRollout(gw, "default", "v2", canary_beats=1)
+    machine.tick()                      # prewarm -> drain
+    machine.tick()                      # swap 0 -> canary
+    frozen = json.loads(json.dumps(machine.record))     # "crash"
+
+    resumed = ModelRollout.resume(gw, frozen)
+    assert resumed.phase == "canary"
+    assert resumed.record["updated"] == [0]
+    assert _drive(resumed, verdict=True) == "completed"
+    assert gw.snapshot()["models"] == ["default@v2"]
+
+    _, other = _cluster(2)              # different topology
+    with pytest.raises(RolloutError, match="members changed"):
+        ModelRollout.resume(other, frozen)
+
+
+def test_rollout_healing_rebuilt_replica_short_circuits():
+    """A replica healing rebuilt straight onto the new weights needs no
+    swap: the drain step observes the version label and advances —
+    the resume path's idempotency in its most extreme form."""
+    _, gw = _cluster(2)
+    machine = ModelRollout(gw, "default", "v2", canary_beats=1)
+    machine.tick()                      # prewarm -> drain
+    gw.set_replica_version(0, "v2")     # healing already rebuilt it
+    machine.tick()
+    assert machine.phase == "canary"
+    assert machine.record["history"][-1]["event"] == "already_updated"
+    assert _drive(machine, verdict=True) == "completed"
+
+
+def test_rollout_refuses_noop_and_unknown_model():
+    _, gw = _cluster(2, models=["llama@v2", "llama@v2"])
+    with pytest.raises(RolloutError, match="already entirely on"):
+        ModelRollout(gw, "llama", "v2")
+    with pytest.raises(RolloutError, match="unknown model"):
+        ModelRollout(gw, "gemma", "v3")
+
+
+def test_rollout_abort_reverses_or_cancels():
+    _, gw = _cluster(2)
+    m = ModelRollout(gw, "default", "v2")
+    assert m.abort() == "aborted"       # nothing updated: outright cancel
+    _, gw2 = _cluster(2)
+    m2 = ModelRollout(gw2, "default", "v2", canary_beats=2)
+    m2.tick(), m2.tick()                # one replica updated
+    assert m2.abort() == "rollback"
+    assert _drive(m2) == "rolled_back"
+    assert gw2.snapshot()["models"] == ["default@v0"]
+
+
+# ---------------------------------------------------------------------------
+# scenario spec: the rollout chaos kind validates like the others
+# ---------------------------------------------------------------------------
+
+def test_scenario_spec_validates_rollout_chaos():
+    from kubeoperator_tpu.scenario.spec import SCENARIOS, validate_spec
+    assert validate_spec(SCENARIOS["rollout_mid_burst"]) == []
+    bad = json.loads(json.dumps(SCENARIOS["rollout_mid_burst"]))
+    bad["chaos"][0].pop("to_version")
+    bad["chaos"][0]["canary_beats"] = 0
+    bad["chaos"][3]["expect"] = "maybe"
+    bad["workloads"][0]["replicas"] = 1
+    probs = validate_spec(bad)
+    assert any("to_version" in p for p in probs)
+    assert any("canary_beats" in p for p in probs)
+    assert any("expect" in p for p in probs)
+    assert any("gateway-fronted" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# slow soak: repeated rollouts under sustained load and chaos
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_rollout_soak_repeated_versions_with_chaos_kills():
+    """Five consecutive rollouts (v1..v5) under continuous load, a
+    chaos drain/readmit of a random-but-seeded replica mid-canary each
+    round: zero failed requests, every reply bit-exact, and the group
+    converges on the final version."""
+    _, gw = _cluster(3)
+    stop = threading.Event()
+    got, errors = {}, []
+
+    def load():
+        k = 0
+        while not stop.is_set():
+            prompt = [k % 40 + 1, (5 * k) % 40 + 1]
+
+            def client(k=k, prompt=prompt):
+                try:
+                    got[k] = (prompt, gw.submit(prompt, 5, timeout=60.0))
+                except Exception as e:  # noqa: BLE001 — judged below
+                    errors.append(e)
+            threading.Thread(target=client, daemon=True).start()
+            k += 1
+            time.sleep(0.002)
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    try:
+        for n in range(1, 6):
+            machine = ModelRollout(gw, "default", f"v{n}", canary_beats=1)
+            victim = n % 3
+            kicked = False
+            for _ in range(64):
+                if machine.done:
+                    break
+                if machine.phase == "canary" and not kicked:
+                    gw.drain_replica(victim, reason="soak_chaos",
+                                     timeout=30.0)
+                    gw.readmit_replica(victim)
+                    kicked = True
+                machine.tick(canary_ok=True)
+            assert machine.phase == "completed", machine.record
+    finally:
+        stop.set()
+        loader.join(10.0)
+    _spin(lambda: gw.backlog() == 0, timeout=60.0, msg="load drained")
+    assert not errors
+    assert gw.snapshot()["models"] == ["default@v5"]
+    for prompt, reply in got.values():
+        assert reply == _want(prompt, 5)
+    assert gw.stats.snapshot()["errors_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rollout bench A/B (round 17): zero failed requests, artifact of record
+# ---------------------------------------------------------------------------
+
+def _bench_mod():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rollout_bench_zero_failed_requests_guard():
+    """Tier-1 guard on the rollout A/B: BOTH arms finish every request
+    (run_load raises on any client error and asserts replies token for
+    token), both converge the whole group onto v2, and the prewarmed
+    arm's degraded window beats the cold arm's by at least the injected
+    compile stalls — the number that justifies AOT pre-warm."""
+    bs = _bench_mod()
+    out = bs.bench_rollout(requests=24, replicas=3, cold_compile_s=0.1)
+    assert out["zero_failed_requests"] is True, out
+    for arm in (out["prewarmed"], out["cold"]):
+        assert arm["phase"] == "completed", arm
+        assert arm["models"] == ["default@v2"]
+        assert arm["installed"] == [(0, "v2"), (1, "v2"), (2, "v2")]
+        assert arm["errors_total"] == 0
+        # base weight pages are shared across versions mid-rollout
+        assert arm["weights"]["shared_pages"] == 12
+        assert arm["weights"]["new_pages"] == 2
+    assert out["prewarmed"]["rollout_s"] < out["cold"]["rollout_s"]
+    assert out["rollout_speedup"] > 1.5, out
+
+
+def test_rollout_artifact_checked_in():
+    """MULTICHIP_serving_r06.json is the rollout A/B's number of record:
+    present, ok, zero failed requests in both arms, and the prewarmed
+    swap strictly faster than the cold one."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MULTICHIP_serving_r06.json")
+    with open(path, encoding="utf-8") as fh:
+        art = json.load(fh)
+    assert art["ok"] is True and art["rc"] == 0
+    assert art["zero_failed_requests"] is True
+    assert art["prewarmed"]["errors_total"] == 0
+    assert art["cold"]["errors_total"] == 0
+    assert art["prewarmed"]["phase"] == "completed"
+    assert art["cold"]["phase"] == "completed"
+    assert art["prewarmed"]["rollout_s"] < art["cold"]["rollout_s"]
+    assert art["rollout_speedup"] >= 1.5

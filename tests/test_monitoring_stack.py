@@ -21,8 +21,8 @@ def _queried_metric_names() -> set[str]:
     names: set[str] = set()
     for expr in mon.PROMQL.values():
         names |= set(re.findall(
-            r"\b((?:node|tpu|container|ko_serve|ko_train|ko_gateway|ko_aot)"
-            r"_[a-zA-Z0-9_]+)\b",
+            r"\b((?:node|tpu|container|ko_serve|ko_train|ko_gateway|ko_aot"
+            r"|ko_rollout)_[a-zA-Z0-9_]+)\b",
             expr))
     return names
 

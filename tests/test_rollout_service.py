@@ -1,0 +1,294 @@
+"""Deploy-plane rollout beat (services/rollout.py): the in-process
+ModelRollout machine lifted onto tracked DeployExecutions — per-replica
+weight installs under the single-mutator guard, canary verdicts read
+from the monitor's persisted per-cohort SLO block, rollback re-emission
+on failure, ERROR escalation when the rollback itself fails — plus the
+``ko rollout`` CLI and ``/api/v1/rollouts`` surface over the same
+record."""
+
+import asyncio
+
+from aiohttp.test_utils import TestServer
+
+from kubeoperator_tpu import ctl
+from kubeoperator_tpu.api.app import create_app, ensure_admin
+from kubeoperator_tpu.resources.entities import (
+    DeployExecution, ExecutionState, Message,
+)
+from kubeoperator_tpu.services import rollout as ro
+from kubeoperator_tpu.services.monitor import MonitorSnapshot
+from kubeoperator_tpu.telemetry import metrics as tm
+from test_autoscaler import make_auto_cluster
+
+import pytest
+
+
+def set_cohort_verdict(platform, cluster: str, cohort: str, state: str):
+    """Persist a monitor snapshot whose per-cohort SLO block reports the
+    canary cohort in ``state`` — what a real monitor beat writes after
+    evaluate_slos judged the ``model@version`` tenant dimension."""
+    found = platform.store.find(MonitorSnapshot, scoped=False, name=cluster)
+    rec = found[0] if found else MonitorSnapshot(project=cluster,
+                                                name=cluster)
+    data = dict(rec.data or {})
+    data["slo"] = {"tenants": {cohort: {
+        "ttft_p95_ms": {"state": state, "target": 2000.0}}}}
+    rec.data = data
+    platform.store.save(rec)
+
+
+def tick_and_settle(platform, cluster: str):
+    """One beat, then wait for any execution it emitted — the beat only
+    acts again once the tracked execution resolves."""
+    actions = ro.rollout_tick(platform)
+    data = ro._load_record(platform, cluster).data
+    if data.get("pending"):
+        platform.tasks.wait(data["pending"], timeout=120)
+    return actions
+
+
+def rollout_execs(platform, name: str) -> list[DeployExecution]:
+    return sorted((e for e in platform.store.find(
+                      DeployExecution, scoped=False, project=name)
+                   if e.operation == "scale" and "rollout" in e.params),
+                  key=lambda e: e.created_at)
+
+
+def test_beat_drives_prewarm_install_canary_to_completion(
+        platform, fake_executor):
+    """E2E: start -> prewarm execution -> per-replica install executions
+    -> canary verdicts from the persisted SLO block -> completed, every
+    step a tracked SUCCESS under the mutation slot."""
+    make_auto_cluster(platform, "serve1", worker_size=2)
+    rec = ro.start_rollout(platform, "serve1", "llama", "v2",
+                           replicas=2, canary_beats=1, breach_beats=2)
+    assert rec["phase"] == "prewarm" and rec["members"] == [0, 1]
+    set_cohort_verdict(platform, "serve1", "llama@v2", "ok")
+
+    assert tick_and_settle(platform, "serve1") == ["serve1:prewarm"]
+    for _ in range(8):
+        if ro._load_record(platform, "serve1").data["rollout"]["phase"] \
+                in ro.TERMINAL_PHASES:
+            break
+        tick_and_settle(platform, "serve1")
+    final = ro._load_record(platform, "serve1").data["rollout"]
+    assert final["phase"] == "completed", final
+    assert final["updated"] == [0, 1]
+
+    execs = rollout_execs(platform, "serve1")
+    kinds = [(e.params["rollout"]["kind"], e.params["rollout"]["replica"])
+             for e in execs]
+    assert kinds == [("prewarm", None), ("install", 0), ("install", 1)]
+    assert all(e.state == ExecutionState.SUCCESS for e in execs)
+    assert all(e.params["rollout"]["id"] == final["id"] for e in execs)
+    assert tm.ROLLOUT_COMPLETED.value(model="llama") >= 1.0
+
+    # the status/read surface reports the terminal record
+    row = next(r for r in ro.rollout_status(platform)
+               if r["cluster"] == "serve1")
+    assert row["phase"] == "completed" and row["updated"] == 2
+    got = ro.get_rollout(platform, final["id"])
+    assert got["to_version"] == "v2" and got["phase"] == "completed"
+    assert ro.get_rollout(platform, "nope-nope") is None
+
+
+def test_canary_breach_reverses_through_restore_executions(
+        platform, fake_executor):
+    """Sustained cohort breach mid-canary: the beat stops advancing and
+    re-emits restores (newest first) until the group is back on the
+    prior weights — the autoscaler's rollback discipline for weights."""
+    make_auto_cluster(platform, "serve2", worker_size=2)
+    ro.start_rollout(platform, "serve2", "llama", "v2", replicas=2,
+                     canary_beats=3, breach_beats=2)
+    set_cohort_verdict(platform, "serve2", "llama@v2", "ok")
+    tick_and_settle(platform, "serve2")             # prewarm
+    tick_and_settle(platform, "serve2")             # install replica 0
+    tick_and_settle(platform, "serve2")             # canary: ok beat
+
+    set_cohort_verdict(platform, "serve2", "llama@v2", "breach")
+    tick_and_settle(platform, "serve2")             # breach streak 1
+    mid = ro._load_record(platform, "serve2").data["rollout"]
+    assert mid["phase"] == "canary" and mid["breach_streak"] == 1
+    tick_and_settle(platform, "serve2")             # sustained -> rollback
+    assert ro._load_record(platform, "serve2") \
+        .data["rollout"]["phase"] == "rollback"
+    tick_and_settle(platform, "serve2")             # emit restore 0
+    tick_and_settle(platform, "serve2")             # resolve -> rolled_back
+    final = ro._load_record(platform, "serve2").data["rollout"]
+    assert final["phase"] == "rolled_back" and final["updated"] == []
+    restores = [e for e in rollout_execs(platform, "serve2")
+                if e.params["rollout"]["kind"] == "restore"]
+    assert [e.params["rollout"]["version"] for e in restores] == ["v0"]
+    assert tm.ROLLOUT_ROLLED_BACK.value(model="llama") >= 1.0
+
+
+def test_install_failure_warns_and_rolls_back(platform, fake_executor):
+    """A FAILED install execution flips the machine to rollback with a
+    WARNING — mirroring the autoscaler's failed-post-check path."""
+    cluster = make_auto_cluster(platform, "serve3", worker_size=2)
+    ro.start_rollout(platform, "serve3", "llama", "v2", replicas=2)
+    failed = DeployExecution(project="serve3", operation="scale",
+                             state=ExecutionState.FAILURE,
+                             params={"rollout": {"kind": "install"}})
+    platform.store.save(failed)
+    rec = ro._load_record(platform, cluster.name)
+    rec.data["rollout"]["phase"] = "drain"
+    rec.data["rollout"]["updated"] = [0]
+    rec.data.update(pending=failed.id, pending_kind="install",
+                    pending_replica=1)
+    ro._save_record(platform, rec)
+
+    ro.rollout_tick(platform)
+    out = ro._load_record(platform, "serve3").data["rollout"]
+    assert out["phase"] in ("rollback", "rolled_back")
+    assert "install failed" in out["error"]
+    msgs = platform.store.find(Message, scoped=False, project="serve3")
+    assert any(m.level == "WARNING" and "rolling back" in m.title
+               for m in msgs)
+
+
+def test_restore_failure_escalates_error_and_parks(platform, fake_executor):
+    """A FAILED restore is terminal: the record parks in ``failed`` and
+    an ERROR notification escalates — desired state needs a human."""
+    cluster = make_auto_cluster(platform, "serve4", worker_size=2)
+    ro.start_rollout(platform, "serve4", "llama", "v2", replicas=2)
+    failed = DeployExecution(project="serve4", operation="scale",
+                             state=ExecutionState.FAILURE,
+                             params={"rollout": {"kind": "restore"}})
+    platform.store.save(failed)
+    rec = ro._load_record(platform, cluster.name)
+    rec.data["rollout"]["phase"] = "rollback"
+    rec.data["rollout"]["updated"] = [0]
+    rec.data.update(pending=failed.id, pending_kind="restore",
+                    pending_replica=0)
+    ro._save_record(platform, rec)
+
+    ro.rollout_tick(platform)
+    out = ro._load_record(platform, "serve4").data["rollout"]
+    assert out["phase"] == "failed"
+    assert "restore of replica 0 failed" in out["error"]
+    msgs = platform.store.find(Message, scoped=False, project="serve4")
+    assert any(m.level == "ERROR" and "rollback" in m.title.lower()
+               for m in msgs)
+    # a terminal record frees the cluster for the next rollout
+    again = ro.start_rollout(platform, "serve4", "llama", "v3", replicas=2)
+    assert again["phase"] == "prewarm"
+
+
+def test_one_live_rollout_per_cluster(platform, fake_executor):
+    make_auto_cluster(platform, "serve5", worker_size=2)
+    first = ro.start_rollout(platform, "serve5", "llama", "v2", replicas=2)
+    with pytest.raises(ValueError, match="already has rollout"):
+        ro.start_rollout(platform, "serve5", "llama", "v3", replicas=2)
+    # abort before anything updated: cancelled outright, then free again
+    aborted = ro.abort_rollout(platform, "serve5")
+    assert aborted["id"] == first["id"] and aborted["phase"] == "aborted"
+    with pytest.raises(ValueError, match="no live rollout"):
+        ro.abort_rollout(platform, "serve5")
+    assert ro.start_rollout(platform, "serve5", "llama", "v3",
+                            replicas=2)["phase"] == "prewarm"
+    # mid-flight abort reverses instead of cancelling
+    rec = ro._load_record(platform, "serve5")
+    rec.data["rollout"]["updated"] = [0]
+    rec.data["rollout"]["phase"] = "canary"
+    ro._save_record(platform, rec)
+    assert ro.abort_rollout(platform, "serve5")["phase"] == "rollback"
+
+
+def test_start_validates_inputs(platform, fake_executor):
+    make_auto_cluster(platform, "serve6", worker_size=2)
+    with pytest.raises(ValueError, match="unknown cluster"):
+        ro.start_rollout(platform, "ghost", "llama", "v2")
+    with pytest.raises(ValueError, match="must be >= 1"):
+        ro.start_rollout(platform, "serve6", "llama", "v2", canary_beats=0)
+    with pytest.raises(ValueError, match="non-empty"):
+        ro.start_rollout(platform, "serve6", "", "v2")
+
+
+# ---------------------------------------------------------------------------
+# the CLI + API surface over the same record
+# ---------------------------------------------------------------------------
+
+def run_with_server(platform, fn):
+    async def main():
+        server = TestServer(create_app(platform))
+        await server.start_server()
+        try:
+            url = f"http://{server.host}:{server.port}"
+            return await asyncio.get_event_loop().run_in_executor(
+                None, fn, url)
+        finally:
+            await server.close()
+    return asyncio.run(main())
+
+
+def test_ko_rollout_cli_start_status_abort(platform, fake_executor,
+                                           tmp_path, monkeypatch, capsys):
+    make_auto_cluster(platform, "demo", worker_size=2)
+    ensure_admin(platform)
+    monkeypatch.setattr(ctl, "CONFIG_DIR", str(tmp_path))
+    monkeypatch.setattr(ctl, "CONFIG", str(tmp_path / "client.json"))
+
+    def drive(url):
+        assert ctl.main(["login", url, "admin",
+                         "--password", "KubeOperator@tpu1"]) == 0
+        assert ctl.main(["rollout", "start", "--cluster", "demo",
+                         "--model", "llama", "--to-version", "v2",
+                         "--replicas", "2", "--canary-beats", "1"]) == 0
+        assert ctl.main(["rollout", "status"]) == 0
+        # a second start while live is a clean API error, not a traceback
+        assert ctl.main(["rollout", "start", "--cluster", "demo",
+                         "--model", "llama", "--to-version", "v3"]) == 1
+        assert ctl.main(["rollout", "abort", "--cluster", "demo"]) == 0
+        return True
+
+    assert run_with_server(platform, drive)
+    out = capsys.readouterr()
+    assert "rollout" in out.out and "llama" in out.out
+    assert "prewarm" in out.out                     # status table row
+    assert "aborted" in out.out
+    assert "already has rollout" in out.err
+
+    final = ro._load_record(platform, "demo").data["rollout"]
+    assert final["phase"] == "aborted"
+
+
+def test_api_get_rollout_by_id(platform, fake_executor, tmp_path,
+                               monkeypatch):
+    import json as _json
+    import urllib.request
+
+    make_auto_cluster(platform, "demo", worker_size=2)
+    ensure_admin(platform)
+    started = ro.start_rollout(platform, "demo", "llama", "v2", replicas=2)
+
+    def drive(url):
+        body = _json.dumps({"username": "admin",
+                            "password": "KubeOperator@tpu1"}).encode()
+        req = urllib.request.Request(f"{url}/api/v1/auth/login", data=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            token = _json.loads(resp.read())["token"]
+
+        def get(path):
+            r = urllib.request.Request(
+                f"{url}{path}",
+                headers={"Authorization": f"Bearer {token}"})
+            try:
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, _json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"{}")
+
+        code, rows = get("/api/v1/rollouts")
+        assert code == 200 and rows[0]["id"] == started["id"]
+        code, one = get(f"/api/v1/rollouts/{started['id']}")
+        assert code == 200
+        assert one["model"] == "llama" and one["to_version"] == "v2"
+        assert one["cluster"] == "demo"
+        code, _ = get("/api/v1/rollouts/nope")
+        assert code == 404
+        return True
+
+    assert run_with_server(platform, drive)
