@@ -291,6 +291,38 @@ async def list_serve_request_traces(request: web.Request) -> web.Response:
                               "evicted": SERVE_TRACES.evicted})
 
 
+async def get_serve_request_critical_path(request: web.Request) \
+        -> web.Response:
+    """End-to-end latency attribution for one stitched serve trace
+    (``ko trace --serve --critical-path <id>`` consumes this): every
+    second of the root span charged to exactly one phase — gateway wait,
+    shed gaps, hop gaps, prefill, handoff, decode, host-blocked — plus
+    an explicit ``unattributed`` remainder, tiling the total."""
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        SERVE_TRACES, critical_path, render_record,
+    )
+    rec = SERVE_TRACES.get(request.match_info["id"])
+    if rec is None:
+        return json_error(404, "no trace recorded for this request "
+                               "(retired requests age out of the ring)")
+    return web.json_response(critical_path(render_record(rec)))
+
+
+async def dump_flight_recorder(request: web.Request) -> web.Response:
+    """Freeze the incident flight recorder into a ``FLIGHT_<ts>.json``
+    bundle on demand (``ko debug dump``). The same dump fires
+    automatically on an SLO breach edge and on scenario --check failure;
+    this endpoint is for grabbing the evidence *before* it ages out."""
+    from kubeoperator_tpu.telemetry.flight import FLIGHT
+    path = FLIGHT.dump(reason="on_demand")
+    bundle = FLIGHT.snapshot()
+    return web.json_response({"bundle": path,
+                              "points": len(bundle["points"]),
+                              "events": len(bundle["events"]),
+                              "decisions": len(bundle["decisions"]),
+                              "traces": len(bundle["slowest_traces"])})
+
+
 # ---------------------------------------------------------------------------
 # generic CRUD
 # ---------------------------------------------------------------------------
@@ -1272,6 +1304,9 @@ def create_app(platform: Platform) -> web.Application:
     r.add_post("/api/v1/executions/{id}/retry", retry_execution)
     r.add_get("/api/v1/serve/requests/traces", list_serve_request_traces)
     r.add_get("/api/v1/serve/requests/{id}/trace", get_serve_request_trace)
+    r.add_get("/api/v1/serve/requests/{id}/critical-path",
+              get_serve_request_critical_path)
+    r.add_post("/api/v1/debug/flight", dump_flight_recorder)
     r.add_get("/api/v1/tasks", tasks_monitor)
     r.add_get("/api/v1/tasks/{id}", get_task)
     r.add_get("/api/v1/schema", openapi_schema)

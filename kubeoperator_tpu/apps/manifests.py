@@ -314,10 +314,11 @@ data:
         "targets": [{{"expr": "histogram_quantile(0.95, sum(rate(ko_train_step_seconds_bucket[5m])) by (le, workload))", "legendFormat": "step p95 {{{{workload}}}}"}},
                     {{"expr": "avg(ko_train_mfu) by (workload)", "legendFormat": "mfu {{{{workload}}}}"}},
                     {{"expr": "sum(rate(ko_train_collective_seconds[5m])) by (collective)", "legendFormat": "{{{{collective}}}}"}}]}},
-      {{"title": "Gateway: routing by replica/policy, affinity, handoff pages", "type": "timeseries", "gridPos": {{"x":0,"y":40,"w":24,"h":8}},
+      {{"title": "Gateway: routing by replica/policy, affinity, handoff pages, queue wait p95", "type": "timeseries", "gridPos": {{"x":0,"y":40,"w":24,"h":8}},
         "targets": [{{"expr": "sum(rate(ko_gateway_requests_routed_total[5m])) by (replica, policy)", "legendFormat": "replica {{{{replica}}}} {{{{policy}}}}"}},
                     {{"expr": "avg(ko_gateway_prefix_affinity_ratio)", "legendFormat": "prefix affinity"}},
-                    {{"expr": "sum(rate(ko_gateway_handoff_pages_total[5m]))", "legendFormat": "handoff pages/s"}}]}},
+                    {{"expr": "sum(rate(ko_gateway_handoff_pages_total[5m]))", "legendFormat": "handoff pages/s"}},
+                    {{"expr": "histogram_quantile(0.95, sum(rate(ko_gateway_queue_wait_seconds_bucket[5m])) by (le, tenant))", "legendFormat": "queue wait p95 {{{{tenant}}}}"}}]}},
       {{"title": "AOT cache: hit/miss rate, bring-up p95", "type": "timeseries", "gridPos": {{"x":0,"y":48,"w":24,"h":8}},
         "targets": [{{"expr": "sum(rate(ko_aot_cache_hits_total[5m])) by (fn)", "legendFormat": "hits {{{{fn}}}}"}},
                     {{"expr": "sum(rate(ko_aot_cache_misses_total[5m])) by (fn)", "legendFormat": "misses {{{{fn}}}}"}},

@@ -99,6 +99,7 @@ from collections import deque
 from typing import Any, Sequence
 
 from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.telemetry.flight import FLIGHT
 from kubeoperator_tpu.workloads.serving import _Pending
 
 POLICIES = ("sticky_prefix", "round_robin", "least_loaded")
@@ -316,7 +317,8 @@ class ServeGateway:
                  handoff_min_pages: int = 1,
                  tenants: dict[str, dict] | None = None,
                  qos: str = "fair", shed_after: int | None = None,
-                 models: Sequence[str] | None = None):
+                 models: Sequence[str] | None = None,
+                 tracer: Any = None):
         if not batchers:
             raise ValueError("ServeGateway needs at least one batcher")
         if policy not in POLICIES:
@@ -340,6 +342,12 @@ class ServeGateway:
                              else 2 * int(batchers[0].engine.slots))
         self._prefill = prefill_worker
         self._handoff_min_pages = int(handoff_min_pages)
+        # the gateway-tier tracer (round 18): when wired, submit mints
+        # ONE trace per request here — gateway wait, sheds, handoffs and
+        # requeue hops stitch into the same tree the batcher's scheduling
+        # edges already annotate. Without it the pre-18 contract holds:
+        # batcher-minted traces, one per replica visit.
+        self._tracer = tracer
         self.replicas = [
             _Replica(i, b, models[i] if models is not None else DEFAULT_MODEL)
             for i, b in enumerate(batchers)]
@@ -391,6 +399,10 @@ class ServeGateway:
         prompt = list(prompt_ids)
         model = self._resolve_model(model)
         if not self.qos:
+            if self._tracer is not None:
+                return self._submit_traced(prompt, int(max_tokens),
+                                           float(temperature), int(seed),
+                                           timeout, model)
             # pre-QoS direct path: route and delegate (tenant identity is
             # accepted but unenforced — nothing to admit against)
             idx, decision = self._route(prompt, model=model)
@@ -402,6 +414,36 @@ class ServeGateway:
         return self._submit_qos(prompt, int(max_tokens), float(temperature),
                                 int(seed), timeout, tenant or "default",
                                 priority, deadline_s, model)
+
+    def _submit_traced(self, prompt: list[int], max_tokens: int,
+                       temperature: float, seed: int,
+                       timeout: float | None,
+                       model: str | None) -> list[int]:
+        """The non-QoS path with a gateway tracer wired: mint the trace
+        context HERE so gateway wait, handoffs and any later requeue hops
+        land in the same tree the decode replica's scheduling edges
+        annotate — the request enters the replica through ``inject`` with
+        its trace already attached instead of via ``batcher.submit``."""
+        self._validate(prompt, max_tokens)
+        if max_tokens == 0:
+            return list(prompt)      # the batcher's mt==0 fast path
+        req = _Pending(prompt, max_tokens, temperature, seed)
+        req.model = model
+        req.trace = self._tracer.begin(req.id, prompt_len=len(prompt),
+                                       max_tokens=max_tokens, gateway=True)
+        idx, decision = self._route(prompt, model=model)
+        tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+        tm.GATEWAY_QUEUE_WAIT.observe(
+            time.monotonic() - req.submitted_at, tenant=req.tenant)
+        req.trace.dispatched(replica=idx, decision=decision)
+        if self._prefill is not None:
+            self._maybe_handoff(idx, prompt, trace=req.trace)
+        self.replicas[idx].batcher.inject([req], front=False)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
 
     def _resolve_model(self, model: str | None) -> str | None:
         """Validate a submit's model selector against the registered
@@ -468,6 +510,10 @@ class ServeGateway:
                                  f"got {req.priority!r}")
             req.deadline_s = (float(deadline_s) if deadline_s is not None
                               else t.deadline_s)
+            if self._tracer is not None and max_tokens > 0:
+                req.trace = self._tracer.begin(
+                    req.id, prompt_len=len(prompt), max_tokens=max_tokens,
+                    gateway=True, tenant=tenant, priority=req.priority)
             t.refill(time.monotonic())
             # fifo mode is the no-QoS baseline: per-tenant accounting
             # only — admission never sheds, arrival order rules
@@ -476,6 +522,8 @@ class ServeGateway:
                 retry = t.retry_after()
                 reason = ("deadline" if req.deadline_s is not None
                           and retry >= req.deadline_s else "rate")
+                if req.trace is not None:
+                    req.trace.shed(reason=reason, retry_after_s=retry)
                 raise self._shed_locked(t, reason, retry)
             t.spend()
             t.submitted += 1
@@ -513,6 +561,8 @@ class ServeGateway:
         # ko: lint-ok[KO201] caller holds _lock: _shed_locked runs inside _submit_qos/_dispatch_one lock scopes
         self._shed_total += 1
         tm.SERVE_SHED.inc(tenant=t.name, reason=reason)
+        FLIGHT.record_decision("shed", tenant=t.name, reason=reason,
+                               retry_after_s=round(retry_after_s, 6))
         return ShedError(t.name, reason, retry_after_s)
 
     # -- routing ------------------------------------------------------------
@@ -611,7 +661,8 @@ class ServeGateway:
             return self._sticky_hits / self._sticky_total
 
     # -- disaggregated prefill handoff --------------------------------------
-    def _maybe_handoff(self, idx: int, prompt: list[int]) -> None:
+    def _maybe_handoff(self, idx: int, prompt: list[int],
+                       trace: Any = None) -> None:
         n = len(prompt) // self._page
         if n < self._handoff_min_pages:
             return
@@ -620,6 +671,7 @@ class ServeGateway:
             if aligned in self._handed[idx]:
                 return
             self._handed[idx].add(aligned)   # claim before the slow part
+        t0 = time.perf_counter()
         try:
             payload = self._prefill.prefill(list(aligned))
             pages = self.replicas[idx].batcher.handoff(
@@ -632,6 +684,9 @@ class ServeGateway:
             tm.GATEWAY_HANDOFF_PAGES.inc(pages)
             with self._lock:
                 self._handoff_pages += pages
+        if trace is not None:
+            trace.handoff(pages=pages or 0,
+                          seconds=time.perf_counter() - t0, replica=idx)
 
     # -- replica lifecycle --------------------------------------------------
     def drain_replica(self, index: int, reason: str = "replica_drain",
@@ -656,6 +711,8 @@ class ServeGateway:
         ids = r.batcher.drain(range(dp), reason=reason, timeout=timeout)
         with self._lock:
             self._requeued_total += len(ids)
+        FLIGHT.record_decision("drain_replica", replica=index,
+                               reason=reason, requeued=len(ids))
         return ids
 
     def readmit_replica(self, index: int) -> None:
@@ -667,6 +724,7 @@ class ServeGateway:
         with self._gcond:
             r.draining = False
             self._gcond.notify()
+        FLIGHT.record_decision("readmit_replica", replica=index)
 
     def set_replica_version(self, index: int, version: str) -> None:
         """Rewrite one replica's version label — the rollout
@@ -789,6 +847,10 @@ class ServeGateway:
                     self._gq.extend(batch[i:])
                 break
             tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+            if req.trace is not None:
+                # post-hop re-dispatch: the hop span is still open (the
+                # next admission closes it) — note where the victim went
+                req.trace.dispatched(replica=idx, decision=decision)
             groups.setdefault(idx, []).append(req)
         for idx, rs in groups.items():
             self.replicas[idx].batcher.inject(rs, front=True)
@@ -805,6 +867,9 @@ class ServeGateway:
                 t.refill(time.monotonic())
                 req.error = self._shed_locked(t, "expired",
                                               max(t.retry_after(), 0.0))
+            if req.trace is not None:
+                req.trace.shed(reason="expired",
+                               retry_after_s=req.error.retry_after_s)
             req.done.set()
             return
         try:
@@ -817,12 +882,16 @@ class ServeGateway:
                 self._gq.append(req)
             return
         tm.GATEWAY_ROUTED.inc(replica=str(idx), policy=decision)
+        tm.GATEWAY_QUEUE_WAIT.observe(
+            time.monotonic() - req.submitted_at, tenant=req.tenant)
+        if req.trace is not None:
+            req.trace.dispatched(replica=idx, decision=decision)
         front = False
         if req.priority == "latency" and self._qos_mode == "fair":
             front = True        # latency class enters at the queue head
             self._maybe_preempt(idx)
         if self._prefill is not None:
-            self._maybe_handoff(idx, req.prompt_ids)
+            self._maybe_handoff(idx, req.prompt_ids, trace=req.trace)
         self.replicas[idx].batcher.inject([req], front=front)
 
     def _maybe_preempt(self, idx: int) -> None:
@@ -843,6 +912,8 @@ class ServeGateway:
         except (TimeoutError, ValueError):
             return              # the victim retired first — nothing lost
         tm.SERVE_PREEMPTIONS.inc(tenant=victim.tenant)
+        FLIGHT.record_decision("preempt", tenant=victim.tenant,
+                               replica=idx, request=victim.id)
         with self._lock:
             self._tenant(victim.tenant).preempted += 1
             self._preempted_total += 1

@@ -12,6 +12,8 @@ hosts, packages, and executions. Zero dependencies — stdlib urllib.
     ko retry <execution-id>
     ko trace <execution-id> --slowest 3
     ko trace --serve --slowest 5          # slowest recent serve requests
+    ko trace --serve --critical-path --slowest 3   # where the time went
+    ko debug dump                         # freeze the flight recorder
     ko hosts | ko packages | ko logs --query error
 """
 
@@ -253,6 +255,10 @@ def cmd_trace(args) -> int:
     c = Client()
     # rendering lives next to the tracer so the API and CLI can't drift
     from kubeoperator_tpu.telemetry.tracing import format_trace
+    if args.critical_path and not args.serve:
+        print("error: --critical-path needs --serve (execution traces "
+              "already have --slowest)", file=sys.stderr)
+        return 2
     if args.serve:
         if args.id:
             one = c.call("GET", f"/api/v1/serve/requests/{args.id}/trace")
@@ -261,6 +267,9 @@ def cmd_trace(args) -> int:
             q = f"?slowest={args.slowest}" if args.slowest > 0 else ""
             d = c.call("GET", f"/api/v1/serve/requests/traces{q}")
             traces, evicted = d["traces"], d.get("evicted", 0)
+        if args.critical_path:
+            return _render_critical_paths(traces, single=bool(args.id),
+                                          as_json=args.as_json)
         if args.as_json:
             print(json.dumps(traces[0] if args.id else
                              {"traces": traces, "evicted": evicted},
@@ -288,6 +297,47 @@ def cmd_trace(args) -> int:
           + (f", {d['dropped']} dropped" if d.get("dropped") else ""))
     print(format_trace(d["spans"], slowest=args.slowest))
     return 0
+
+
+def _render_critical_paths(traces, *, single: bool, as_json: bool) -> int:
+    """Attribute each stitched trace's end-to-end latency into phases
+    (gateway wait, shed gaps, hops, prefill, handoff, decode, host-
+    blocked …) via the analyzer that lives next to the tracer."""
+    from kubeoperator_tpu.telemetry.serve_trace import critical_path
+    paths = [critical_path(t) for t in traces]
+    if as_json:
+        print(json.dumps(paths[0] if single else
+                         {"version": 1, "critical_paths": paths}, indent=2))
+        return 0
+    if not paths:
+        print("(no serve traces recorded)")
+        return 0
+    for p in paths:
+        total = p["duration_s"] or 1e-12
+        print(f"request {p['request']} — {_fmt_s(p['duration_s'])} "
+              f"end-to-end ({p['status']})"
+              + (f", ttft {_fmt_s(p['ttft_s'])}"
+                 if p.get("ttft_s") is not None else ""))
+        rows = sorted(p["phases"].items(), key=lambda kv: -kv[1])
+        if p["unattributed"] > 0:
+            rows.append(("unattributed", p["unattributed"]))
+        for phase, sec in rows:
+            print(f"  {phase:<14} {_fmt_s(sec):>9}  {100 * sec / total:5.1f}%")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Operator escape hatches. ``ko debug dump`` freezes the incident
+    flight recorder (recent history points, SLO edges, gateway QoS
+    decisions, slowest stitched traces) into a ``FLIGHT_<ts>.json``
+    bundle on the controller and prints its path."""
+    if args.action == "dump":
+        d = Client().call("POST", "/api/v1/debug/flight", {})
+        print(f"flight recorder bundle: {d['bundle']} "
+              f"({d['points']} points, {d['events']} events, "
+              f"{d['decisions']} decisions, {d['traces']} traces)")
+        return 0
+    return 2
 
 
 def _fmt_s(seconds: float) -> str:
@@ -521,6 +571,12 @@ def build_parser(sub) -> None:
                             "path); --serve: the N slowest recent requests")
     trace.add_argument("--json", action="store_true", dest="as_json",
                        help="emit the schema-v1 span dicts as JSON")
+    trace.add_argument("--critical-path", action="store_true",
+                       dest="critical_path",
+                       help="--serve: attribute end-to-end latency into "
+                            "phases (gateway wait, shed/hop gaps, prefill, "
+                            "handoff, decode, host-blocked) instead of the "
+                            "span timeline")
     trace.set_defaults(fn=cmd_trace)
 
     apps = sub.add_parser("apps", help="runtime app store on a cluster")
@@ -615,6 +671,13 @@ def build_parser(sub) -> None:
     aot.add_argument("--force", action="store_true",
                      help="purge even artifacts a running engine holds")
     aot.set_defaults(fn=cmd_aot)
+
+    debug = sub.add_parser(
+        "debug", help="operator escape hatches (incident flight recorder)")
+    debug.add_argument("action", choices=("dump",),
+                       help="dump: freeze the flight recorder into a "
+                            "FLIGHT_<ts>.json bundle on the controller")
+    debug.set_defaults(fn=cmd_debug)
 
     logs = sub.add_parser("logs", help="search system logs")
     logs.add_argument("--query", default="")

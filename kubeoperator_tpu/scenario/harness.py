@@ -45,7 +45,11 @@ from kubeoperator_tpu.services.monitor import (
     evaluate_slos, serve_history_point,
 )
 from kubeoperator_tpu.telemetry import metrics
+from kubeoperator_tpu.telemetry.flight import FLIGHT
+from kubeoperator_tpu.utils.logs import get_logger
 from kubeoperator_tpu.workloads.serving import BatcherStats, ContinuousBatcher
+
+log = get_logger(__name__)
 
 #: cap on overtime beats (drivers still draining after the scheduled
 #: window) so a wedged replay fails loudly instead of spinning forever
@@ -84,6 +88,10 @@ class _Stage:
         self.replicas = int(replicas)
         self.tenant_labels = tenant_labels
         self.gateway = None
+        # replays trace into the process-wide serve ring (round 18) so a
+        # breached --check run's flight bundle carries the slowest
+        # stitched traces of the exact replay that failed
+        from kubeoperator_tpu.telemetry.serve_trace import ServeTracer
         if self.replicas > 1 or tenants:
             from kubeoperator_tpu.cluster import ServeGateway
             engines = [_build_engine(espec) for _ in range(self.replicas)]
@@ -94,14 +102,16 @@ class _Stage:
                 kw["tenants"] = tenants
                 if shed_after is not None:
                     kw["shed_after"] = int(shed_after)
-            self.gateway = ServeGateway(batchers, policy=router, **kw)
+            self.gateway = ServeGateway(batchers, policy=router,
+                                        tracer=ServeTracer(), **kw)
             self.engine = engines[0]        # paged-protocol sniffing only
             self.stats = self.gateway.stats
             self.batcher = self.gateway
         else:
             self.engine = _build_engine(espec)
             self.stats = BatcherStats()
-            self.batcher = ContinuousBatcher(self.engine, stats=self.stats)
+            self.batcher = ContinuousBatcher(self.engine, stats=self.stats,
+                                             tracer=ServeTracer())
         self.slos = dict(slos or {})
         self.trace = trace
         self.offsets = offsets
@@ -149,6 +159,12 @@ class _Stage:
         block = evaluate_slos(self.slos, self.points,
                               fast_window=fast, slow_window=slow)
         self.breach_events.extend(block["events"])
+        # the flight recorder rides the replay beat exactly like the
+        # monitor beat: if the run breaches, the dump in run_scenarios
+        # freezes the same evidence an operator would get in production
+        FLIGHT.record_point(self.points[-1])
+        for ev in block["events"]:
+            FLIGHT.record_event(dict(ev))
 
     def verdict(self, fast: int, slow: int) -> dict:
         return evaluate_slos(self.slos, self.points,
@@ -656,6 +672,15 @@ def run_scenarios(specs: list[dict], out: str | None = None,
         "ok": all(r["ok"] for r in reports),
         "scenarios": reports,
     }
+    if not artifact["ok"]:
+        # a failed --check gets its flight-recorder bundle attached: the
+        # replay's history points, breach edges, gateway QoS decisions
+        # and slowest stitched traces, frozen at the moment of failure
+        try:
+            artifact["flight_bundle"] = FLIGHT.dump(reason="scenario_breach")
+        except OSError:
+            log.exception("flight-recorder dump for failed replay failed")
+            artifact["flight_bundle"] = None
     if out:
         with open(out, "w", encoding="utf-8") as fh:
             json.dump(artifact, fh, indent=1)

@@ -25,6 +25,7 @@ from kubeoperator_tpu.resources.entities import (
 )
 from kubeoperator_tpu.resources.entities import iso as iso_now
 from kubeoperator_tpu.telemetry import metrics as tm
+from kubeoperator_tpu.telemetry.flight import FLIGHT
 from kubeoperator_tpu.utils.logs import get_logger
 
 log = get_logger(__name__)
@@ -61,6 +62,10 @@ QUERIED_METRICS = {
     "ko_gateway_requests_routed_total": "jax-serve",
     "ko_gateway_prefix_affinity_ratio": "jax-serve",
     "ko_gateway_handoff_pages_total": "jax-serve",
+    # distributed tracing (round 18): virtual-time gateway dequeue wait,
+    # measured at dispatch — the "where did my TTFT go" phase the serve
+    # metrics could not see before the gateway tier was instrumented
+    "ko_gateway_queue_wait_seconds_bucket": "jax-serve",
     # multi-tenant QoS (round 16): deliberate overload sheds (by tenant and
     # reason) and priority preemptions of batch-class victims (by victim
     # tenant) — served off the gateway process's /metrics like the rest
@@ -127,6 +132,12 @@ PROMQL = {
         "sum(rate(ko_gateway_requests_routed_total[5m])) by (policy)",
     "gateway_affinity_ratio": "avg(ko_gateway_prefix_affinity_ratio)",
     "gateway_handoff_rate": "sum(rate(ko_gateway_handoff_pages_total[5m]))",
+    # distributed tracing (round 18): p95 of the gateway dequeue wait —
+    # time from submit to routing dispatch, the queueing phase critical-
+    # path attribution charges to "gateway_wait" per request
+    "gateway_queue_wait_p95":
+        "histogram_quantile(0.95, "
+        "sum(rate(ko_gateway_queue_wait_seconds_bucket[5m])) by (le))",
     # multi-tenant QoS (round 16): who is being shed (and why — rate vs
     # deadline vs expired tells config error from genuine saturation) and
     # whose batch traffic is paying for latency-class slots
@@ -556,6 +567,8 @@ class ClusterMonitor:
         gateway_affinity = prom.scalar_or_none(
             PROMQL["gateway_affinity_ratio"])
         gateway_handoff = prom.scalar_or_none(PROMQL["gateway_handoff_rate"])
+        gateway_wait_p95 = prom.scalar_or_none(
+            PROMQL["gateway_queue_wait_p95"])
         # multi-tenant QoS: {} marks "no QoS-enabled gateway deployed"
         try:
             serve_shed_rates = {
@@ -631,6 +644,7 @@ class ClusterMonitor:
             "gateway_routed_by_policy": gateway_by_policy,
             "gateway_affinity_ratio": gateway_affinity,
             "gateway_handoff_rate": gateway_handoff,
+            "gateway_queue_wait_p95": gateway_wait_p95,
             "train_step_p95": train_step_p95,
             "train_mfu": train_mfu,
             "train_collective_rate": train_coll_rate,
@@ -681,6 +695,8 @@ class ClusterMonitor:
                        "gateway_affinity_ratio":
                            data["gateway_affinity_ratio"],
                        "gateway_handoff_rate": data["gateway_handoff_rate"],
+                       "gateway_queue_wait_p95":
+                           data["gateway_queue_wait_p95"],
                        "train_step_p95": data["train_step_p95"],
                        "train_mfu": data["train_mfu"],
                        "aot_hit_rate": data["aot_hit_rate"],
@@ -722,6 +738,12 @@ class ClusterMonitor:
         _publish(block["slos"], "")
         for tname, tslos in (block.get("tenants") or {}).items():
             _publish(tslos, tname)
+        # incident flight recorder (round 18): every beat feeds the ring —
+        # the freshest history point and any SLO state-transition edges —
+        # and a → breach edge freezes the evidence automatically, while
+        # the window that produced it is still in the ring
+        if points:
+            FLIGHT.record_point(points[-1])
         for ev in block["events"]:
             log.warning(
                 "slo %s%s %s -> %s on %s (burn_fast=%s value=%s target=%s)",
@@ -729,6 +751,13 @@ class ClusterMonitor:
                 " tenant=" + ev["tenant"] if ev.get("tenant") else "",
                 ev["from"], ev["to"], self.cluster.name,
                 ev["burn_fast"], ev["value"], ev["target"])
+            FLIGHT.record_event(dict(ev, cluster=self.cluster.name))
+        if any(ev["to"] == "breach" for ev in block["events"]):
+            try:
+                FLIGHT.dump(reason="slo_breach")
+            except OSError:
+                # diagnostics must never take the monitor beat down
+                log.exception("flight-recorder auto-dump failed")
         return block
 
     # -- events (reference put_event_data_to_es, :506-534) -----------------

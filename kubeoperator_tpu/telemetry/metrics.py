@@ -637,6 +637,18 @@ GATEWAY_HANDOFF_PAGES = REGISTRY.counter(
     "ko_gateway_handoff_pages_total",
     "Whole KV pages shipped from disaggregated prefill workers into "
     "decode replicas' prefix caches as block-table page lists.")
+# A gateway dequeue is sub-ms on an idle cost model but stretches to
+# many seconds for batch-class work parked behind full replicas; start
+# finer than DEFAULT_BUCKETS and keep its tail.
+GATEWAY_WAIT_BUCKETS: tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 15.0, 60.0)
+GATEWAY_QUEUE_WAIT = REGISTRY.histogram(
+    "ko_gateway_queue_wait_seconds",
+    "Time one request spent in the gateway tier before dispatch to a "
+    "replica (QoS admission + weighted-fair queue wait, measured at "
+    "dispatch), by tenant.",
+    labels=("tenant",), buckets=GATEWAY_WAIT_BUCKETS)
 
 # -- multi-tenant QoS families (cluster/gateway.py, round 16) ---------------
 # Set by the gateway's tenant admission and preemption paths, on the

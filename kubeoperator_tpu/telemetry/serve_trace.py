@@ -13,6 +13,19 @@ retirement. Each request becomes one ``tracing.Trace`` whose tree is
     ├── segment ×N         (one per decode-segment dispatch touching it)
     └── retire             (device_s / host_blocked_s attribution)
 
+Round 18 stitches the cluster tier into the SAME tree: a gateway-minted
+``RequestTrace`` (``gateway=True``) opens a ``gateway``-kind span at
+submit that closes at dispatch (admission/fair-queue wait), token-bucket
+and deadline sheds terminate the tree with a ``shed`` span, disagg
+prefill handoffs post a ``handoff`` span, and every preempt/drain
+eviction opens a ``hop``-kind span that the NEXT admission closes — so
+one request's journey across gateway → prefill worker → decode replicas
+is one connected tree under one trace id, never a fresh root per
+readmission. ``critical_path`` decomposes that tree's end-to-end wall
+time into exclusive phases (gateway wait, replica queue, admit, prefill,
+handoff, decode, host-blocked, requeue hops) that tile the root span
+exactly.
+
 All spans are annotated from values the batcher already holds on the
 host — admission plans, segment wall times, the retirement fetch — so
 tracing adds **no** device reads or dispatches to the decode loop.
@@ -103,18 +116,36 @@ class RequestTrace:
     span-list lock is needed."""
 
     def __init__(self, request_id: str, store: ServeTraceStore,
-                 max_spans: int, prompt_len: int, max_tokens: int):
+                 max_spans: int, prompt_len: int, max_tokens: int,
+                 gateway: bool = False, tenant: str | None = None,
+                 priority: str | None = None):
         self.store = store
         self.trace = Trace(request_id, max_spans=max_spans)
-        self.root = Span("request", "serve", self.trace, attributes={
-            "prompt_len": prompt_len, "max_tokens": max_tokens})
-        self.queue_span: Span | None = Span(
-            "enqueue", "serve", self.trace, parent_id=self.root.span_id)
+        attrs: dict[str, Any] = {"prompt_len": prompt_len,
+                                 "max_tokens": max_tokens}
+        if tenant is not None:
+            attrs["tenant"] = tenant
+        if priority is not None:
+            attrs["priority"] = priority
+        self.root = Span("request", "serve", self.trace, attributes=attrs)
         # recorded up-front (records hold live Span objects; durations land
         # via finish() before serialization) so cap overflow can only drop
         # later segment/retire spans, never the request root
         self.trace.record(self.root)
-        self.trace.record(self.queue_span)
+        self.queue_span: Span | None = None
+        self.gateway_span: Span | None = None
+        self.hop_span: Span | None = None
+        if gateway:
+            # gateway-minted context: the live gateway span covers
+            # admission + fair-queue wait until ``dispatched`` closes it
+            # and opens the replica-level enqueue span in its place
+            self.gateway_span = Span("gateway", "gateway", self.trace,
+                                     parent_id=self.root.span_id)
+            self.trace.record(self.gateway_span)
+        else:
+            self.queue_span = Span("enqueue", "serve", self.trace,
+                                   parent_id=self.root.span_id)
+            self.trace.record(self.queue_span)
         self.segments = 0
 
     # -- span helpers --------------------------------------------------------
@@ -129,6 +160,73 @@ class RequestTrace:
         self.trace.record(sp)
         return sp
 
+    # -- gateway edges -------------------------------------------------------
+    def dispatched(self, *, replica: int | str,
+                   decision: str | None = None) -> float | None:
+        """The gateway picked a replica and injected the request. Closes
+        the live gateway span (its duration IS the gateway queue wait,
+        returned so the dispatch site can feed the wait histogram) and
+        opens the replica-level enqueue span. A re-dispatch after a hop
+        (requeue batch re-routed to a healthy replica) only notes a
+        ``reroute`` event — the hop span already covers the gap."""
+        if self.gateway_span is None:
+            self.root.add_event("reroute", replica=replica,
+                                decision=decision)
+            return None
+        gs = self.gateway_span
+        gs.attributes["replica"] = replica
+        if decision is not None:
+            gs.attributes["decision"] = decision
+        gs.finish()
+        self.gateway_span = None
+        self.queue_span = Span("enqueue", "serve", self.trace,
+                               parent_id=self.root.span_id)
+        self.trace.record(self.queue_span)
+        return gs.duration_s
+
+    def shed(self, *, reason: str, retry_after_s: float = 0.0) -> None:
+        """Terminal gateway rejection (token bucket, queue depth or an
+        expired deadline): the tree still records, ending in a ``shed``
+        span so a shed request's trace is queryable like any other."""
+        if self.gateway_span is not None:
+            self.gateway_span.attributes["decision"] = "shed"
+            self.gateway_span.finish()
+            self.gateway_span = None
+        sp = Span("shed", "gateway", self.trace,
+                  parent_id=self.root.span_id, attributes={
+                      "reason": reason,
+                      "retry_after_s": round(float(retry_after_s), 6)})
+        sp.finish()
+        self.trace.record(sp)
+        self.root.status = "shed"
+        self._finish()
+
+    def hop_begin(self, *, reason: str,
+                  from_replica: int | str | None = None) -> None:
+        """The request was evicted mid-flight (preempt or drain) and is
+        heading back through the requeue path. The live hop span stays
+        open until the NEXT admission closes it — its duration is the
+        eviction→readmission gap the critical path charges to ``hop``."""
+        if self.hop_span is not None:
+            return                       # already hopping (drain of a drain)
+        attrs: dict[str, Any] = {"reason": reason}
+        if from_replica is not None:
+            attrs["from_replica"] = from_replica
+        self.hop_span = Span("hop", "hop", self.trace,
+                             parent_id=self.root.span_id, attributes=attrs)
+        self.trace.record(self.hop_span)
+
+    def handoff(self, *, pages: int, seconds: float,
+                replica: int | str | None = None) -> None:
+        """Disagg prefill export/import: the prefill worker ran the
+        prompt and the decode replica imported the KV pages."""
+        attrs: dict[str, Any] = {"pages": int(pages)}
+        if replica is not None:
+            attrs["replica"] = replica
+        sp = self._post_span("handoff", self.root.span_id,
+                             float(seconds), attrs)
+        sp.kind = "gateway"
+
     # -- batcher edges -------------------------------------------------------
     def admitted(self, *, slot: int, shard: int, wave_s: float,
                  plan: dict | None,
@@ -136,6 +234,9 @@ class RequestTrace:
         if self.queue_span is not None:
             self.queue_span.finish()
             self.queue_span = None
+        if self.hop_span is not None:    # readmission closes the hop
+            self.hop_span.finish()
+            self.hop_span = None
         attrs: dict[str, Any] = {"slot": slot, "shard": shard}
         if replica is not None:
             # which gateway replica admitted this request — a re-routed
@@ -193,9 +294,15 @@ class RequestTrace:
         self._finish()
 
     def _finish(self) -> None:
+        if self.gateway_span is not None:    # failed before dispatch
+            self.gateway_span.finish()
+            self.gateway_span = None
         if self.queue_span is not None:      # failed before admission
             self.queue_span.finish()
             self.queue_span = None
+        if self.hop_span is not None:        # failed mid-hop
+            self.hop_span.finish()
+            self.hop_span = None
         self.root.finish()
         self.store.add(TraceRecord(
             name=self.trace.trace_id, operation="serve",
@@ -213,9 +320,73 @@ class ServeTracer:
         self.max_spans = max_spans
 
     def begin(self, request_id: str, *, prompt_len: int,
-              max_tokens: int) -> RequestTrace:
+              max_tokens: int, gateway: bool = False,
+              tenant: str | None = None,
+              priority: str | None = None) -> RequestTrace:
         return RequestTrace(request_id, self.store, self.max_spans,
-                            prompt_len, max_tokens)
+                            prompt_len, max_tokens, gateway=gateway,
+                            tenant=tenant, priority=priority)
+
+
+#: span name → critical-path phase, highest-specificity first: where two
+#: spans of different phases overlap in time, the EARLIER entry here wins
+#: the overlap (prefill inside its admit wave is charged to prefill, a
+#: segment overlapping the retire fetch is charged to decode, …)
+_PHASE_ORDER = (
+    ("prefill", "prefill"),
+    ("handoff", "handoff"),
+    ("admit", "admit"),
+    ("segment", "decode"),
+    ("retire", "host_blocked"),
+    ("hop", "hop"),
+    ("enqueue", "replica_queue"),
+    ("gateway", "gateway_wait"),
+    ("shed", "shed"),
+)
+
+
+def critical_path(payload: dict) -> dict:
+    """Attribute one stitched trace's end-to-end latency to exclusive
+    phases. ``payload`` is a rendered record (``render_record`` / the
+    ``--json`` wire shape). An interval sweep over the root's timeline
+    charges every instant to the highest-priority span covering it (see
+    ``_PHASE_ORDER``); uncovered time is reported as ``unattributed`` —
+    so the phases plus the remainder tile ``duration_s`` exactly."""
+    spans = payload.get("spans") or []
+    root = next((s for s in spans if not s.get("parent_id")), None)
+    if root is None:
+        return {"request": payload.get("request"), "duration_s": 0.0,
+                "phases": {}, "unattributed": 0.0}
+    r0 = float(root.get("start_offset_s") or 0.0)
+    r1 = r0 + float(root.get("duration_s") or 0.0)
+    prio = {name: i for i, (name, _) in enumerate(_PHASE_ORDER)}
+    ivals = []                       # (start, end, priority) clipped to root
+    for s in spans:
+        p = prio.get(s.get("name"))
+        if p is None:
+            continue
+        a = max(r0, float(s.get("start_offset_s") or 0.0))
+        b = min(r1, a + float(s.get("duration_s") or 0.0))
+        if b > a:
+            ivals.append((a, b, p))
+    cuts = sorted({r0, r1} | {x for a, b, _ in ivals for x in (a, b)})
+    acc = {phase: 0.0 for _, phase in _PHASE_ORDER}
+    unattributed = 0.0
+    for a, b in zip(cuts, cuts[1:]):
+        covering = [p for ia, ib, p in ivals if ia <= a and b <= ib]
+        if covering:
+            acc[_PHASE_ORDER[min(covering)][1]] += b - a
+        else:
+            unattributed += b - a
+    phases = {k: round(v, 6) for k, v in acc.items() if v > 0}
+    return {
+        "request": payload.get("request"),
+        "duration_s": round(r1 - r0, 6),
+        "status": root.get("status", "ok"),
+        "ttft_s": (root.get("attributes") or {}).get("ttft_s"),
+        "phases": phases,
+        "unattributed": round(unattributed, 6),
+    }
 
 
 def render_record(rec: TraceRecord) -> dict:

@@ -481,6 +481,7 @@ class ContinuousBatcher:
         self._dispatch_t0: float | None = None
         self._compiles_seen = 0
         self._aot_noted = False
+        self._traced_seen = False       # a gateway-traced request arrived
         self._cond = threading.Condition()
         self._queue: deque[_Pending] = deque()
         self._track: dict[int, dict] = {}       # slot -> in-flight state
@@ -646,6 +647,11 @@ class ContinuousBatcher:
                 reqs = [self._track.pop(s)["req"] for s in victims]
                 for r in reqs:
                     self.stats.requeued(reason)
+                    if r.trace is not None:
+                        # the hop span stays open until the victim's NEXT
+                        # admission — same trace id, same tree (round 18)
+                        r.trace.hop_begin(reason=reason,
+                                          from_replica=self.replica)
                 if self._paged and victims:
                     try:
                         self.engine.release(victims)
@@ -675,6 +681,12 @@ class ContinuousBatcher:
             reqs = [self._track.pop(s)["req"] for s in victims]
             for r in reqs:
                 self.stats.requeued(reason)
+                if r.trace is not None:
+                    # in-flight victims only: a stranded-queue request's
+                    # enqueue span is still open — its wait simply
+                    # continues on whichever replica admits it next
+                    r.trace.hop_begin(reason=reason,
+                                      from_replica=self.replica)
             if self._paged and victims:
                 try:
                     self.engine.release(victims)
@@ -884,6 +896,8 @@ class ContinuousBatcher:
                 t = {"req": r, "plen": plen, "pos": pos_map[slot],
                      "last": plen + r.max_tokens - 1, "ttft": False}
                 if r.trace is not None:
+                    # ko: lint-ok[KO201,KO301] single-writer: only the worker thread flips the sticky flag
+                    self._traced_seen = True
                     r.trace.admitted(slot=slot,
                                      shard=slot // self._shard_slots,
                                      wave_s=admit_s, plan=plans.get(slot),
@@ -911,7 +925,10 @@ class ContinuousBatcher:
             self.stats.executed(len(active))
             # ko: lint-ok[KO201,KO301] single-writer: only the worker thread times dispatches
             self._dispatch_t0 = t0
-            if self._tracer is not None:
+            # gateway-minted traces ride requests injected into an
+            # otherwise-untraced batcher; once one has been seen, compile
+            # events must reach those trees too
+            if self._tracer is not None or self._traced_seen:
                 self._note_compiles()
             k = self.engine.segment
             for s in active:

@@ -438,6 +438,41 @@ def bench_tracing_overhead(requests: int, slots: int, segment: int,
         "tok_s_on": round(on["tok_s"], 1),
         "overhead_pct": round(100 * overhead, 2),
         "traced": len(store.records()),
+        "gateway": _bench_gateway_tracing(requests, engine, trace,
+                                          stagger_s),
+    }
+
+
+def _bench_gateway_tracing(requests: int, engine, trace,
+                           stagger_s: float, replicas: int = 3) -> dict:
+    """Round 18: the same A/B through a 3-replica ``ServeGateway`` —
+    untraced dispatch vs gateway-minted stitched traces (root + gateway
+    wait span + dispatch bookkeeping per request) with the always-on
+    flight recorder live. Flight recording itself costs nothing on the
+    happy path by construction (only QoS edges — shed/preempt/drain —
+    append to its rings), so this measures what stitching adds to the
+    request path; the tier-1 guard pins it at ≤5% like the batcher arm."""
+    from kubeoperator_tpu.cluster import ServeGateway
+    from kubeoperator_tpu.telemetry.serve_trace import (
+        ServeTracer, ServeTraceStore,
+    )
+
+    def arm(tracer):
+        batchers = [ContinuousBatcher(engine(), stats=BatcherStats())
+                    for _ in range(replicas)]
+        gw = ServeGateway(batchers, policy="round_robin", tracer=tracer)
+        return run_load(gw, trace, stagger_s)
+
+    off = arm(None)
+    store = ServeTraceStore(max_records=requests)
+    on = arm(ServeTracer(store))
+    overhead = (off["tok_s"] - on["tok_s"]) / off["tok_s"]
+    return {
+        "replicas": replicas,
+        "tok_s_off": round(off["tok_s"], 1),
+        "tok_s_on": round(on["tok_s"], 1),
+        "overhead_pct": round(100 * overhead, 2),
+        "traced": len(store.records()),
     }
 
 

@@ -42,6 +42,13 @@ from kubeoperator_tpu.resources.store import Store
 from kubeoperator_tpu.services.platform import Platform
 
 
+@pytest.fixture(autouse=True)
+def _flight_dumps_to_tmp(tmp_path, monkeypatch):
+    """Breach-path tests auto-dump flight-recorder bundles; route them to
+    the test's tmp dir so runs never litter the checkout."""
+    monkeypatch.setenv("KO_FLIGHT_DIR", str(tmp_path))
+
+
 @pytest.fixture
 def fake_executor():
     return FakeExecutor()

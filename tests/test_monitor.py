@@ -311,6 +311,39 @@ def test_slo_breach_and_recovery_through_monitor_beat(platform, installed):
             for p in hist.data["points"]] == [4.5, 4.5, 0.1, 0.1]
 
 
+def test_slo_breach_edge_dumps_flight_bundle(platform, installed, tmp_path):
+    """The no_data → breach edge through the monitor beat freezes the
+    incident flight recorder: the auto-dumped bundle carries the breach
+    event and the offending history window. Recovery is an event, not an
+    incident — no second bundle (round 18)."""
+    import os
+
+    from kubeoperator_tpu.telemetry.flight import FLIGHT
+
+    FLIGHT.clear()
+    platform.config["serve_slos"] = {"ttft_p95_ms": 500}
+    platform.config["slo_fast_window"] = 2
+    platform.config["slo_slow_window"] = 4
+    t = ServeValueTransport(ttft_s=4.5)      # 4500ms >> 500ms target
+    mon.monitor_tick(platform, transport=t)
+    assert FLIGHT.dumps == 0                 # no edge yet, no bundle
+    mon.monitor_tick(platform, transport=t)  # window full: breach edge
+    assert FLIGHT.dumps == 1
+    bundles = [f for f in os.listdir(tmp_path) if f.startswith("FLIGHT_")]
+    assert len(bundles) == 1
+    with open(tmp_path / bundles[0], encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    assert bundle["reason"] == "slo_breach"
+    assert any(e["to"] == "breach" and e["cluster"] == "demo"
+               for e in bundle["events"])
+    assert [p["serve_ttft_p95"] for p in bundle["points"]] == [4.5, 4.5]
+    t.ttft_s = 0.1                           # recovered: 100ms
+    mon.monitor_tick(platform, transport=t)
+    mon.monitor_tick(platform, transport=t)
+    assert FLIGHT.dumps == 1                 # recovery edge: no new dump
+    FLIGHT.clear()
+
+
 def _pts(*ttft_s):
     return [{"time": f"t{i}", "serve_ttft_p95": v}
             for i, v in enumerate(ttft_s)]

@@ -405,9 +405,14 @@ def _bench_mod():
 def test_tracing_overhead_under_5_percent():
     """Tracing every request must cost ≤5% aggregate new-tok/s on the
     injected-latency cost model (span bookkeeping is host-side dict work
-    between sleeps; the margin absorbs CI scheduling noise)."""
+    between sleeps; the margin absorbs CI scheduling noise) — on the solo
+    batcher AND through the 3-replica gateway path, where the trace
+    context is gateway-minted and stitched across routing (round 18)."""
     out = _bench_mod().bench_tracing_overhead(
         requests=32, slots=16, segment=8, step_s=0.001, dispatch_s=0.002,
         prefill_s=0.002, stagger_s=0.002)
     assert out["traced"] == 32               # every request left a tree
     assert out["overhead_pct"] <= 5.0, out
+    gw = out["gateway"]
+    assert gw["replicas"] == 3 and gw["traced"] == 32
+    assert gw["overhead_pct"] <= 5.0, out
