@@ -656,6 +656,76 @@ class PagedPoolWriteBypass(Rule):
 
 
 @register
+class PagedPoolReadBypass(Rule):
+    """KO122 — in an engine whose paged KV pool may be quantized (it
+    defines the fused dequantizing gather ``_gather_kv``), a direct
+    subscript read of a pool buffer anywhere else bypasses the per-page
+    scale multiply. On a quantized pool the buffer holds raw int8/fp8
+    codes; a bare ``pool[block_table]`` gather has exactly the shape the
+    attention matmul expects and silently feeds it garbage — the read
+    twin of KO121's write-path discipline."""
+
+    id = "KO122"
+    severity = "error"
+    title = "page-pool read discipline"
+    hint = ("route the read through the engine's _gather_kv(pool, scale, "
+            "idx) helper so quantized pools are dequantized exactly once, "
+            "fused into the gather (raw page moves belong in "
+            "_page_copy/_page_export)")
+
+    _ALLOWED = {"_gather_kv", "_page_write", "_page_copy", "_page_export"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                       and m.name == "_gather_kv" for m in cls.body):
+                continue
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Load)):
+                    continue
+                base = self._pool_base(node)
+                if base is None:
+                    continue
+                fn = ctx.enclosing_function(node)
+                if fn is not None and getattr(fn, "name", "") \
+                        in self._ALLOWED:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"direct subscript read of paged pool buffer '{base}' "
+                    f"outside _gather_kv — a quantized pool holds raw "
+                    f"int8/fp8 codes, so the read skips the fused per-page "
+                    f"dequantize and feeds unscaled values downstream")
+
+    @staticmethod
+    def _pool_base(node: ast.Subscript) -> str | None:
+        """Name of the pool buffer a subscript reads ('pool' in the
+        identifier marks the paged buffers), else None. ``.at[...]``
+        chains are KO121's write path, never a read bypass."""
+        saw_at = False
+        expr: ast.AST = node.value
+        while isinstance(expr, (ast.Subscript, ast.Attribute)):
+            if isinstance(expr, ast.Attribute):
+                if expr.attr == "at":
+                    saw_at = True
+                elif "pool" in expr.attr.lower():
+                    return None if saw_at else expr.attr
+                expr = expr.value
+                continue
+            expr = expr.value
+        if saw_at:
+            return None
+        if isinstance(expr, ast.Name) and "pool" in expr.id.lower():
+            return expr.id
+        return None
+
+
+@register
 class OpaqueJitCallable(Rule):
     """KO141 — ``jax.jit`` applied to a callable expression the KO140
     fingerprint cannot resolve to a def: a factory call's return value,

@@ -125,6 +125,11 @@ class CacheKey:
     jax_version: str
     jaxlib_version: str
     baseline_sig: str
+    # non-shape closure constants the traced function bakes into the
+    # executable (segment length, model config, kv dtype): two engines
+    # with identical example-arg shapes but different closures must not
+    # share an artifact
+    closure_sig: str = ""
 
     def payload(self) -> dict:
         d = dataclasses.asdict(self)
@@ -209,7 +214,8 @@ class CompileCache:
     # -- key construction ---------------------------------------------------
     def key_for(self, name: str, args: tuple, kwargs: dict | None = None, *,
                 mesh_spec: Any = None, donate: tuple[int, ...] = (),
-                static: tuple[int, ...] = ()) -> CacheKey:
+                static: tuple[int, ...] = (),
+                closure: Any = None) -> CacheKey:
         import jax
 
         dev = jax.devices()[0]
@@ -224,13 +230,15 @@ class CompileCache:
             jax_version=jax.__version__,
             jaxlib_version=_jaxlib_version(),
             baseline_sig=baseline_fingerprint(name, self.baseline_path),
+            closure_sig="" if closure is None else repr(closure),
         )
 
     # -- the one entry point engines use -------------------------------------
     def load_or_compile(self, name: str, jitted: Callable, args: tuple,
                         kwargs: dict | None = None, *, mesh_spec: Any = None,
                         donate: tuple[int, ...] = (),
-                        static: tuple[int, ...] = ()) -> AotResult:
+                        static: tuple[int, ...] = (),
+                        closure: Any = None) -> AotResult:
         """Return a ready executable for ``jitted`` at ``args``' shapes.
 
         Hit: deserialize the stored executable — no trace, no compile.
@@ -241,7 +249,7 @@ class CompileCache:
         """
         self._wire_xla_cache()
         key = self.key_for(name, args, kwargs, mesh_spec=mesh_spec,
-                           donate=donate, static=static)
+                           donate=donate, static=static, closure=closure)
         fp = key.fingerprint()
         entry = self._entry_dir(name, fp)
         guard = _active_guard()

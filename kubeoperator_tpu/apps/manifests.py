@@ -298,7 +298,10 @@ data:
       {{"title": "KV pages used (by mesh shard) / prefix hit rate", "type": "timeseries", "gridPos": {{"x":0,"y":24,"w":12,"h":8}},
         "targets": [{{"expr": "sum(ko_serve_kv_pages_used)"}},
                     {{"expr": "sum(ko_serve_kv_pages_used) by (shard)", "legendFormat": "shard {{{{shard}}}}"}},
-                    {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}}]}},
+                    {{"expr": "sum(rate(ko_serve_prefix_hits_total[5m]))"}},
+                    {{"expr": "sum(ko_serve_kv_spill_pages) by (shard)", "legendFormat": "spill shard {{{{shard}}}}"}},
+                    {{"expr": "sum(rate(ko_serve_kv_demotions_total[5m]))", "legendFormat": "demotions/s"}},
+                    {{"expr": "sum(rate(ko_serve_kv_promoted_hits_total[5m]))", "legendFormat": "promoted hits/s"}}]}},
       {{"title": "SLO burn rate (by slo, fast/slow window, tenant)", "type": "timeseries", "gridPos": {{"x":12,"y":24,"w":12,"h":8}},
         "targets": [{{"expr": "ko_slo_burn_rate", "legendFormat": "{{{{slo}}}} {{{{window}}}} {{{{tenant}}}}"}},
                     {{"expr": "ko_slo_target_ratio", "legendFormat": "{{{{slo}}}} attainment {{{{tenant}}}}"}},

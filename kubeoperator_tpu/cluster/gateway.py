@@ -221,7 +221,8 @@ class AggregateStats:
 
     _SUMMED = ("requests_total", "errors_total", "batches_total",
                "tokens_generated_total", "queue_depth", "slot_occupancy",
-               "kv_pages_used", "prefix_hits_total",
+               "kv_pages_used", "prefix_hits_total", "kv_spill_pages",
+               "kv_demotions_total", "kv_promoted_hits_total",
                "requests_requeued_total")
 
     def __init__(self, stats: Sequence[Any]):

@@ -146,10 +146,21 @@ class FakePagedEngine(FakeSlotEngine):
     handoff: a prefill worker's finished prefix enters the cache
     directly, so the next admission of a matching prompt skips the
     prefill sleep on the *decode* worker thread — which is exactly the
-    segment-time interference disaggregation removes."""
+    segment-time interference disaggregation removes.
+
+    ``kv_dtype``/``spill_pages`` (round 19) mirror the quantized pool +
+    host-RAM spill tier: ``kv_dtype`` is carried for protocol parity
+    (equal-HBM modeling happens in the caller, which doubles ``pages``
+    for int8 exactly like the real pool does at equal bytes), and a
+    bounded per-dp-shard host LRU catches prefix entries the device
+    cache evicts. A later hit on a demoted entry pays ``promote_s`` per
+    promoted page — the host→device gather — instead of that share of
+    the prefill sleep, which is the demoted-hit-TTFT-vs-recompute gap
+    the tier-1 guard pins."""
 
     def __init__(self, *, page: int = 16, pages: int | None = None,
-                 prefix_capacity: int | None = None, **kw):
+                 prefix_capacity: int | None = None, kv_dtype: str = "bf16",
+                 spill_pages: int = 0, promote_s: float = 0.0001, **kw):
         super().__init__(**kw)
         if page <= 0 or page & (page - 1):
             raise ValueError(f"page ({page}) must be a power of two")
@@ -164,6 +175,51 @@ class FakePagedEngine(FakeSlotEngine):
         self._prefix: list[OrderedDict[tuple[int, ...], None]] = [
             OrderedDict() for _ in range(self.dp)]
         self.prefix_hits = 0
+        self.kv_dtype = kv_dtype
+        self.spill_pages = int(spill_pages)
+        self.promote_s = promote_s
+        self._spill: list[OrderedDict[tuple[int, ...], int]] = [
+            OrderedDict() for _ in range(self.dp)]
+        self._spill_used = [0] * self.dp
+        self.demotions = 0
+        self.promoted_hits = 0
+
+    def spill_pages_used(self, shard: int = 0) -> int:
+        return self._spill_used[shard]
+
+    def _demote(self, shard: int, key: tuple[int, ...]) -> None:
+        """Catch a device-evicted prefix entry in the bounded host LRU
+        (oldest host entries fall out to make room, as in the real tier)."""
+        n = len(key) // self.page
+        if not self.spill_pages or n > self.spill_pages:
+            return
+        spill = self._spill[shard]
+        if key in spill:
+            spill.move_to_end(key)
+            return
+        while self._spill_used[shard] + n > self.spill_pages and spill:
+            _old, m = spill.popitem(last=False)
+            self._spill_used[shard] -= m
+        spill[key] = n
+        self._spill_used[shard] += n
+        self.demotions += 1
+
+    def _promote(self, shard: int, prompt: list[int], hit: int) -> int:
+        """Longest demoted prefix covering more of ``prompt`` than the
+        device cache does: republish it device-side; the caller's hit
+        math then skips that share of prefill exactly like a device-cache
+        hit, and the admission bucket pays ``promote_s`` per promoted
+        page (the batched host→device gather) instead."""
+        spill = self._spill[shard]
+        for n in range(len(prompt) // self.page, hit, -1):
+            key = tuple(prompt[:n * self.page])
+            if key in spill:
+                spill.pop(key)
+                self._spill_used[shard] -= n
+                self._remember(shard, list(key))
+                self.promoted_hits += 1
+                return n
+        return hit
 
     @property
     def max_request_pages(self) -> int:
@@ -202,7 +258,8 @@ class FakePagedEngine(FakeSlotEngine):
                 cache[key] = None
         if self.prefix_capacity is not None:
             while len(cache) > self.prefix_capacity:
-                cache.popitem(last=False)
+                old, _ = cache.popitem(last=False)
+                self._demote(shard, old)
 
     def import_prefix(self, tokens, layers=None, shard: int = 0) -> int:
         """Cost-model disaggregated handoff: a prefill worker's finished
@@ -230,9 +287,14 @@ class FakePagedEngine(FakeSlotEngine):
         out = {}
         for c, group in by_c.items():
             uncached = 0.0   # the bucket prefills at its worst row's share
+            promoted = 0     # pages gathered host→device for this bucket
             for slot, prompt, max_tokens in group:
                 shard = slot // self._shard_slots
                 hit = self._hit_pages(shard, prompt)
+                if self.spill_pages and hit * self.page < len(prompt):
+                    new_hit = self._promote(shard, prompt, hit)
+                    promoted += new_hit - hit
+                    hit = new_hit
                 if hit:
                     self.prefix_hits += 1
                 uncached = max(
@@ -248,9 +310,10 @@ class FakePagedEngine(FakeSlotEngine):
                 self.pos[slot] = c
                 self.last[slot] = total - 1
                 out[slot] = c
-            if uncached > 0:
+            if uncached > 0 or promoted:
                 time.sleep(self.dispatch_s + self._link_s
-                           + uncached * self.prefill_s / self.tp)
+                           + uncached * self.prefill_s / self.tp
+                           + self.promote_s * promoted)
                 self.dispatches += 1
         return out
 

@@ -62,8 +62,9 @@ def _build_engine(espec: dict):
                                 "collective_s") if k in espec}
     if espec.get("kind", "paged") == "dense":
         return FakeSlotEngine(**kw)
-    if "page" in espec:
-        kw["page"] = espec["page"]
+    for k in ("page", "prefix_capacity", "kv_dtype", "spill_pages"):
+        if k in espec:
+            kw[k] = espec[k]
     return FakePagedEngine(**kw)
 
 

@@ -20,6 +20,10 @@ A scenario is one dict (YAML on disk, plain dict in tests)::
       segment: 4
       max_total: 256
       page: 16
+      kv_dtype: bf16 | int8 | fp8   # int8/fp8: caller doubles pages
+                                    #   (equal-HBM quantized pool)
+      spill_pages: 0                # host-RAM prefix spill tier bound
+                                    #   per dp shard (0 = off)
       step_s / dispatch_s / prefill_s: injected latencies
     hosts: [10.0.0.1, 10.0.0.2, 10.0.0.3]   # probed through the chaos
                                             #   transport every beat
@@ -137,6 +141,15 @@ def validate_spec(spec: Any) -> list[str]:
     elif eng.get("kind", "paged") not in ENGINE_KINDS:
         errs.append(f"engine.kind: must be one of {ENGINE_KINDS}, "
                     f"got {eng.get('kind')!r}")
+    else:
+        kd = eng.get("kv_dtype", "bf16")
+        if kd not in ("bf16", "int8", "fp8"):
+            errs.append(f"engine.kv_dtype: must be one of ('bf16', 'int8', "
+                        f"'fp8'), got {kd!r}")
+        sp = eng.get("spill_pages", 0)
+        if not isinstance(sp, int) or isinstance(sp, bool) or sp < 0:
+            errs.append(f"engine.spill_pages: must be a non-negative "
+                        f"integer, got {sp!r}")
 
     workloads = spec.get("workloads")
     if not isinstance(workloads, list) or not workloads:

@@ -54,6 +54,11 @@ QUERIED_METRICS = {
     # paged KV cache (round 8): page-pool pressure + prefix-cache payoff
     "ko_serve_kv_pages_used": "jax-serve",
     "ko_serve_prefix_hits_total": "jax-serve",
+    # KV spill tier (round 19): host-RAM prefix-cache footprint and the
+    # demote/promote traffic between HBM and the host tier
+    "ko_serve_kv_spill_pages": "jax-serve",
+    "ko_serve_kv_demotions_total": "jax-serve",
+    "ko_serve_kv_promoted_hits_total": "jax-serve",
     # autoscaler (round 11): in-flight requests requeued by drain/preemption
     "ko_serve_requests_requeued_total": "jax-serve",
     # cluster gateway (round 13): routing volume per replica/decision,
@@ -118,6 +123,13 @@ PROMQL = {
     # the prefix cache's hit rate (skipped prefills per second)
     "serve_kv_pages_used": "sum(ko_serve_kv_pages_used)",
     "serve_prefix_hit_rate": "sum(rate(ko_serve_prefix_hits_total[5m]))",
+    # KV spill tier (round 19): host-tier footprint plus demotion/promotion
+    # traffic — promoted hits are prefills served from host RAM instead of
+    # recomputed, demotions are cache entries saved from eviction
+    "serve_kv_spill_pages": "sum(ko_serve_kv_spill_pages)",
+    "serve_kv_demotion_rate": "sum(rate(ko_serve_kv_demotions_total[5m]))",
+    "serve_kv_promoted_hit_rate":
+        "sum(rate(ko_serve_kv_promoted_hits_total[5m]))",
     # autoscaler (round 11): drain/preemption requeue pressure — a sustained
     # nonzero rate means topology churn is recycling in-flight decodes
     "serve_requeued_rate":
@@ -561,6 +573,11 @@ class ClusterMonitor:
         serve_ttft = prom.scalar_or_none(PROMQL["serve_ttft_p95"])
         serve_pages = prom.scalar_or_none(PROMQL["serve_kv_pages_used"])
         serve_hit_rate = prom.scalar_or_none(PROMQL["serve_prefix_hit_rate"])
+        serve_spill = prom.scalar_or_none(PROMQL["serve_kv_spill_pages"])
+        serve_demotions = prom.scalar_or_none(
+            PROMQL["serve_kv_demotion_rate"])
+        serve_promoted = prom.scalar_or_none(
+            PROMQL["serve_kv_promoted_hit_rate"])
         serve_requeued = prom.scalar_or_none(PROMQL["serve_requeued_rate"])
         # cluster gateway: None marks "no gateway tier deployed"
         gateway_rate = prom.scalar_or_none(PROMQL["gateway_routed_rate"])
@@ -637,6 +654,9 @@ class ClusterMonitor:
             "serve_ttft_p95": serve_ttft,
             "serve_kv_pages_used": serve_pages,
             "serve_prefix_hit_rate": serve_hit_rate,
+            "serve_kv_spill_pages": serve_spill,
+            "serve_kv_demotion_rate": serve_demotions,
+            "serve_kv_promoted_hit_rate": serve_promoted,
             "serve_requeued_rate": serve_requeued,
             "serve_shed_by_tenant": serve_shed_rates,
             "serve_preemption_by_tenant": serve_preempt_rates,
@@ -690,6 +710,11 @@ class ClusterMonitor:
                        "serve_ttft_p95": data["serve_ttft_p95"],
                        "serve_kv_pages_used": data["serve_kv_pages_used"],
                        "serve_prefix_hit_rate": data["serve_prefix_hit_rate"],
+                       "serve_kv_spill_pages": data["serve_kv_spill_pages"],
+                       "serve_kv_demotion_rate":
+                           data["serve_kv_demotion_rate"],
+                       "serve_kv_promoted_hit_rate":
+                           data["serve_kv_promoted_hit_rate"],
                        "serve_requeued_rate": data["serve_requeued_rate"],
                        "gateway_routed_rate": data["gateway_routed_rate"],
                        "gateway_affinity_ratio":

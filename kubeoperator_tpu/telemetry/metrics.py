@@ -442,6 +442,19 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             "ko_serve_prefix_hits_total",
             "Admissions that reused cached prompt-prefix pages (their "
             "prefill was skipped; paged continuous engine)."),
+        "kv_spill_pages": registry.gauge(
+            "ko_serve_kv_spill_pages",
+            "KV pages currently parked in the host-RAM prefix-cache "
+            "spill tier, per dp mesh shard (paged continuous engine).",
+            labels=("shard",)),
+        "kv_demotions": registry.counter(
+            "ko_serve_kv_demotions_total",
+            "Cold prefix-cache entries demoted from device HBM into the "
+            "host-RAM spill tier at LRU eviction instead of dropped."),
+        "kv_promoted_hits": registry.counter(
+            "ko_serve_kv_promoted_hits_total",
+            "Admissions whose prompt prefix hit a demoted entry and was "
+            "gathered host->device instead of recomputed."),
         "requeued": registry.counter(
             "ko_serve_requests_requeued_total",
             "In-flight requests snapshotted off drained slots and pushed "

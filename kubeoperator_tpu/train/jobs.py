@@ -404,7 +404,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                     segment=args.segment,
                                     page=args.page, pages=args.pages,
                                     mesh_spec=mesh_spec,
-                                    compile_cache=compile_cache)
+                                    compile_cache=compile_cache,
+                                    kv_dtype=args.kv_dtype,
+                                    spill_pages=args.spill_pages)
         except ValueError as e:
             raise SystemExit(f"serve: {e}") from e
         # round 9: per-request span trees into the in-process ring —
@@ -422,6 +424,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         emit({"job": "serve", "engine": "continuous",
               "slots": args.slots, "segment": args.segment,
               "page": engine.page, "pages": engine.pages,
+              "kv_dtype": engine.kv_dtype,
+              "spill_pages": engine.spill_pages,
               "mesh": (dict(engine.spec.sizes())
                        if engine.spec is not None else None),
               "aot": ({"hit": engine.aot.hit,
@@ -887,6 +891,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "shards — the admission limiter (default "
                          "slots * max_seq_len/page + dp, dense-"
                          "equivalent HBM)")
+    sv.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16",
+                    help="continuous engine: KV page-pool element type — "
+                         "int8 stores quantized codes with per-page "
+                         "scales (~2x pages at equal HBM; greedy logits "
+                         "within the engine's declared tolerance instead "
+                         "of bit-identical)")
+    sv.add_argument("--spill-pages", type=int, default=0,
+                    help="continuous engine: host-RAM prefix-cache spill "
+                         "tier bound, in KV pages per dp shard — cold "
+                         "prefix entries demote here at LRU eviction and "
+                         "promote back on a later hit (0 disables)")
     sv.add_argument("--aot-cache", type=str, default=None,
                     help="continuous engine: AOT compile-artifact cache "
                          "dir — bring-up loads the segment executable "

@@ -63,6 +63,30 @@ rewrites its own frozen position (or the trash page) with the same value,
 and a stale entry in a recycled page is always overwritten (at ``pos``)
 before the mask first exposes it — so garbage never reaches live rows.
 
+Quantized KV (round 19): ``kv_dtype="int8"`` (or ``"fp8"`` where the
+dtype exists) stores every page pool in 1-byte elements with a per-
+(page, offset, head) float32 scale buffer alongside — roughly double
+the KV capacity at equal HBM, which is admission concurrency under the
+page-based admission above. The quantize hook lives INSIDE
+``_page_write``/``_page_copy`` (already the only legal pool write
+paths, KO121) and the dequantize is fused into the segment jit's
+``pool[block_table]`` gather (``_gather_kv`` — the only legal pool
+READ path, enforced by lint rule KO122), so attention matmuls stay in
+the model dtype and no extra HBM round trip is added. Bit-exactness
+becomes a two-tier policy: bf16 pools keep the bit-identical guarantee
+below; quantized pools pin a declared greedy-logit tolerance
+(``LOGIT_TOLERANCE``, surfaced as ``engine.logit_tolerance`` and
+asserted by the signature tests via ``debug_logits()``).
+
+Host-RAM spill tier (round 19): with ``spill_pages=N``, LRU eviction
+of a cold cache-only prefix entry demotes its raw pages (quantized
+bits + scales — a bit-exact round trip) into a bounded per-dp-shard
+host pool instead of dropping them; a later prefix hit on a demoted
+entry becomes a host→device ``import_prefix``-style gather
+(``_promote_spill``) instead of a recompute. Cluster-wide the
+gateway's sticky prefix hashing already shards requests by prefix, so
+each replica's spill tier acts as one shard of a giant cluster cache.
+
 Multi-chip (round 7): pass a dp×tp ``MeshSpec`` and the same pool runs
 sharded over a device mesh — the page axis P splits over ``dp`` (the
 allocator hands each dp group a contiguous page range, so a slot's block
@@ -117,6 +141,26 @@ def _default_page(max_total: int) -> int:
     return p
 
 
+#: legal page-pool element layouts. "bf16" means "the model dtype,
+#: unquantized" (pools store cfg.dtype verbatim — float32 in tests);
+#: "int8"/"fp8" store 1-byte elements plus per-(page, offset, head)
+#: float32 scales.
+KV_DTYPES = ("bf16", "int8", "fp8")
+
+#: declared greedy-logit tolerance per KV layout — the two-tier
+#: bit-exactness policy. bf16 pools are BIT-IDENTICAL to solo
+#: ``generate()`` (tolerance 0.0, the pre-round-19 guarantee,
+#: unchanged); quantized pools promise max |logit delta| below this
+#: bound instead, pinned by the signature tests through
+#: ``debug_logits()``. The int8 bound is empirical headroom over the
+#: worst admission path (seeded prefill attends over dequantized K/V
+#: while a cold prefill attends over the exact scratch values).
+LOGIT_TOLERANCE = {"bf16": 0.0, "int8": 0.25, "fp8": 0.25}
+
+#: symmetric quantization range per quantized dtype
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
 def donation_argnums(platform: str) -> tuple[int, ...]:
     """Segment-dispatch donation (buf, pos, page pools — argnums 0, 1, 6)
     for the platform the engine's buffers actually LIVE on. Decided from
@@ -130,9 +174,32 @@ def donation_argnums(platform: str) -> tuple[int, ...]:
 
 
 def validate_page_pool(*, page: int, pages: int, max_seq_len: int,
-                       dp: int = 1) -> None:
+                       dp: int = 1, kv_dtype: str = "bf16",
+                       spill_pages: int = 0) -> None:
     """Reject un-serveable page-pool layouts up front with actionable
-    errors instead of an opaque gather/scatter shape failure mid-admit."""
+    errors instead of an opaque gather/scatter shape failure mid-admit.
+    ``kv_dtype`` validates the quantized scale layout in the same
+    breath; ``spill_pages`` the host spill-tier bound."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype ({kv_dtype!r}) must be one of {KV_DTYPES}: bf16 "
+            f"stores the model dtype verbatim (bit-identical decode), "
+            f"int8/fp8 store 1-byte pages with per-page scales")
+    if kv_dtype == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+        raise ValueError(
+            "kv_dtype 'fp8' needs jnp.float8_e4m3fn, which this jax "
+            "build does not provide; use 'int8'")
+    if kv_dtype != "bf16" and page < 2:
+        raise ValueError(
+            f"page size ({page}) must be >= 2 for the quantized "
+            f"({kv_dtype}) layout: each page row carries a float32 "
+            f"scale per (offset, head), so a 1-token page spends as "
+            f"many scale bytes as a bf16 page spends on K/V and the "
+            f"int8 HBM win cancels")
+    if spill_pages < 0:
+        raise ValueError(
+            f"spill_pages ({spill_pages}) must be >= 0 (0 disables the "
+            f"host-RAM spill tier)")
     if page < 1 or page & (page - 1):
         raise ValueError(
             f"page size ({page}) must be a power of two: admission "
@@ -210,10 +277,12 @@ class _PageShard:
     shard's contiguous page range, per-page refcounts (``ref`` counts
     every holder, ``cache_ref`` the prefix-cache's share of it — a page
     is evictable exactly when the two are equal), the LRU prefix cache
-    ``hash(tokens) -> (tokens, pages)``, and the reserved trash page."""
+    ``hash(tokens) -> (tokens, pages)``, the reserved trash page, and
+    (round 19) the bounded host-RAM spill tier ``hash(tokens) ->
+    (tokens, payload, n_pages)`` holding raw demoted pages."""
 
     __slots__ = ("index", "base", "span", "trash", "free", "ref",
-                 "cache_ref", "prefix")
+                 "cache_ref", "prefix", "spill", "spill_used")
 
     def __init__(self, index: int, base: int, span: int):
         self.index = index
@@ -225,6 +294,12 @@ class _PageShard:
         self.cache_ref: dict[int, int] = {}
         self.prefix: OrderedDict[int, tuple[tuple[int, ...],
                                             tuple[int, ...]]] = OrderedDict()
+        # host spill tier: LRU of demoted prefix entries (raw page bytes
+        # + scales, fetched once at demotion). spill_used counts pages so
+        # the tier stays bounded by the engine's spill_pages.
+        self.spill: OrderedDict[int, tuple[tuple[int, ...], list,
+                                           int]] = OrderedDict()
+        self.spill_used = 0
 
 
 class SlotPoolEngine:
@@ -258,6 +333,7 @@ class SlotPoolEngine:
     def __init__(self, cfg: TransformerConfig, params: Any, *,
                  slots: int = 16, segment: int = 8,
                  page: int | None = None, pages: int | None = None,
+                 kv_dtype: str = "bf16", spill_pages: int = 0,
                  mesh: Any = None, mesh_spec: MeshSpec | None = None,
                  devices: Sequence[Any] | None = None,
                  compile_cache: Any = None):
@@ -288,7 +364,8 @@ class SlotPoolEngine:
             tp_ax = "tp" if "tp" in self.mesh.axis_names else None
             self._buf_sh = NamedSharding(self.mesh, P(dp_ax, None))
             self._vec_sh = NamedSharding(self.mesh, P(dp_ax))
-            self._pool_sh, self._bt_sh = shard_page_pool(self.mesh)
+            self._pool_sh, self._bt_sh, self._scale_sh = \
+                shard_page_pool(self.mesh)
             # scratch prefill cache [L, k, C, H, D]: the admission group k
             # is not slot-aligned, so only heads shard
             self._scratch_sh = NamedSharding(
@@ -299,6 +376,7 @@ class SlotPoolEngine:
             self.mesh = None
             self._buf_sh = self._vec_sh = None
             self._pool_sh = self._bt_sh = self._scratch_sh = None
+            self._scale_sh = None
         self.dp = self.spec.dp if self.spec is not None else 1
 
         # -- paged-KV geometry ----------------------------------------------
@@ -314,8 +392,18 @@ class SlotPoolEngine:
             # until validate_page_pool rejects a bad page size below.
             self.pages = (self.slots * (self.max_total // max(self.page, 1))
                           + self.dp)
+        self.kv_dtype = str(kv_dtype)
+        self.spill_pages = int(spill_pages)
         validate_page_pool(page=self.page, pages=self.pages,
-                           max_seq_len=self.max_total, dp=self.dp)
+                           max_seq_len=self.max_total, dp=self.dp,
+                           kv_dtype=self.kv_dtype,
+                           spill_pages=self.spill_pages)
+        self._quantized = self.kv_dtype != "bf16"
+        self._qdt = (None if not self._quantized
+                     else jnp.int8 if self.kv_dtype == "int8"
+                     else jnp.float8_e4m3fn)
+        self._qmax = _QMAX.get(self.kv_dtype)
+        self.logit_tolerance = LOGIT_TOLERANCE[self.kv_dtype]
         self.blocks = self.max_total // self.page
         self._shard_slots = self.slots // self.dp
         self._span = self.pages // self.dp
@@ -325,6 +413,8 @@ class SlotPoolEngine:
         self.prefix_hits = 0          # admissions that reused cached pages
         self.prefix_pages_reused = 0  # pages whose prefill was skipped
         self.cow_copies = 0           # copy-on-write page duplications
+        self.demotions = 0            # prefix entries demoted to host RAM
+        self.promoted_hits = 0        # admissions served from the spill tier
         self.last_plans: dict[int, dict] = {}   # last wave's admission plans
 
         self._emb = self._params["embedding"]
@@ -340,11 +430,30 @@ class SlotPoolEngine:
         self._plen = self._pin(jnp.ones((s,), jnp.int32), self._vec_sh)
         self._temp = self._pin(jnp.zeros((s,), jnp.float32), self._vec_sh)
         self._seeds = self._pin(jnp.zeros((s,), jnp.int32), self._vec_sh)
-        self._pools = [(self._pin(jnp.zeros((self.pages, self.page, h, d),
-                                            dt), self._pool_sh),
-                        self._pin(jnp.zeros((self.pages, self.page, h, d),
-                                            dt), self._pool_sh))
-                       for _ in range(cfg.n_layers)]
+        # bf16 keeps the exact pre-round-19 pytree — 2-tuples of model-
+        # dtype pools — so donation, out_shardings, AOT keys and the
+        # bit-identical guarantee are untouched. Quantized mode widens
+        # each layer entry to (k_pool, v_pool, k_scale, v_scale): 1-byte
+        # pools plus per-(page, offset, head) float32 scales.
+        if self._quantized:
+            def _entry():
+                return (
+                    self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                        self._qdt), self._pool_sh),
+                    self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                        self._qdt), self._pool_sh),
+                    self._pin(jnp.ones((self.pages, self.page, h),
+                                       jnp.float32), self._scale_sh),
+                    self._pin(jnp.ones((self.pages, self.page, h),
+                                       jnp.float32), self._scale_sh))
+            self._pools = [_entry() for _ in range(cfg.n_layers)]
+        else:
+            self._pools = [
+                (self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                     dt), self._pool_sh),
+                 self._pin(jnp.zeros((self.pages, self.page, h, d),
+                                     dt), self._pool_sh))
+                for _ in range(cfg.n_layers)]
         self._bt_np = np.zeros((s, self.blocks), np.int32)
         for i in range(self.dp):
             self._bt_np[i * self._shard_slots:(i + 1) * self._shard_slots] = \
@@ -366,9 +475,11 @@ class SlotPoolEngine:
             # pin the dispatch's output layouts to the canonical shardings
             # so the pool's layout is stable across segments (donation
             # needs matching in/out placements; GSPMD must not re-layout)
+            entry_sh = ((self._pool_sh, self._pool_sh, self._scale_sh,
+                         self._scale_sh) if self._quantized
+                        else (self._pool_sh, self._pool_sh))
             out_sh = (self._buf_sh, self._vec_sh,
-                      [(self._pool_sh, self._pool_sh)
-                       for _ in range(cfg.n_layers)])
+                      [entry_sh for _ in range(cfg.n_layers)])
         self._seg_fn = jax.jit(
             self._segment_body, donate_argnums=self._donate,
             **({"out_shardings": out_sh} if out_sh is not None else {}))
@@ -383,7 +494,9 @@ class SlotPoolEngine:
                 "_segment_body", self._seg_fn,
                 (self._buf, self._pos, self._last, self._plen, self._temp,
                  self._seeds, self._pools, self._bt),
-                mesh_spec=self.spec, donate=self._donate)
+                mesh_spec=self.spec, donate=self._donate,
+                closure=(self.segment, self.page, self.kv_dtype,
+                         repr(cfg)))
             if res.fn is not None:
                 self._seg_fn = res.fn
             self.aot = res
@@ -394,23 +507,102 @@ class SlotPoolEngine:
         through this, so the segment jit always sees one layout."""
         return x if sh is None else jax.device_put(x, sh)
 
+    # -- quantized-entry plumbing -------------------------------------------
+    def _split(self, entry):
+        """Normalize one per-layer pool entry to (k_pool, v_pool, k_scale,
+        v_scale); bf16 entries carry ``None`` scales."""
+        if self._quantized:
+            return entry
+        kp, vp = entry
+        return kp, vp, None, None
+
+    def _join(self, kp, vp, ks, vs):
+        """Inverse of ``_split`` — rebuild the layer entry in the arity
+        the engine's pytree (donation, out_shardings, AOT key) expects."""
+        return (kp, vp) if ks is None else (kp, vp, ks, vs)
+
+    def _pin_entry(self, kp, vp, ks, vs):
+        """``_join`` plus canonical placement — the host-side admission
+        writes arrive in scratch layouts and the segment jit's donated
+        inputs must keep the dp×tp placement."""
+        if ks is None:
+            return self._pin(kp, self._pool_sh), self._pin(vp, self._pool_sh)
+        return (self._pin(kp, self._pool_sh), self._pin(vp, self._pool_sh),
+                self._pin(ks, self._scale_sh), self._pin(vs, self._scale_sh))
+
+    def _quantize(self, vals):
+        """Symmetric per-(row, head) quantization over the head dim:
+        scale = amax/qmax so dequant is one fused multiply. Returns
+        (quantized values, float32 scales)."""
+        v32 = vals.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(v32), axis=-1)               # [..., H]
+        qscale = jnp.maximum(amax, 1e-30) / self._qmax
+        q = v32 / qscale[..., None]
+        if self._qdt == jnp.int8:
+            q = jnp.clip(jnp.round(q), -self._qmax, self._qmax)
+        return q.astype(self._qdt), qscale
+
     # -- page write discipline (KO121 anchors) ------------------------------
-    def _page_write(self, pool, pages, offsets, vals):
+    def _page_write(self, pool, pages, offsets, vals, scale=None):
         """THE pool write path: one scatter of already block-table-routed
         ``(page, offset)`` pairs. Every write into a paged KV pool must go
         through here or ``_page_copy`` — lint rule KO121 flags any other
         ``.at[...]`` update on a pool buffer, because a raw slot- or
         position-indexed write lands in whichever request currently owns
-        that page."""
-        return pool.at[pages, offsets].set(vals)
+        that page. With a ``scale`` buffer (quantized pools) the values
+        are quantized here — the hook inside the legal write path — and
+        the matching scale rows land in the same breath. Returns
+        ``(pool, scale)``; the scale is ``None`` for bf16 pools."""
+        if scale is None:
+            return pool.at[pages, offsets].set(vals), None
+        q, s = self._quantize(vals)
+        return (pool.at[pages, offsets].set(q),
+                scale.at[pages, offsets].set(s))
 
-    def _page_copy(self, pool, dst, src, src_pool=None):
+    def _page_copy(self, pool, dst, src, src_pool=None, *,
+                   scale=None, src_scale=None):
         """Whole-page duplication (gather + scatter): copy-on-write when a
         prefix-sharing slot is about to diverge from its cached pages, and
-        — with ``src_pool`` — the disaggregated import path, landing a
-        prefill worker's exported pages (``src`` indexes ``src_pool``)
-        into this pool's freshly allocated ``dst`` pages."""
-        return pool.at[dst].set((pool if src_pool is None else src_pool)[src])
+        — with ``src_pool`` — the import paths, landing exported or
+        demoted pages (``src`` indexes ``src_pool``) into this pool's
+        freshly allocated ``dst`` pages. Quantized pools move raw bits
+        plus their scale rows (same-pool copy-on-write and spill-tier
+        promotion are therefore bit-exact round trips); a bf16 payload
+        landing in a quantized pool (``src_pool`` without ``src_scale``,
+        the disaggregated import) is quantized on land. Returns
+        ``(pool, scale)``; the scale is ``None`` for bf16 pools."""
+        sp = pool if src_pool is None else src_pool
+        if scale is None:                                   # bf16 pool
+            return pool.at[dst].set(sp[src]), None
+        if src_pool is not None and src_scale is None:
+            q, s = self._quantize(sp[src])
+            return pool.at[dst].set(q), scale.at[dst].set(s)
+        ss = scale if src_scale is None else src_scale
+        return pool.at[dst].set(sp[src]), scale.at[dst].set(ss[src])
+
+    # -- page read discipline (KO122 anchors) -------------------------------
+    def _gather_kv(self, pool, scale, idx):
+        """THE pool read path: gather pages by index and — for quantized
+        pools — fuse the dequantizing multiply into the same expression,
+        so downstream attention math always sees model-dtype operands and
+        the 1-byte pool never takes an extra HBM round trip. Every K/V
+        read out of a paged pool must go through here (or the raw
+        ``_page_export`` demotion gather) — lint rule KO122 flags any
+        other subscript read of a pool buffer, because a raw read of a
+        quantized pool hands integer codes to bf16 math. bf16 pools
+        return the gather verbatim (a pure permutation copy — the
+        bit-identical guarantee)."""
+        if scale is None:
+            return pool[idx]
+        return (pool[idx].astype(jnp.float32)
+                * scale[idx][..., None]).astype(self.cfg.dtype)
+
+    def _page_export(self, buf, idx):
+        """Raw page gather for the spill tier: demotion must round-trip
+        the pool's stored bits (quantized codes AND their scale rows)
+        exactly, so a demote→promote cycle is bit-identical — dequantizing
+        here would re-quantize on promotion and compound the error."""
+        return buf[idx]
 
     # -- device math --------------------------------------------------------
     def _micro_step(self, buf, pos, last, plen, temp, seeds, pools, bt):
@@ -433,24 +625,31 @@ class SlotPoolEngine:
         off = pos - blk * self.page
         pg = bt[rows, blk]                                      # [S]
         new_pools = []
-        for pl, (kp, vp) in zip(self._layers, pools):
+        for pl, entry in zip(self._layers, pools):
+            kp, vp, ks, vs = self._split(entry)
             hdn = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
             q, k, v = token_qkv(pl["attn"], hdn, dt)
             q, k = _rope_rows(q, pos), _rope_rows(k, pos)
-            kp = self._page_write(kp, pg, off, k[:, 0].astype(dt))
-            vp = self._page_write(vp, pg, off, v[:, 0].astype(dt))
+            kp, ks = self._page_write(kp, pg, off, k[:, 0].astype(dt), ks)
+            vp, vs = self._page_write(vp, pg, off, v[:, 0].astype(dt), vs)
             if self._pool_sh is not None:
                 # keep the pool layout pinned through the scan: pages over
                 # dp, heads over tp — GSPMD then partitions the scatter and
                 # the attention einsums in place instead of re-laying-out
                 kp = jax.lax.with_sharding_constraint(kp, self._pool_sh)
                 vp = jax.lax.with_sharding_constraint(vp, self._pool_sh)
-            new_pools.append((kp, vp))
+                if ks is not None:
+                    ks = jax.lax.with_sharding_constraint(ks, self._scale_sh)
+                    vs = jax.lax.with_sharding_constraint(vs, self._scale_sh)
+            new_pools.append(self._join(kp, vp, ks, vs))
             # gather the dense [S, T, H, D] view back out of the pool — a
-            # permutation copy, so the einsum sees bit-identical operands
-            # to the dense-row engine it replaced
-            ck = kp[bt].reshape(s, self.max_total, nh, hd)
-            cv = vp[bt].reshape(s, self.max_total, nh, hd)
+            # permutation copy for bf16 (the einsum sees bit-identical
+            # operands to the dense-row engine it replaced); quantized
+            # pools fuse the dequantizing multiply into the same gather
+            ck = self._gather_kv(kp, ks, bt).reshape(s, self.max_total,
+                                                     nh, hd)
+            cv = self._gather_kv(vp, vs, bt).reshape(s, self.max_total,
+                                                     nh, hd)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                 preferred_element_type=jnp.float32) * scale
             mask = (jnp.arange(self.max_total)[None, None, None, :]
@@ -477,12 +676,12 @@ class SlotPoolEngine:
         value = jnp.where(active, chosen, buf[rows, pos])
         buf = buf.at[rows, target].set(value)
         pos = jnp.where(active, pos + 1, pos)
-        return buf, pos, new_pools
+        return buf, pos, new_pools, logits
 
     def _segment_body(self, buf, pos, last, plen, temp, seeds, pools, bt):
         def step(carry, _):
             buf, pos, pools = carry
-            buf, pos, pools = self._micro_step(
+            buf, pos, pools, _ = self._micro_step(
                 buf, pos, last, plen, temp, seeds, pools, bt)
             return (buf, pos, pools), None
 
@@ -534,11 +733,48 @@ class SlotPoolEngine:
                 return n, ent[1]
         return 0, ()
 
+    def spill_pages_used(self, shard: int = 0) -> int:
+        """Host pages the spill tier currently holds on one dp shard
+        (bounded by ``spill_pages``)."""
+        return self._shards[shard].spill_used
+
+    def _demote(self, sh: _PageShard, toks: tuple[int, ...],
+                pgs: tuple[int, ...]) -> None:
+        """Demote one cold cache-only prefix entry into the shard's host
+        spill pool before LRU eviction frees its device pages: ONE raw
+        page gather (quantized codes + scale rows — the demote→promote
+        round trip is bit-exact) and ONE device→host fetch. The host LRU
+        evicts its own cold entries until the newcomer fits the
+        ``spill_pages`` bound; an entry larger than the whole bound is
+        simply dropped, as before the spill tier existed."""
+        n = len(pgs)
+        if not self.spill_pages or n > self.spill_pages:
+            return
+        key = hash(toks)
+        if key in sh.spill:
+            sh.spill.move_to_end(key)
+            return
+        while sh.spill_used + n > self.spill_pages and sh.spill:
+            _k, (_t, _p, m) = sh.spill.popitem(last=False)
+            sh.spill_used -= m
+        idx = jnp.asarray(list(pgs), jnp.int32)
+        payload = jax.device_get(
+            [tuple(self._page_export(b, idx)
+                   for b in self._split(entry) if b is not None)
+             for entry in self._pools])
+        sh.spill[key] = (toks, payload, n)
+        sh.spill_used += n
+        self.demotions += 1
+
     def _ensure_free(self, sh: _PageShard, need: int) -> None:
         """Evict LRU prefix entries until ``need`` pages are free. Pages a
-        live slot still pins survive eviction (ref stays > 0)."""
+        live slot still pins survive eviction (ref stays > 0). Entries
+        whose pages are ALL cache-only — the cold ones whose K/V would
+        otherwise be lost — demote into the host spill tier first."""
         while len(sh.free) < need and sh.prefix:
-            _key, (_toks, pgs) = sh.prefix.popitem(last=False)
+            _key, (toks, pgs) = sh.prefix.popitem(last=False)
+            if all(sh.ref[pg] == sh.cache_ref.get(pg, 0) for pg in pgs):
+                self._demote(sh, toks, pgs)
             for pg in pgs:
                 sh.cache_ref[pg] -= 1
                 if not sh.cache_ref[pg]:
@@ -553,6 +789,94 @@ class SlotPoolEngine:
                 f"free pages, {len(sh.free)} available after draining the "
                 f"prefix cache ({sh.span - 1} usable pages per shard; "
                 f"raise pages= or admit less concurrency)")
+
+    def _promote_spill(self, sh: _PageShard, prompt: list[int],
+                       n_hit: int, hit_pages: tuple[int, ...]
+                       ) -> tuple[int, tuple[int, ...]]:
+        """Promote the longest spilled prefix of ``prompt`` that beats the
+        device cache's hit: land its raw pages host→device into freshly
+        allocated pages (``_page_copy`` — bit-exact for quantized pools)
+        and republish them as cache-only prefix entries, so the caller's
+        plan shares them like any other hit. The entry is popped BEFORE
+        ``_ensure_free`` runs: eviction inside the allocation may demote
+        OTHER entries into the spill LRU, and the one mid-promotion must
+        not be re-evicted from under us. If the pool cannot host the
+        promotion even after draining the prefix cache, the entry goes
+        back and the plan degrades to recompute from the device hit —
+        admission never deadlocks on the spill tier."""
+        best_key = None
+        for n in range(len(prompt) // self.page, n_hit, -1):
+            toks = tuple(prompt[:n * self.page])
+            key = hash(toks)
+            ent = sh.spill.get(key)
+            if ent is not None and ent[0] == toks:
+                best_key = key
+                break
+        if best_key is None:
+            return n_hit, hit_pages
+        toks, payload, n = sh.spill.pop(best_key)
+        sh.spill_used -= n
+        try:
+            self._ensure_free(sh, n)
+        except RuntimeError:
+            sh.spill[best_key] = (toks, payload, n)
+            sh.spill_used += n
+            # the failed drain may have evicted the very entry backing
+            # hit_pages — re-resolve against the surviving cache instead
+            # of handing the caller freed page numbers
+            return self._lookup_prefix(sh.index, prompt)
+        pages = [sh.free.pop() for _ in range(n)]
+        self._land_pages(pages, payload)
+        self._publish_prefix(sh, list(toks), pages)
+        self.promoted_hits += 1
+        return n, tuple(pages)
+
+    def _land_pages(self, pages: list[int], payload: list) -> None:
+        """Land one spill payload (raw pages + scale rows per layer) into
+        freshly allocated device pages via the legal write path."""
+        dst = jnp.asarray(pages, jnp.int32)
+        src = jnp.arange(len(pages), dtype=jnp.int32)
+        # one stacked host->device transfer per buffer kind, not per layer
+        quant = len(payload[0]) == 4
+        kb = jnp.asarray(np.stack([lay[0] for lay in payload]))
+        vb = jnp.asarray(np.stack([lay[1] for lay in payload]))
+        ksb = jnp.asarray(np.stack([lay[2] for lay in payload])) \
+            if quant else None
+        vsb = jnp.asarray(np.stack([lay[3] for lay in payload])) \
+            if quant else None
+        new_pools = []
+        for li, entry in enumerate(self._pools):
+            kp, vp, ks, vs = self._split(entry)
+            if ks is None:
+                kp, _ = self._page_copy(kp, dst, src, kb[li])
+                vp, _ = self._page_copy(vp, dst, src, vb[li])
+            else:
+                kp, ks = self._page_copy(kp, dst, src, kb[li],
+                                         scale=ks, src_scale=ksb[li])
+                vp, vs = self._page_copy(vp, dst, src, vb[li],
+                                         scale=vs, src_scale=vsb[li])
+            new_pools.append(self._pin_entry(kp, vp, ks, vs))
+        self._pools = new_pools
+
+    def _publish_prefix(self, sh: _PageShard, toks: list[int],
+                        pages: list[int]) -> None:
+        """Register every page-aligned prefix of ``toks`` over freshly
+        landed ``pages`` as cache-only entries (ref == cache_ref), i.e.
+        evictable under pool pressure like any other prefix entry —
+        shared by the disaggregated import and spill-tier promotion."""
+        for m in range(1, len(pages) + 1):
+            ptoks = tuple(toks[:m * self.page])
+            key = hash(ptoks)
+            ent = sh.prefix.get(key)
+            if ent is not None:
+                if ent[0] == ptoks:
+                    sh.prefix.move_to_end(key)
+                continue        # hash collision: keep the resident entry
+            pgs = tuple(pages[:m])
+            sh.prefix[key] = (ptoks, pgs)
+            for pg in pgs:
+                sh.ref[pg] = sh.ref.get(pg, 0) + 1
+                sh.cache_ref[pg] = sh.cache_ref.get(pg, 0) + 1
 
     def _release_slot(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, None)
@@ -648,6 +972,13 @@ class SlotPoolEngine:
             self._release_slot(slot)
             blocks_needed = self.pages_for(plen, mt)
             n_hit, hit_pages = self._lookup_prefix(shard_i, prompt)
+            if sh.spill and n_hit * self.page < plen:
+                # a demoted prefix may cover more of the prompt than the
+                # device cache still does: promote it host→device and the
+                # hit below skips that share of prefill instead of
+                # recomputing it
+                n_hit, hit_pages = self._promote_spill(
+                    sh, prompt, n_hit, hit_pages)
             c = _pow2_at_most(plen)
             h = n_hit * self.page
             if h == plen:
@@ -696,10 +1027,13 @@ class SlotPoolEngine:
             return
         dst = jnp.asarray([d for d, _ in cow_pairs], jnp.int32)
         src = jnp.asarray([s for _, s in cow_pairs], jnp.int32)
-        self._pools = [
-            (self._pin(self._page_copy(kp, dst, src), self._pool_sh),
-             self._pin(self._page_copy(vp, dst, src), self._pool_sh))
-            for kp, vp in self._pools]
+        new_pools = []
+        for entry in self._pools:
+            kp, vp, ks, vs = self._split(entry)
+            kp, ks = self._page_copy(kp, dst, src, scale=ks)
+            vp, vs = self._page_copy(vp, dst, src, scale=vs)
+            new_pools.append(self._pin_entry(kp, vp, ks, vs))
+        self._pools = new_pools
 
     def _admit_group(self, c: int, h: int, group: list[dict]
                      ) -> dict[int, int]:
@@ -723,8 +1057,13 @@ class SlotPoolEngine:
             blk_np = np.array([pl["pages"][:h // self.page] for pl in group],
                               np.int32)
             blk = jnp.asarray(blk_np)
-            seed_k = jnp.stack([kp[blk] for kp, _ in self._pools])
-            seed_v = jnp.stack([vp[blk] for _, vp in self._pools])
+            # seed through the dequantizing gather: the chunk pass then
+            # attends over exactly the K/V the segment jit would see
+            parts = [self._split(e) for e in self._pools]
+            seed_k = jnp.stack([self._gather_kv(kp, ks, blk)
+                                for kp, _vp, ks, _vs in parts])
+            seed_v = jnp.stack([self._gather_kv(vp, vs, blk)
+                                for _kp, vp, _ks, vs in parts])
             scratch_k = scratch_k.at[:, :, :h].set(
                 seed_k.reshape(cfg.n_layers, k, h, nh, hd))
             scratch_v = scratch_v.at[:, :, :h].set(
@@ -747,17 +1086,16 @@ class SlotPoolEngine:
         off_np = np.tile((hpos % self.page).astype(np.int32), k)
         pg_j, off_j = jnp.asarray(pg_np), jnp.asarray(off_np)
         new_pools = []
-        for l, (kp, vp) in enumerate(self._pools):
+        for l, entry in enumerate(self._pools):
+            kp, vp, ks, vs = self._split(entry)
             kv = chunk_k[l][:, h:c].reshape(k * w, nh, hd)
             vv = chunk_v[l][:, h:c].reshape(k * w, nh, hd)
             # re-pin after the host-side scatter: admission writes arrive
             # from the (tp-only) scratch layout, and the segment jit's
             # donated inputs must keep the canonical dp×tp placement
-            new_pools.append(
-                (self._pin(self._page_write(kp, pg_j, off_j, kv),
-                           self._pool_sh),
-                 self._pin(self._page_write(vp, pg_j, off_j, vv),
-                           self._pool_sh)))
+            kp, ks = self._page_write(kp, pg_j, off_j, kv, ks)
+            vp, vs = self._page_write(vp, pg_j, off_j, vv, vs)
+            new_pools.append(self._pin_entry(kp, vp, ks, vs))
         self._pools = new_pools
 
         rows_j = jnp.asarray(self._prompt_rows(group))
@@ -862,14 +1200,20 @@ class SlotPoolEngine:
         the slot's position has passed ``n_pages * page``, so the write
         frontier is strictly above every exported position. Returns one
         ``(k_pages, v_pages)`` pair per layer, each ``[n, page, H, D]`` —
-        page lists, never a dense ``[T]`` row copy."""
+        page lists, never a dense ``[T]`` row copy. Quantized pools
+        export DEQUANTIZED model-dtype pages (the fused gather), so the
+        handoff payload is layout-agnostic: a bf16 decode worker can
+        import a quantized prefill worker's pages and vice versa (the
+        quantized importer re-quantizes on land)."""
         pages = self._slot_pages.get(int(slot), [])
         if n_pages > len(pages):
             raise ValueError(
                 f"slot {slot} holds {len(pages)} pages, cannot export "
                 f"{n_pages}")
         idx = jnp.asarray(pages[:n_pages], jnp.int32)
-        return [(kp[idx], vp[idx]) for kp, vp in self._pools]
+        parts = [self._split(e) for e in self._pools]
+        return [(self._gather_kv(kp, ks, idx), self._gather_kv(vp, vs, idx))
+                for kp, vp, ks, vs in parts]
 
     def import_prefix(self, tokens: Sequence[int], layers: Any,
                       shard: int = 0) -> int:
@@ -905,25 +1249,16 @@ class SlotPoolEngine:
         pages = [sh.free.pop() for _ in range(n)]
         dst = jnp.asarray(pages, jnp.int32)
         src = jnp.arange(n, dtype=jnp.int32)
-        self._pools = [
-            (self._pin(self._page_copy(kp, dst, src, src_pool=lk),
-                       self._pool_sh),
-             self._pin(self._page_copy(vp, dst, src, src_pool=lv),
-                       self._pool_sh))
-            for (kp, vp), (lk, lv) in zip(self._pools, layers)]
-        for m in range(1, n + 1):
-            ptoks = tuple(toks[:m * self.page])
-            key = hash(ptoks)
-            ent = sh.prefix.get(key)
-            if ent is not None:
-                if ent[0] == ptoks:
-                    sh.prefix.move_to_end(key)
-                continue        # hash collision: keep the resident entry
-            pgs = tuple(pages[:m])
-            sh.prefix[key] = (ptoks, pgs)
-            for pg in pgs:
-                sh.ref[pg] = sh.ref.get(pg, 0) + 1
-                sh.cache_ref[pg] = sh.cache_ref.get(pg, 0) + 1
+        new_pools = []
+        for entry, (lk, lv) in zip(self._pools, layers):
+            kp, vp, ks, vs = self._split(entry)
+            # a quantized pool re-quantizes the (model-dtype) payload on
+            # land inside _page_copy; bf16 lands it verbatim
+            kp, ks = self._page_copy(kp, dst, src, src_pool=lk, scale=ks)
+            vp, vs = self._page_copy(vp, dst, src, src_pool=lv, scale=vs)
+            new_pools.append(self._pin_entry(kp, vp, ks, vs))
+        self._pools = new_pools
+        self._publish_prefix(sh, toks, pages)
         return n
 
     def run_segment(self) -> None:
@@ -939,3 +1274,17 @@ class SlotPoolEngine:
         per-scalar fetches (each scalar fetch is a transport round trip)."""
         buf, pos = jax.device_get((self._buf, self._pos))
         return np.asarray(buf), np.asarray(pos)
+
+    def debug_logits(self) -> np.ndarray:
+        """Test-only hook behind the two-tier bit-exactness policy: one
+        NON-mutating micro-step over the live state, returning the
+        next-token logits ``[S, vocab]`` every slot would sample from.
+        Routes through the same ``_page_write`` + fused dequantizing
+        ``_gather_kv`` as the segment jit, so a quantized engine's
+        declared ``logit_tolerance`` is asserted against exactly what
+        decode sees — the engine never exposes logits otherwise. Eager
+        (unjitted) on purpose: no donation, so the live buffers survive."""
+        _, _, _, logits = self._micro_step(
+            self._buf, self._pos, self._last, self._plen, self._temp,
+            self._seeds, self._pools, self._bt)
+        return np.asarray(jax.device_get(logits))

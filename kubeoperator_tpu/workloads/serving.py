@@ -84,6 +84,7 @@ def plan_bucket(lens: Sequence[int], max_tokens: Sequence[int],
 #: drained in the same tick re-route deterministically instead of in
 #: container order
 _SEQ = itertools.count()
+_SEQ_LOCK = threading.Lock()
 
 
 @dataclass
@@ -95,7 +96,7 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: list[int] | None = None
     error: Exception | None = None
-    submitted_at: float = field(default_factory=time.monotonic)
+    submitted_at: float = -1.0
     # request identity for serve traces (``ko trace --serve <id>``); the
     # trace handle is a telemetry.serve_trace.RequestTrace when the
     # batcher was built with a tracer, else None (tracing off)
@@ -114,7 +115,19 @@ class _Pending:
     # first-token latency stamped by the worker at the TTFT observation,
     # so the gateway can aggregate TTFT per tenant without new plumbing
     ttft_s: float | None = None
-    seq: int = field(default_factory=lambda: next(_SEQ))
+    seq: int = -1
+
+    def __post_init__(self) -> None:
+        # both stamps under one lock: independently-evaluated field
+        # factories let two racing submits interleave the clock read and
+        # the counter bump, producing inverted (submitted_at, seq) pairs
+        # that make the requeue sort disagree with admission order
+        if self.seq < 0:
+            with _SEQ_LOCK:
+                stamp = time.monotonic()
+                if self.submitted_at < 0:
+                    self.submitted_at = stamp
+                self.seq = next(_SEQ)
 
 
 class BatcherStats:
@@ -191,6 +204,18 @@ class BatcherStats:
     def prefix_hit(self, n: int = 1) -> None:
         self._m["prefix_hits"].inc(n)
 
+    def kv_spill_pages(self, pages: int, shard: int | str = 0) -> None:
+        """KV pages parked in one dp shard's host-RAM spill tier."""
+        self._m["kv_spill_pages"].set(pages, shard=str(shard))
+
+    def kv_demotion(self, n: int = 1) -> None:
+        """Prefix entries demoted from HBM into the host spill tier."""
+        self._m["kv_demotions"].inc(n)
+
+    def kv_promoted_hit(self, n: int = 1) -> None:
+        """Admissions served by promoting a demoted prefix host->device."""
+        self._m["kv_promoted_hits"].inc(n)
+
     def requeued(self, reason: str, n: int = 1) -> None:
         """In-flight requests snapshotted off drained slots and pushed
         back to the queue head instead of dropped (reason: drain |
@@ -265,6 +290,12 @@ class BatcherStats:
             "kv_pages_used": int(sum(
                 self._m["kv_pages_used"].samples().values())),
             "prefix_hits_total": int(self._m["prefix_hits"].value()),
+            # summed over dp shards: cluster-wide host-tier footprint
+            "kv_spill_pages": int(sum(
+                self._m["kv_spill_pages"].samples().values())),
+            "kv_demotions_total": int(self._m["kv_demotions"].value()),
+            "kv_promoted_hits_total": int(
+                self._m["kv_promoted_hits"].value()),
             # summed over reasons: total in-flight requeues (drain/revoke)
             "requests_requeued_total": int(sum(
                 self._m["requeued"].samples().values())),
@@ -495,6 +526,8 @@ class ContinuousBatcher:
         self._shard_slots = engine.slots // self._dp
         self._paged = hasattr(engine, "pages_for")
         self._prefix_hits_seen = 0
+        self._demotions_seen = 0
+        self._promoted_hits_seen = 0
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ko-serve-continuous")
         self._worker.start()
@@ -558,6 +591,20 @@ class ContinuousBatcher:
             self.stats.prefix_hit(hits - self._prefix_hits_seen)
             # ko: lint-ok[KO201,KO301] single-writer: only the worker thread reads the engine counter
             self._prefix_hits_seen = hits
+        if getattr(self.engine, "spill_pages", 0):
+            for shard in range(self._dp):
+                self.stats.kv_spill_pages(
+                    self.engine.spill_pages_used(shard), shard=shard)
+        demos = int(getattr(self.engine, "demotions", 0))
+        if demos > self._demotions_seen:
+            self.stats.kv_demotion(demos - self._demotions_seen)
+            # ko: lint-ok[KO201,KO301] single-writer: only the worker thread reads the engine counter
+            self._demotions_seen = demos
+        promos = int(getattr(self.engine, "promoted_hits", 0))
+        if promos > self._promoted_hits_seen:
+            self.stats.kv_promoted_hit(promos - self._promoted_hits_seen)
+            # ko: lint-ok[KO201,KO301] single-writer: only the worker thread reads the engine counter
+            self._promoted_hits_seen = promos
 
     def _admit_wave_locked(self) -> list[tuple[int, _Pending]]:
         """Pick the next admissions (caller holds the lock). Dense

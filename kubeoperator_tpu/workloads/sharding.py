@@ -272,10 +272,12 @@ def shard_params_decode_tp(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map_with_path(place, params)
 
 
-def shard_page_pool(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+def shard_page_pool(mesh: Mesh) -> tuple[NamedSharding, NamedSharding,
+                                         NamedSharding]:
     """Placement for the serving engine's paged KV layout
     (``decode_loop.SlotPoolEngine`` round 8): per-layer page pools
-    ``[P, page, H, D]`` and per-slot block tables ``[S, T/page]``.
+    ``[P, page, H, D]``, per-slot block tables ``[S, T/page]``, and
+    (round 19, quantized pools) per-page scale buffers ``[P, page, H]``.
 
     The page axis P splits over ``dp`` exactly like the dense slot rows it
     replaces — the host allocator hands each dp group a contiguous range
@@ -283,13 +285,18 @@ def shard_page_pool(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
     owns and no cross-dp gather exists. Attention heads split over ``tp``
     as before. Block tables replicate: they are tiny int32 index arrays
     every shard needs to gather its pages, and replication keeps the
-    segment jit's gather local. Missing axes degrade to None, so the same
-    call works on any dp×tp mesh. Returns (pool_sharding, table_sharding).
+    segment jit's gather local. Scale buffers follow their pool exactly
+    (pages over dp, heads over tp, minus the head dim the scale
+    amortizes over) so the fused dequantizing gather multiplies two
+    co-resident shards — no relayout between a page and its scales.
+    Missing axes degrade to None, so the same call works on any dp×tp
+    mesh. Returns (pool_sharding, table_sharding, scale_sharding).
     """
     dp_ax = "dp" if "dp" in mesh.axis_names else None
     tp_ax = "tp" if "tp" in mesh.axis_names else None
     return (NamedSharding(mesh, P(dp_ax, None, tp_ax, None)),
-            NamedSharding(mesh, P(None, None)))
+            NamedSharding(mesh, P(None, None)),
+            NamedSharding(mesh, P(dp_ax, None, tp_ax)))
 
 
 # ---------------------------------------------------------------------------

@@ -188,6 +188,7 @@ class Trainer:
         self.batch_shd = batch_sharding(self.mesh, self.spec)
         self._step_fn: Callable | None = None
         self._init_fn: Callable | None = None
+        self._multi_fns: dict[tuple[int, bool], Callable] = {}
         self._compile_cache = compile_cache
         self.aot = None
 
@@ -263,8 +264,14 @@ class Trainer:
         the stem conv, billing data synthesis to the model. ``fresh_data``
         regenerates per step (for loss-curve realism, not for MFU).
 
-        Returns ``fn(state, key) -> (state, losses[k])``.
+        Returns ``fn(state, key) -> (state, losses[k])``. Memoized per
+        ``(k, fresh_data)``: a scanned trainer is an expensive compile,
+        and repeated ``measure()`` calls at one ``steps_per_call`` must
+        reuse it rather than re-jit a fresh wrapper each time.
         """
+        memo = self._multi_fns.get((k, fresh_data))
+        if memo is not None:
+            return memo
         cfg = self.cfg
         shape = (cfg.batch_size, cfg.image_size, cfg.image_size, 3)
 
@@ -290,7 +297,9 @@ class Trainer:
             (state, key), losses = jax.lax.scan(body, (state, key), None, length=k)
             return state, losses
 
-        return jax.jit(multi, donate_argnums=(0,))
+        fn = jax.jit(multi, donate_argnums=(0,))
+        self._multi_fns[(k, fresh_data)] = fn
+        return fn
 
     # -- data --------------------------------------------------------------
     def synthetic_batch(self, batch: int | None = None, seed: int = 0):

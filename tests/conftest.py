@@ -49,6 +49,42 @@ def _flight_dumps_to_tmp(tmp_path, monkeypatch):
     monkeypatch.setenv("KO_FLIGHT_DIR", str(tmp_path))
 
 
+_SHARED_AOT_CACHE = None
+
+
+@pytest.fixture(autouse=True)
+def _shared_segment_cache(monkeypatch, tmp_path_factory):
+    """Route every bare SlotPoolEngine through one session-shared AOT
+    compile-artifact cache: tests reusing an engine shape deserialize
+    the segment executable instead of recompiling it, which cuts minutes
+    of duplicate XLA compiles off the tier-1 wall clock. Safe because
+    bit-exactness through the cache is pinned by tests/test_aot.py, the
+    cache key carries the engine's closure constants (segment, page,
+    kv_dtype, model config), and every engine-building test module
+    initializes the same tiny model from the same seed (weights are
+    baked into the executable, so differing params must never share an
+    artifact). Engines constructed with an explicit ``compile_cache``
+    (tests/test_aot.py's hit/miss assertions) are left untouched, and so
+    are engines built under an active compile-count guard — those tests
+    are *observing* real trace events, which a cache hit would absorb."""
+    from kubeoperator_tpu.analysis.compile_guard import active_guard
+    from kubeoperator_tpu.aot import CompileCache
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+
+    global _SHARED_AOT_CACHE
+    if _SHARED_AOT_CACHE is None:
+        _SHARED_AOT_CACHE = CompileCache(
+            str(tmp_path_factory.mktemp("t1_aot")))
+    orig = SlotPoolEngine.__init__
+
+    def patched(self, *a, **kw):
+        if active_guard() is None:
+            kw.setdefault("compile_cache", _SHARED_AOT_CACHE)
+        orig(self, *a, **kw)
+
+    monkeypatch.setattr(SlotPoolEngine, "__init__", patched)
+
+
 @pytest.fixture
 def fake_executor():
     return FakeExecutor()

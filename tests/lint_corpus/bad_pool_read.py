@@ -1,0 +1,27 @@
+"""Corpus: quantized paged-KV pool read bypassing the fused gather (KO122)."""
+import jax.numpy as jnp
+
+
+class QuantizedPagedPool:
+    def __init__(self, kv_pool, kv_scale, bt):
+        self._kv_pool = kv_pool
+        self._kv_scale = kv_scale
+        self._bt = bt
+
+    def _page_write(self, pool, pages, offsets, vals):
+        return pool.at[pages, offsets].set(vals)
+
+    def _gather_kv(self, pool, scale, idx):
+        if scale is None:
+            return pool[idx]
+        return (pool[idx].astype(jnp.float32)
+                * scale[idx][..., None]).astype(jnp.bfloat16)
+
+    def attend(self, slot):
+        # KO122: raw gather of int8 codes — skips the per-page dequantize
+        k = self._kv_pool[self._bt[slot]]
+        return jnp.einsum("thd,hd->th", k.astype(jnp.float32), k[0])
+
+    def attend_routed(self, slot):
+        bt = self._bt[slot]
+        return self._gather_kv(self._kv_pool, self._kv_scale, bt)

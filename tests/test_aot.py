@@ -94,9 +94,28 @@ def test_cache_key_rolls_on_every_input(tmp_path):
         cache.key_for("f", args, mesh_spec=MESH_2x4),          # mesh
         cache.key_for("f", args, donate=(0,)),                 # donation
         cache.key_for("f", args, static=(0,)),                 # static args
+        cache.key_for("f", args, closure=(4, "int8")),         # closure
     ]
     fps = {base.fingerprint()} | {k.fingerprint() for k in rolled}
     assert len(fps) == 1 + len(rolled), "every key field must roll the key"
+
+
+def test_cache_key_rolls_on_closure_constants(tmp_path):
+    """Two engines with identical example-arg shapes but different
+    closure constants (segment length, kv dtype, model config) bake
+    different executables — the key must keep them apart, or a segment=2
+    engine can deserialize a segment=4 artifact and silently advance
+    rows at the wrong cadence."""
+    cache = CompileCache(str(tmp_path))
+    args = (jnp.zeros((4, 24), jnp.int32),)
+    seg2 = cache.key_for("_segment_body", args, closure=(2, 8, "bf16"))
+    seg4 = cache.key_for("_segment_body", args, closure=(4, 8, "bf16"))
+    int8 = cache.key_for("_segment_body", args, closure=(2, 8, "int8"))
+    assert len({seg2.fingerprint(), seg4.fingerprint(),
+                int8.fingerprint()}) == 3
+    # same closure -> same key: the cache still hits across bring-ups
+    again = cache.key_for("_segment_body", args, closure=(2, 8, "bf16"))
+    assert again.fingerprint() == seg2.fingerprint()
 
 
 def test_cache_key_folds_ko140_baseline(tmp_path):

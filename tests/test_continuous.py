@@ -40,6 +40,8 @@ def params():
                                jnp.zeros((2, 8), jnp.int32))["params"])
 
 
+
+
 def solo(params, prompt, max_tokens, temperature=0.0, **kw):
     out = generate(CFG, params, jnp.asarray([prompt], jnp.int32), max_tokens,
                    temperature=temperature, **kw)
@@ -949,3 +951,339 @@ def test_paged_cost_model_equal_hbm_win():
     assert out["concurrency_gain"] >= 1.3, out
     assert out["paged"]["mean_ttft_s"] < out["dense"]["mean_ttft_s"], out
     assert out["paged"]["prefix_hits"] >= 1, out
+
+
+# ---------------------------------------------------------------------------
+# quantized KV + host-RAM spill tier (round 19)
+# ---------------------------------------------------------------------------
+
+PROMPT_B = [(3 * i + 5) % 60 + 1 for i in range(16)]
+PROMPT_C = [(3 * i + 7) % 60 + 1 for i in range(20)]
+PROMPT_D = [(11 * i + 13) % 60 + 1 for i in range(20)]
+
+
+def _page_accounting_exact(eng):
+    """Exactness oracle for the allocator: recompute ref/cache_ref from
+    first principles (slot holdings + prefix entries) and require the
+    incremental bookkeeping to match — any leak or double-free shows up
+    as a counter drift or a page neither free nor referenced."""
+    for sh in eng._shards:
+        held: dict[int, int] = {}
+        for slot, pages in eng._slot_pages.items():
+            if slot // eng._shard_slots != sh.index:
+                continue
+            for pg in pages:
+                held[pg] = held.get(pg, 0) + 1
+        cache: dict[int, int] = {}
+        for _toks, pgs in sh.prefix.values():
+            for pg in pgs:
+                cache[pg] = cache.get(pg, 0) + 1
+        assert cache == sh.cache_ref, "cache_ref drifted from prefix entries"
+        want = {pg: held.get(pg, 0) + cache.get(pg, 0)
+                for pg in set(held) | set(cache)}
+        assert want == sh.ref, "ref drifted from slot+cache holdings"
+        assert sorted(sh.free + list(sh.ref)) == list(
+            range(sh.base + 1, sh.base + sh.span)), (
+            "pages leaked or double-freed")
+        assert sh.spill_used == sum(n for _t, _p, n in sh.spill.values())
+        assert sh.spill_used <= eng.spill_pages
+
+
+def test_validate_kv_dtype_and_spill_rejections():
+    """Satellite 6: quantized-layout misfits fail fast with actionable
+    messages — unknown dtype, scale-row amortization, spill bound."""
+    with pytest.raises(ValueError, match=r"kv_dtype \('int4'\) must be "
+                                         r"one of"):
+        validate_page_pool(page=8, pages=8, max_seq_len=24,
+                           kv_dtype="int4")
+    with pytest.raises(ValueError, match=r"page size \(1\) must be >= 2 "
+                                         r"for the quantized"):
+        validate_page_pool(page=1, pages=8, max_seq_len=24,
+                           kv_dtype="int8")
+    with pytest.raises(ValueError, match=r"spill_pages \(-1\) must be "
+                                         r">= 0"):
+        validate_page_pool(page=8, pages=8, max_seq_len=24,
+                           spill_pages=-1)
+    # the valid quantized layout passes
+    validate_page_pool(page=8, pages=8, max_seq_len=24, kv_dtype="int8",
+                       spill_pages=4)
+
+
+def test_two_tier_signature_policy_declared(params):
+    """The bit-exactness policy is explicit engine state: bf16 pools
+    declare tolerance 0.0 (the bit-identical tier, pinned by every
+    pre-round-19 test above), quantized pools a finite logit bound."""
+    from kubeoperator_tpu.workloads.decode_loop import LOGIT_TOLERANCE
+    bf = SlotPoolEngine(CFG, params, slots=2, segment=2)
+    assert bf.kv_dtype == "bf16" and bf.logit_tolerance == 0.0
+    q = SlotPoolEngine(CFG, params, slots=2, segment=2, kv_dtype="int8")
+    assert q.logit_tolerance == LOGIT_TOLERANCE["int8"] > 0.0
+    # quantized pools really are 1-byte elements with f32 scale buffers
+    kp, vp, ks, vs = q._pools[0]
+    assert kp.dtype == jnp.int8 and vp.dtype == jnp.int8
+    assert ks.dtype == jnp.float32 and ks.shape == kp.shape[:3]
+
+
+def test_int8_signature_within_tolerance_solo(params):
+    """Round-19 signature test, quantized tier: an int8 engine driven in
+    lockstep with a bf16 reference — including mid-flight admission and
+    a full-prompt prefix hit (copy-on-write) — keeps every slot's
+    next-token logits within the declared tolerance at every segment
+    boundary, and (this model) greedy tokens still match solo."""
+    ref = SlotPoolEngine(CFG, params, slots=4, segment=2)
+    q = SlotPoolEngine(CFG, params, slots=4, segment=2, kv_dtype="int8")
+    wave1 = [(0, PRE + [7, 7], 4, 0.0, 0), (1, [5, 5, 9, 2], 8, 0.0, 1)]
+    for eng in (ref, q):
+        eng.admit(wave1)
+    for step in range(3):
+        delta = np.abs(ref.debug_logits() - q.debug_logits()).max()
+        assert delta <= q.logit_tolerance, (step, delta)
+        for eng in (ref, q):
+            eng.run_segment()
+    # mid-flight admission with a full-prompt hit -> CoW boundary page
+    for eng in (ref, q):
+        eng.admit([(2, PRE, 6, 0.0, 2)])
+    assert q.cow_copies >= 1 and q.prefix_hits >= 1
+    # debug_logits is deliberately eager (one full forward per call), so
+    # sample the boundary right after the CoW admission, mid-decode, and
+    # at the end rather than every segment
+    for step in range(12):
+        if step in (0, 5, 11):
+            delta = np.abs(ref.debug_logits() - q.debug_logits()).max()
+            assert delta <= q.logit_tolerance, delta
+        for eng in (ref, q):
+            eng.run_segment()
+    buf, _ = q.poll()
+    assert buf[0][:22].tolist() == solo(params, PRE + [7, 7], 4)
+    assert buf[2][:22].tolist() == solo(params, PRE, 6)
+    _page_accounting_exact(q)
+
+
+@needs_8dev
+def test_int8_signature_within_tolerance_sharded(params):
+    """The same quantized-tier signature on the 2×4 dp×tp mesh: int8
+    pools + f32 scale shards (pages over dp, heads over tp) stay within
+    the declared logit tolerance of the sharded bf16 reference through
+    mid-flight admission and a prefix-CoW hit on each dp shard."""
+    ref = SlotPoolEngine(CFG, params, slots=4, segment=2,
+                         mesh_spec=MESH_2x4)
+    q = SlotPoolEngine(CFG, params, slots=4, segment=2,
+                       mesh_spec=MESH_2x4, kv_dtype="int8")
+    for eng in (ref, q):
+        eng.admit([(0, PRE + [7, 7], 4, 0.0, 0), (2, PROMPT_B, 6, 0.0, 1)])
+    for _ in range(2):
+        for eng in (ref, q):
+            eng.run_segment()
+        delta = np.abs(ref.debug_logits() - q.debug_logits()).max()
+        assert delta <= q.logit_tolerance, delta
+    # mid-flight: full-prompt hits on both shards (CoW boundary pages)
+    for eng in (ref, q):
+        eng.admit([(1, PRE, 5, 0.0, 2), (3, PROMPT_B, 5, 0.0, 3)])
+    assert q.cow_copies >= 2
+    # eager debug_logits on the mesh pays a full sharded forward per
+    # call: sample post-CoW, mid-decode, and final boundaries only
+    for step in range(11):
+        for eng in (ref, q):
+            eng.run_segment()
+        if step in (0, 5, 10):
+            delta = np.abs(ref.debug_logits() - q.debug_logits()).max()
+            assert delta <= q.logit_tolerance, delta
+    buf, _ = q.poll()
+    assert buf[0][:22].tolist() == solo(params, PRE + [7, 7], 4)
+    assert buf[1][:21].tolist() == solo(params, PRE, 5)
+    assert buf[3][:21].tolist() == solo(params, PROMPT_B, 5)
+    _page_accounting_exact(q)
+
+
+def _drain_slots(eng, slots, total):
+    track = {s: (0, t - 1) for s, t in zip(slots, total)}
+    return drain(eng, track)
+
+
+def test_spill_demote_then_evict_host(params):
+    """Spill edge 1: the host LRU is bounded — demoting past the bound
+    evicts the oldest HOST entry, and a later admission whose prefix was
+    host-evicted recomputes correctly (no promotion, no stale pages)."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, page=8, pages=9,
+                         spill_pages=2)
+    eng.admit([(0, PRE, 4, 0.0, 0)])
+    _drain_slots(eng, [0], [20])
+    eng.release([0])                    # A cached: 2 pages, 2 entries
+    for slot, prompt in ((1, PROMPT_B), (2, PROMPT_C)):
+        eng.admit([(slot, prompt, 4, 0.0, 0)])
+        eng.release([slot])
+    # pressure: evicting PRE's 1-page then 2-page entries; the 2-page
+    # demotion must push the 1-page entry out of the bounded host tier
+    eng.admit([(3, PROMPT_D, 4, 0.0, 0)])
+    assert eng.demotions == 2
+    assert eng.spill_pages_used() == 2          # only the 2-page entry fits
+    sh = eng._shards[0]
+    assert [n for _t, _p, n in sh.spill.values()] == [2]
+    _page_accounting_exact(eng)
+    # a prompt whose only matching prefix was host-evicted: clean miss
+    eng.release([3])
+    hits0, promoted0 = eng.prefix_hits, eng.promoted_hits
+    eng.admit([(0, PRE[:8] + [9, 9, 9], 4, 0.0, 0)])
+    assert eng.prefix_hits == hits0 and eng.promoted_hits == promoted0
+    buf = _drain_slots(eng, [0], [15])
+    assert buf[0][:15].tolist() == solo(params, PRE[:8] + [9, 9, 9], 4)
+    _page_accounting_exact(eng)
+
+
+def test_spill_promote_while_demoting(params):
+    """Spill edge 2: a promotion whose allocation must itself evict (and
+    demote) OTHER prefix entries — the entry mid-promotion is popped
+    first, so the demotion wave cannot re-evict it from under us."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, page=8, pages=9,
+                         kv_dtype="int8", spill_pages=4)
+    eng.admit([(0, PRE, 4, 0.0, 0)])
+    _drain_slots(eng, [0], [20])
+    eng.release([0])
+    eng.admit([(1, PROMPT_B, 4, 0.0, 0)])
+    eng.release([1])
+    eng.admit([(2, PROMPT_C, 4, 0.0, 0)])
+    eng.release([2])
+    eng.admit([(3, PROMPT_D, 4, 0.0, 0)])   # demotes PRE
+    assert eng.demotions == 2 and eng.spill_pages_used() == 3
+    # promoting PRE's 2-page entry needs 2 free pages -> evicts PROMPT_B's
+    # entries, demoting them into the spill LRU mid-promotion
+    eng.admit([(0, PRE + [7, 7], 4, 0.0, 0)])
+    assert eng.promoted_hits == 1
+    assert eng.demotions == 4                   # + PROMPT_B's two entries
+    assert eng.spill_pages_used() == 4          # PRE n1 + B n1 + B n2
+    _page_accounting_exact(eng)
+    buf = _drain_slots(eng, [0], [22])
+    assert buf[0][:22].tolist() == solo(params, PRE + [7, 7], 4)
+    _page_accounting_exact(eng)
+
+
+def test_spill_cow_on_promoted_page(params):
+    """Spill edge 3: a full-prompt hit on a promoted entry copy-on-writes
+    the boundary page exactly like a device-cache hit — the promoted
+    shared copy stays pristine and tokens still match solo."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, page=8, pages=9,
+                         kv_dtype="int8", spill_pages=4)
+    eng.admit([(0, PRE, 4, 0.0, 0)])
+    _drain_slots(eng, [0], [20])
+    eng.release([0])
+    eng.admit([(1, PROMPT_B[:11], 4, 0.0, 0)])
+    eng.release([1])
+    eng.admit([(2, PROMPT_C, 4, 0.0, 0)])
+    eng.release([2])
+    eng.admit([(1, PROMPT_D, 4, 0.0, 0)])       # 3 pages, stays live
+    eng.admit([(3, PROMPT_B[:8] + [3, 3, 3], 4, 0.0, 0)])  # demotes PRE
+    assert eng.demotions == 2 and eng.spill_pages_used() == 3
+    eng.release([1, 3])
+    cow0 = eng.cow_copies
+    eng.admit([(0, PRE, 4, 0.0, 0)])            # full-prompt promoted hit
+    assert eng.promoted_hits == 1 and eng.cow_copies == cow0 + 1
+    _page_accounting_exact(eng)
+    buf = _drain_slots(eng, [0], [20])
+    assert buf[0][:20].tolist() == solo(params, PRE, 4)
+    _page_accounting_exact(eng)
+
+
+def test_spill_release_slot_with_host_side_prefix(params):
+    """Spill edge 4: release() of a slot whose prompt prefix (also)
+    lives host-side touches only device refcounts — the stale spill copy
+    neither double-frees nor resurrects pages, and a failed promotion
+    (pool full of live slots) restores the entry and leaves accounting
+    exact instead of deadlocking admission."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, page=8, pages=9,
+                         spill_pages=4)
+    eng.admit([(0, PRE, 4, 0.0, 0)])
+    _drain_slots(eng, [0], [20])
+    eng.release([0])
+    eng.admit([(1, PROMPT_B, 4, 0.0, 0)])       # 3 pages, live
+    eng.admit([(3, PROMPT_C[:11], 4, 0.0, 0)])  # 2 pages, live
+    eng.admit([(2, PROMPT_D, 4, 0.0, 0)])   # demotes PRE
+    assert eng.demotions == 2 and eng.spill_pages_used() == 3
+    # pool now full of live slots: promoting PRE cannot fit and admission
+    # of one more request must fail loudly, restoring the spill entry
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        eng.admit([(0, PRE + [7, 7], 4, 0.0, 0)])
+    assert eng.promoted_hits == 0
+    assert eng.spill_pages_used() == 3          # entry restored intact
+    _page_accounting_exact(eng)
+    # free a shard's worth of live pages, promote for real this time
+    eng.release([1, 3])
+    eng.admit([(0, PRE + [7, 7], 4, 0.0, 0)])
+    assert eng.promoted_hits == 1
+    _page_accounting_exact(eng)
+    # slot 0's prefix now exists BOTH device-side (promoted) and as the
+    # stale 1-page host copy: releasing the slot must only return its
+    # own holdings
+    buf = _drain_slots(eng, [0], [22])
+    assert buf[0][:22].tolist() == solo(params, PRE + [7, 7], 4)
+    eng.release([0, 2])
+    _page_accounting_exact(eng)
+    # drain every cache entry: all usable pages must come back exactly
+    sh = eng._shards[0]
+    eng._ensure_free(sh, sh.span - 1)
+    assert eng.free_pages() == sh.span - 1
+    _page_accounting_exact(eng)
+
+
+def test_batcher_spill_admission_deadlock_free(params):
+    """The batcher's page-based admission over a spill-enabled quantized
+    engine: overlapping shared-prefix requests all complete (promotion
+    keeps capacity invariant — promoted pages are cache-only, i.e. still
+    evictable) and greedy replies stay correct."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=4, page=8, pages=9,
+                         kv_dtype="int8", spill_pages=4)
+    cb = ContinuousBatcher(eng)
+    reqs = [(PRE + [7, 7], 4), (PROMPT_B, 4), (PROMPT_C, 4),
+            (PRE + [9, 9], 4), (PROMPT_D, 4), (PRE[:8] + [4, 4], 6)]
+    results = [None] * len(reqs)
+    errors = []
+
+    def run(i, prompt, mt):
+        try:
+            results[i] = cb.submit(prompt, mt)
+        except Exception as e:              # pragma: no cover - fail loud
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i, p, mt))
+               for i, (p, mt) in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors
+    for i, (prompt, mt) in enumerate(reqs):
+        assert results[i] == solo(params, prompt, mt), f"request {i}"
+    _page_accounting_exact(eng)
+
+
+def test_quantized_cost_model_guard():
+    """Round-19 acceptance guard on the injected-latency cost model: at
+    EQUAL KV HBM, quantizing the pool to int8 doubles the page count and
+    must buy >= 1.5x peak admitted concurrency (the extra pages admit
+    more rows before backpressure); and re-admitting a prompt whose
+    prefix was demoted to the host spill tier must beat recomputing the
+    prefill (the promotion gather is cheap DMA, not FLOPs)."""
+    bs = _bench_mod()
+    out = bs.bench_quantized(requests=48, dense_slots=4, segment=8,
+                             page=16, step_s=0.0004, dispatch_s=0.001,
+                             prefill_s=0.01, stagger_s=0.002)
+    assert out["concurrency_gain"] >= 1.5, out
+    sp = out["spill"]
+    assert sp["demoted_hit_ttft_s"] < sp["recompute_ttft_s"], out
+    assert sp["promoted_hits"] >= 1 and sp["demotions"] >= 1, out
+
+
+def test_fake_engine_shares_spill_protocol(params):
+    """The fake paged engine must keep mirroring the real engine's spill
+    tier surface (kv_dtype/spill_pages config echo, per-shard host-pool
+    occupancy, demotion/promotion counters) or the quantized microbench
+    and the batcher's spill metrics stop modeling production."""
+    bs = _bench_mod()
+    fake = bs.FakePagedEngine(slots=2, segment=2, max_total=24, page=8,
+                              kv_dtype="int8", spill_pages=4,
+                              step_s=0.0, dispatch_s=0.0, prefill_s=0.0)
+    real = SlotPoolEngine(CFG, params, slots=2, segment=2,
+                          kv_dtype="int8", spill_pages=4)
+    for eng in (fake, real):
+        assert eng.kv_dtype == "int8" and eng.spill_pages == 4
+        assert eng.spill_pages_used(0) == 0
+        assert eng.demotions == 0 and eng.promoted_hits == 0

@@ -138,3 +138,20 @@ def test_serve_restores_llm_checkpoint(tmp_path, capsys):
         http.server.HTTPServer.__init__ = orig_init
     logged = capsys.readouterr().out
     assert '"weights": "checkpoint step' in logged
+
+
+def test_serve_parser_kv_dtype_and_spill_flags():
+    """--kv-dtype/--spill-pages parse on `ko-train serve` and reach the
+    continuous engine's constructor signature; bad dtypes die in argparse
+    before any device work."""
+    args = jobs.build_parser().parse_args(
+        ["serve", "--engine", "continuous", "--kv-dtype", "int8",
+         "--spill-pages", "32"])
+    assert args.kv_dtype == "int8" and args.spill_pages == 32
+    # defaults: exact bf16 pools, spill tier off
+    dflt = jobs.build_parser().parse_args(["serve"])
+    assert dflt.kv_dtype == "bf16" and dflt.spill_pages == 0
+    import pytest
+
+    with pytest.raises(SystemExit):
+        jobs.build_parser().parse_args(["serve", "--kv-dtype", "fp64"])
