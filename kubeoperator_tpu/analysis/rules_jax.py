@@ -726,6 +726,137 @@ class PagedPoolReadBypass(Rule):
 
 
 @register
+class RewindBypass(Rule):
+    """KO123 — in an engine that speculative-decodes over a paged pool
+    (it defines the designated rollback helper ``_rewind``), rejection
+    rollback has exactly two legal moves: per-row ``pos`` rolls back
+    through ``_rewind``, and over-speculated tail pages are reclaimed by
+    block-table truncation on the host admission/release paths. An
+    ad-hoc ``jnp.minimum`` clamp into a position vector, or a block-table
+    write anywhere else, can strand a row's position above KV its pages
+    no longer hold — the tokens that follow are silently wrong, and no
+    shape check can catch it."""
+
+    id = "KO123"
+    severity = "error"
+    title = "rewind discipline"
+    hint = ("roll positions back through the engine's _rewind(...) helper "
+            "and reclaim speculative tails by block-table truncation in "
+            "release/_plan_entries — never an inline pos clamp or a "
+            "stray block-table write")
+
+    _UPDATES = {"set", "add", "multiply", "divide", "min", "max", "apply"}
+    _ALLOWED = {"_rewind", "release", "_plan_entries", "_push_block_tables",
+                "__init__"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.has_jax:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                       and m.name == "_rewind" for m in cls.body):
+                continue
+            for node in ast.walk(cls):
+                fn = ctx.enclosing_function(node)
+                if fn is not None and getattr(fn, "name", "") \
+                        in self._ALLOWED:
+                    continue
+                # (a) block-table mutation outside the truncation paths
+                if isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, ast.Store):
+                    base = self._bt_base(node.value)
+                    if base is not None:
+                        yield self.finding(
+                            ctx, node,
+                            f"block-table '{base}' written outside the "
+                            f"designated truncation paths — speculative "
+                            f"tail pages are reclaimed ONLY by "
+                            f"release/_plan_entries truncation, any other "
+                            f"write desyncs table and allocator")
+                        continue
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in self._UPDATES:
+                    base = self._bt_at_base(node.func.value)
+                    if base is not None:
+                        yield self.finding(
+                            ctx, node,
+                            f".at[...].{node.func.attr} on block-table "
+                            f"'{base}' outside _push_block_tables — the "
+                            f"device table must mirror the host-"
+                            f"authoritative copy exactly")
+                        continue
+                # (b) inline position clamp: a rollback that bypasses the
+                # helper's live-row masking
+                if isinstance(node, ast.Assign) \
+                        and self._pos_target(node.targets) \
+                        and self._has_minimum(node.value):
+                    yield self.finding(
+                        ctx, node,
+                        "position vector clamped inline (jnp.minimum into "
+                        "a pos-named target) — rollback must go through "
+                        "_rewind so inactive rows keep their frozen "
+                        "positions and the clamp matches the accounting")
+
+    @staticmethod
+    def _bt_name(name: str) -> bool:
+        n = name.lower()
+        return (n.lstrip("_") in ("bt", "dbt", "bt_np", "dbt_np")
+                or "block_table" in n)
+
+    @classmethod
+    def _bt_base(cls, expr: ast.AST) -> str | None:
+        """Name of the block table a subscript-store writes, else None."""
+        if isinstance(expr, ast.Attribute) and cls._bt_name(expr.attr):
+            return expr.attr
+        if isinstance(expr, ast.Name) and cls._bt_name(expr.id):
+            return expr.id
+        return None
+
+    @classmethod
+    def _bt_at_base(cls, expr: ast.AST) -> str | None:
+        """Name of the block table an ``.at[...]`` chain updates."""
+        saw_at = False
+        node = expr
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if isinstance(node, ast.Attribute):
+                if node.attr == "at":
+                    saw_at = True
+                elif saw_at and cls._bt_name(node.attr):
+                    return node.attr
+                node = node.value
+                continue
+            node = node.value
+        if saw_at and isinstance(node, ast.Name) and cls._bt_name(node.id):
+            return node.id
+        return None
+
+    @staticmethod
+    def _pos_target(targets: list[ast.AST]) -> bool:
+        for t in targets:
+            if isinstance(t, ast.Name) and "pos" in t.id.lower():
+                return True
+            if isinstance(t, ast.Attribute) and "pos" in t.attr.lower():
+                return True
+        return False
+
+    @staticmethod
+    def _has_minimum(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = ""
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name == "minimum":
+                    return True
+        return False
+
+
+@register
 class OpaqueJitCallable(Rule):
     """KO141 — ``jax.jit`` applied to a callable expression the KO140
     fingerprint cannot resolve to a def: a factory call's return value,

@@ -330,7 +330,12 @@ data:
         "targets": [{{"expr": "max(ko_rollout_phase) by (model)", "legendFormat": "phase {{{{model}}}}"}},
                     {{"expr": "sum(rate(ko_rollout_started_total[5m])) by (model)", "legendFormat": "started {{{{model}}}}"}},
                     {{"expr": "sum(rate(ko_rollout_completed_total[5m])) by (model)", "legendFormat": "completed {{{{model}}}}"}},
-                    {{"expr": "sum(rate(ko_rollout_rolled_back_total[5m])) by (model)", "legendFormat": "rolled back {{{{model}}}}"}}]}}
+                    {{"expr": "sum(rate(ko_rollout_rolled_back_total[5m])) by (model)", "legendFormat": "rolled back {{{{model}}}}"}}]}},
+      {{"title": "Speculative decode: draft/accept rates, acceptance; MoE expert load", "type": "timeseries", "gridPos": {{"x":0,"y":72,"w":24,"h":8}},
+        "targets": [{{"expr": "sum(rate(ko_serve_spec_draft_tokens_total[5m]))", "legendFormat": "drafted/s"}},
+                    {{"expr": "sum(rate(ko_serve_spec_accepted_tokens_total[5m]))", "legendFormat": "accepted/s"}},
+                    {{"expr": "avg(ko_serve_spec_acceptance_ratio)", "legendFormat": "acceptance"}},
+                    {{"expr": "sum(ko_serve_moe_expert_load) by (expert)", "legendFormat": "expert {{{{expert}}}}"}}]}}
     ]}}
 ---
 apiVersion: v1

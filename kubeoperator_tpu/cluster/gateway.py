@@ -223,7 +223,8 @@ class AggregateStats:
                "tokens_generated_total", "queue_depth", "slot_occupancy",
                "kv_pages_used", "prefix_hits_total", "kv_spill_pages",
                "kv_demotions_total", "kv_promoted_hits_total",
-               "requests_requeued_total")
+               "requests_requeued_total", "spec_draft_tokens_total",
+               "spec_accepted_tokens_total")
 
     def __init__(self, stats: Sequence[Any]):
         if not stats:
@@ -238,6 +239,11 @@ class AggregateStats:
             for k, v in s["batch_size_hist"].items():
                 hist[k] = hist.get(k, 0) + v
         out["batch_size_hist"] = hist
+        # cluster acceptance is a ratio of the summed counters — an
+        # average of per-replica ratios would overweight idle replicas
+        out["spec_acceptance_ratio"] = round(
+            out["spec_accepted_tokens_total"]
+            / max(out["spec_draft_tokens_total"], 1), 4)
         for k in ("latency_p50_s", "latency_p95_s"):
             out[k] = max(s[k] for s in snaps)
         return out

@@ -156,11 +156,27 @@ class FakePagedEngine(FakeSlotEngine):
     cache evicts. A later hit on a demoted entry pays ``promote_s`` per
     promoted page — the host→device gather — instead of that share of
     the prefill sleep, which is the demoted-hit-TTFT-vs-recompute gap
-    the tier-1 guard pins."""
+    the tier-1 guard pins.
+
+    ``spec_k``/``draft`` (round 20) mirror the speculative slot pool:
+    one dispatch drafts K tokens (each at ``draft_cost`` of a step — the
+    truncated draft stack) and verifies them in ONE K-wide target pass
+    (``verify_cost`` of a step — K-wide matmuls amortize on a memory-
+    bound decode), then advances each row by its accepted prefix + 1.
+    Acceptance is a deterministic per-(row, position) hash thresholded
+    at ``draft`` — the replay stays bit-checkable while the accept-rate
+    knob swings the A/B from friendly (aligned draft) to adversarial
+    (misaligned draft). ``pages_for`` doubles and adds the K-token
+    lookahead exactly like the real engine (draft mirror + unclamped
+    in-flight write window), and positions flow back through
+    ``poll_spec`` because a dispatch advances 1..K+1 tokens per row —
+    the host mirror can no longer assume ``segment``."""
 
     def __init__(self, *, page: int = 16, pages: int | None = None,
                  prefix_capacity: int | None = None, kv_dtype: str = "bf16",
-                 spill_pages: int = 0, promote_s: float = 0.0001, **kw):
+                 spill_pages: int = 0, promote_s: float = 0.0001,
+                 spec_k: int = 0, draft: float = 0.0,
+                 draft_cost: float = 0.08, verify_cost: float = 1.0, **kw):
         super().__init__(**kw)
         if page <= 0 or page & (page - 1):
             raise ValueError(f"page ({page}) must be a power of two")
@@ -183,6 +199,18 @@ class FakePagedEngine(FakeSlotEngine):
         self._spill_used = [0] * self.dp
         self.demotions = 0
         self.promoted_hits = 0
+        if spec_k < 0:
+            raise ValueError(f"spec_k ({spec_k}) must be >= 0")
+        if not 0.0 <= draft <= 1.0:
+            raise ValueError(f"draft ({draft}) must be in [0, 1]")
+        self.spec_k = int(spec_k)
+        self.draft = float(draft)
+        self.draft_cost, self.verify_cost = draft_cost, verify_cost
+        self._base = np.zeros((self.slots,), np.int64)
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self._seg_drafted = 0
+        self._seg_accepted = 0
 
     def spill_pages_used(self, shard: int = 0) -> int:
         return self._spill_used[shard]
@@ -226,6 +254,12 @@ class FakePagedEngine(FakeSlotEngine):
         return self._span - 1
 
     def pages_for(self, prompt_len: int, max_tokens: int) -> int:
+        if self.spec_k:
+            # mirror SlotPoolEngine: K-token unclamped-write lookahead on
+            # the target table, then double for the draft mirror
+            span = min(prompt_len + max_tokens + self.spec_k,
+                       self.max_total)
+            return 2 * -(-span // self.page)
         return -(-(prompt_len + max_tokens) // self.page)
 
     def free_pages(self, shard: int = 0) -> int:
@@ -303,6 +337,7 @@ class FakePagedEngine(FakeSlotEngine):
                 self._free_pg[shard] -= need
                 assert self._free_pg[shard] >= 0, "batcher over-admitted"
                 self._held[slot] = (shard, need)
+                self._base[slot] = sum(prompt) % VOCAB
                 self._remember(shard, prompt)
                 total = len(prompt) + max_tokens
                 self.buf[slot] = 0
@@ -316,6 +351,50 @@ class FakePagedEngine(FakeSlotEngine):
                            + self.promote_s * promoted)
                 self.dispatches += 1
         return out
+
+    def _accept(self, slot: int, pos: int, i: int) -> bool:
+        """Deterministic per-(row, position) accept hash thresholded at
+        ``draft`` — replays stay bit-checkable at any accept rate."""
+        h = (int(self._base[slot]) * 1103515245
+             + (pos + i) * 12345 + i * 2654435761) % 1000
+        return h < self.draft * 1000
+
+    def _rewind(self, pos: int, adv: int, last: int) -> int:
+        """The one clamp into a row position (KO123 discipline, mirrored
+        from the real engine): accepted prefix + 1, never past last."""
+        return min(pos + adv, last)
+
+    def run_segment(self):
+        if not self.spec_k:
+            return super().run_segment()
+        # one speculative round: K draft micro-steps on the truncated
+        # stack + ONE K-wide verify pass — NOT segment sequential steps
+        time.sleep(self.dispatch_s + self._link_s
+                   + (self.spec_k * self.step_s * self.draft_cost
+                      + self.step_s * self.verify_cost) / self.tp)
+        self.dispatches += 1
+        active = self.pos < self.last
+        self.peak_concurrency = max(self.peak_concurrency, int(active.sum()))
+        for s in np.nonzero(active)[0]:
+            pos, last = int(self.pos[s]), int(self.last[s])
+            room = min(self.spec_k, last - pos)
+            a = 0
+            while a < room and self._accept(int(s), pos, a):
+                a += 1
+            adv = self.spec_k if a == self.spec_k else a + 1
+            self.pos[s] = self._rewind(pos, adv, last)
+            self._seg_drafted += room
+            self._seg_accepted += a
+
+    def poll_spec(self):
+        """Positions + (drafted, accepted) since the last poll — the
+        batcher mirrors TRUE per-row advances from here, exactly as with
+        the real speculative engine."""
+        drafted, accepted = self._seg_drafted, self._seg_accepted
+        self._seg_drafted = self._seg_accepted = 0
+        self.spec_draft_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        return self.pos.copy(), drafted, accepted
 
     def release(self, slots):
         for s in slots:

@@ -62,7 +62,8 @@ def _build_engine(espec: dict):
                                 "collective_s") if k in espec}
     if espec.get("kind", "paged") == "dense":
         return FakeSlotEngine(**kw)
-    for k in ("page", "prefix_capacity", "kv_dtype", "spill_pages"):
+    for k in ("page", "prefix_capacity", "kv_dtype", "spill_pages",
+              "spec_k", "draft"):
         if k in espec:
             kw[k] = espec[k]
     return FakePagedEngine(**kw)

@@ -150,6 +150,15 @@ def validate_spec(spec: Any) -> list[str]:
         if not isinstance(sp, int) or isinstance(sp, bool) or sp < 0:
             errs.append(f"engine.spill_pages: must be a non-negative "
                         f"integer, got {sp!r}")
+        sk = eng.get("spec_k", 0)
+        if not isinstance(sk, int) or isinstance(sk, bool) or sk < 0:
+            errs.append(f"engine.spec_k: must be a non-negative "
+                        f"integer, got {sk!r}")
+        dr = eng.get("draft", 0.0)
+        if (not isinstance(dr, (int, float)) or isinstance(dr, bool)
+                or not 0.0 <= dr <= 1.0):
+            errs.append(f"engine.draft: must be a number in [0, 1], "
+                        f"got {dr!r}")
 
     workloads = spec.get("workloads")
     if not isinstance(workloads, list) or not workloads:
@@ -526,6 +535,28 @@ SCENARIOS: dict[str, dict] = {
         "chaos": [
             {"beat": 4, "kind": "latency", "pattern": "healthz",
              "base_s": 0.0005, "jitter_s": 0.001},
+        ],
+        "slo_windows": {"fast": 4, "slow": 8},
+    },
+    "spec_decode_burst": {
+        "name": "spec_decode_burst",
+        "description": "burst arrivals over a shared prefix served "
+                       "speculatively (K=4 drafts + one-pass verify, "
+                       "friendly accept rate): rows advance 1..K+1 tokens "
+                       "per dispatch at mixed accept rates in one "
+                       "co-batch, with flaky control-plane probes "
+                       "mid-replay",
+        "beats": 12, "beat_s": 30.0, "beat_wall_s": 0.05,
+        "engine": {**_ENGINE, "spec_k": 4, "draft": 0.8},
+        "hosts": list(_HOSTS),
+        "workloads": [
+            {"kind": "serving", "name": "chat",
+             "trace": {"shape": "burst", "requests": 32, "bursts": [1, 2],
+                       "share": 0.7, "prefix_len": 32},
+             "serve_slos": {"ttft_p95_ms": 4000, "queue_depth_max": 48}},
+        ],
+        "chaos": [
+            {"beat": 5, "kind": "flake", "pattern": "healthz", "rate": 0.3},
         ],
         "slo_windows": {"fast": 4, "slow": 8},
     },

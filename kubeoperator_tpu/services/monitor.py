@@ -59,6 +59,14 @@ QUERIED_METRICS = {
     "ko_serve_kv_spill_pages": "jax-serve",
     "ko_serve_kv_demotions_total": "jax-serve",
     "ko_serve_kv_promoted_hits_total": "jax-serve",
+    # speculative decoding + MoE serving (round 20): draft/accept volume
+    # (their ratio is the speedup's whole story — a sagging acceptance
+    # means the draft stopped tracking the target) and per-expert routing
+    # load (a hot expert is the MoE capacity limiter)
+    "ko_serve_spec_draft_tokens_total": "jax-serve",
+    "ko_serve_spec_accepted_tokens_total": "jax-serve",
+    "ko_serve_spec_acceptance_ratio": "jax-serve",
+    "ko_serve_moe_expert_load": "jax-serve",
     # autoscaler (round 11): in-flight requests requeued by drain/preemption
     "ko_serve_requests_requeued_total": "jax-serve",
     # cluster gateway (round 13): routing volume per replica/decision,
@@ -130,6 +138,18 @@ PROMQL = {
     "serve_kv_demotion_rate": "sum(rate(ko_serve_kv_demotions_total[5m]))",
     "serve_kv_promoted_hit_rate":
         "sum(rate(ko_serve_kv_promoted_hits_total[5m]))",
+    # speculative decoding (round 20): drafted vs accepted token rates and
+    # the cumulative acceptance ratio — the operator signal for whether
+    # spec-K is paying (acceptance sagging toward 1/K means turn it off)
+    "serve_spec_draft_rate":
+        "sum(rate(ko_serve_spec_draft_tokens_total[5m]))",
+    "serve_spec_accept_rate":
+        "sum(rate(ko_serve_spec_accepted_tokens_total[5m]))",
+    "serve_spec_acceptance": "avg(ko_serve_spec_acceptance_ratio)",
+    # MoE serving (round 20): routed token load per expert — skew here is
+    # capacity-factor drop (overflowed tokens pass through the residual)
+    "serve_moe_expert_load":
+        "sum(ko_serve_moe_expert_load) by (expert)",
     # autoscaler (round 11): drain/preemption requeue pressure — a sustained
     # nonzero rate means topology churn is recycling in-flight decodes
     "serve_requeued_rate":
@@ -579,6 +599,18 @@ class ClusterMonitor:
         serve_promoted = prom.scalar_or_none(
             PROMQL["serve_kv_promoted_hit_rate"])
         serve_requeued = prom.scalar_or_none(PROMQL["serve_requeued_rate"])
+        # speculative decoding (round 20): None marks "spec decode off"
+        spec_draft_rate = prom.scalar_or_none(PROMQL["serve_spec_draft_rate"])
+        spec_accept_rate = prom.scalar_or_none(
+            PROMQL["serve_spec_accept_rate"])
+        spec_acceptance = prom.scalar_or_none(PROMQL["serve_spec_acceptance"])
+        # MoE serving (round 20): {} marks "no MoE model behind the endpoint"
+        try:
+            moe_expert_load = {
+                r.get("metric", {}).get("expert", "?"): float(r["value"][1])
+                for r in prom.query(PROMQL["serve_moe_expert_load"])}
+        except Exception:  # noqa: BLE001 — metric gaps are data, not errors
+            moe_expert_load = {}
         # cluster gateway: None marks "no gateway tier deployed"
         gateway_rate = prom.scalar_or_none(PROMQL["gateway_routed_rate"])
         gateway_affinity = prom.scalar_or_none(
@@ -658,6 +690,10 @@ class ClusterMonitor:
             "serve_kv_demotion_rate": serve_demotions,
             "serve_kv_promoted_hit_rate": serve_promoted,
             "serve_requeued_rate": serve_requeued,
+            "serve_spec_draft_rate": spec_draft_rate,
+            "serve_spec_accept_rate": spec_accept_rate,
+            "serve_spec_acceptance": spec_acceptance,
+            "serve_moe_expert_load": moe_expert_load,
             "serve_shed_by_tenant": serve_shed_rates,
             "serve_preemption_by_tenant": serve_preempt_rates,
             "gateway_routed_rate": gateway_rate,
@@ -716,6 +752,7 @@ class ClusterMonitor:
                        "serve_kv_promoted_hit_rate":
                            data["serve_kv_promoted_hit_rate"],
                        "serve_requeued_rate": data["serve_requeued_rate"],
+                       "serve_spec_acceptance": data["serve_spec_acceptance"],
                        "gateway_routed_rate": data["gateway_routed_rate"],
                        "gateway_affinity_ratio":
                            data["gateway_affinity_ratio"],

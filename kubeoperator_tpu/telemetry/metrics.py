@@ -472,6 +472,23 @@ def declare_serve_metrics(registry: Registry, window: int = 512) -> dict:
             "the batched result fetch, per dp mesh shard retiring rows.",
             labels=("shard",),
             buckets=SERVE_SEGMENT_BUCKETS),
+        "spec_draft": registry.counter(
+            "ko_serve_spec_draft_tokens_total",
+            "Draft tokens proposed by speculative-decode dispatches "
+            "(continuous engine with spec_k > 0)."),
+        "spec_accepted": registry.counter(
+            "ko_serve_spec_accepted_tokens_total",
+            "Draft tokens the target model verified and committed "
+            "(always <= draft tokens proposed)."),
+        "spec_acceptance": registry.gauge(
+            "ko_serve_spec_acceptance_ratio",
+            "Cumulative accepted/drafted ratio of speculative decoding "
+            "(0 before any dispatch; 1.0 means every draft committed)."),
+        "moe_expert_load": registry.gauge(
+            "ko_serve_moe_expert_load",
+            "Cumulative tokens dispatched to each MoE expert by the "
+            "serving engine, per expert index.",
+            labels=("expert",)),
     }
 
 

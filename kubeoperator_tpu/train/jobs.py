@@ -334,6 +334,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         # default-trained checkpoint restores with matching shapes
         d_ff=args.d_ff or int(args.d_model * 8 / 3 / 32) * 32,
         max_seq_len=args.max_seq_len,
+        moe_experts=getattr(args, "moe", 0) or 0,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     model_params = None
     if args.ckpt_dir:
@@ -406,7 +407,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                     mesh_spec=mesh_spec,
                                     compile_cache=compile_cache,
                                     kv_dtype=args.kv_dtype,
-                                    spill_pages=args.spill_pages)
+                                    spill_pages=args.spill_pages,
+                                    spec_k=getattr(args, "spec_k", 0),
+                                    draft_layers=getattr(
+                                        args, "draft_layers", 0))
         except ValueError as e:
             raise SystemExit(f"serve: {e}") from e
         # round 9: per-request span trees into the in-process ring —
@@ -897,6 +901,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "scales (~2x pages at equal HBM; greedy logits "
                          "within the engine's declared tolerance instead "
                          "of bit-identical)")
+    sv.add_argument("--spec-k", type=int, default=0,
+                    help="continuous engine: speculative decoding — draft K "
+                         "tokens per dispatch with a truncated-stack draft "
+                         "model, verify all K in ONE target pass, commit "
+                         "the accepted prefix + 1 (0 = off; greedy output "
+                         "stays bit-identical either way)")
+    sv.add_argument("--draft-layers", type=int, default=0,
+                    help="speculative decoding: how many of the target's "
+                         "leading layers form the self-draft stack "
+                         "(required when --spec-k > 0, must be < --layers)")
+    sv.add_argument("--moe", type=int, default=0,
+                    help="serve a mixture-of-experts model: experts per "
+                         "FFN block (0 = dense). --mesh gains an ep axis "
+                         "for expert placement, e.g. dp:2,ep:2,tp:2")
     sv.add_argument("--spill-pages", type=int, default=0,
                     help="continuous engine: host-RAM prefix-cache spill "
                          "tier bound, in KV pages per dp shard — cold "

@@ -87,6 +87,34 @@ entry becomes a host→device ``import_prefix``-style gather
 gateway's sticky prefix hashing already shards requests by prefix, so
 each replica's spill tier acts as one shard of a giant cluster cache.
 
+Speculative decoding (round 20): ``spec_k=K, draft_layers=N`` turns each
+dispatch into K cheap draft micro-steps (the target's own first N layers
+— a strict-prefix draft needs no second parameter set) followed by ONE
+K-wide target pass that verifies all K proposals at once. Decode is
+weight-streaming-bound, so the K-wide verify streams the target weights
+once for K query positions — that is the entire speedup. The draft's KV
+pages live in the SAME per-dp-shard pool with their own block tables
+(``pages_for`` reserves target extent + K-token lookahead, mirrored for
+the draft, so backpressure stays deadlock-free), and rejection is a
+masked per-row cache-position rewind through ``_rewind`` (lint rule
+KO123: the ONLY legal rollback path) — per-row ``pos`` rolls back, the
+over-speculated tail is reclaimed by block-table truncation at
+retirement (no data movement), and the accepted-prefix+1 correction
+token is written through the ordinary masked buffer write. Greedy output
+stays bit-identical to solo ``generate()`` and sampled rows stay on the
+(seed, position)-keyed stream: a rejected draft never surfaces.
+
+MoE serving (round 20): ``moe_experts > 0`` configs serve through the
+same pool — the segment jit carries router state by inlining
+``moe.MoEMlp``'s exact math (``_moe_tail``: f32 router → top-k gates →
+GShard capacity dispatch/combine → expert einsums, same einsum strings
+and cast points), expert weights shard over the ``ep`` mesh axis
+(``validate_serve_mesh``/``shard_params_decode_tp``), and per-expert
+assigned-token loads accumulate on device for telemetry
+(``expert_load()``). MoE greedy tokens are bit-identical to the solo
+flax decode at equal chunk widths (GShard capacity dropping is
+chunk-width dependent, so admission buckets pin the width).
+
 Multi-chip (round 7): pass a dp×tp ``MeshSpec`` and the same pool runs
 sharded over a device mesh — the page axis P splits over ``dp`` (the
 allocator hands each dp group a contiguous page range, so a slot's block
@@ -227,20 +255,28 @@ def validate_page_pool(*, page: int, pages: int, max_seq_len: int,
 
 def validate_serve_mesh(spec: MeshSpec, *, slots: int, n_heads: int,
                         page: int | None = None, pages: int | None = None,
-                        max_seq_len: int | None = None) -> None:
+                        max_seq_len: int | None = None,
+                        moe_experts: int = 0) -> None:
     """Reject un-shardable serving layouts up front with actionable
     errors instead of letting GSPMD fail mid-compile with an opaque
     partition error. The serving pool shards exactly two ways: the page
-    pool (and with it the slot axis) over dp, attention heads over tp.
+    pool (and with it the slot axis) over dp, attention heads over tp —
+    plus, for MoE models (``moe_experts > 0``), expert weights over ep.
     Pass ``page``/``pages``/``max_seq_len`` to validate the paged-KV
     layout in the same breath."""
+    allowed = ("dp", "tp", "ep") if moe_experts else ("dp", "tp")
     extra = {n: s for n, s in spec.sizes()
-             if n not in ("dp", "tp") and s > 1}
+             if n not in allowed and s > 1}
     if extra:
         raise ValueError(
             f"serving mesh shards slots over dp and heads over tp only; "
             f"got {', '.join(f'{n}={s}' for n, s in extra.items())} "
             f"(use --mesh dp:N,tp:M)")
+    if moe_experts and spec.ep > 1 and moe_experts % spec.ep:
+        raise ValueError(
+            f"moe_experts ({moe_experts}) must be divisible by ep "
+            f"({spec.ep}): expert weights shard over ep, so each shard "
+            f"owns moe_experts/ep experts")
     if slots % spec.dp:
         raise ValueError(
             f"slots ({slots}) must be divisible by dp ({spec.dp}): the "
@@ -266,6 +302,23 @@ def _rope_rows(x: jnp.ndarray, pos: jnp.ndarray,
     angles = pos[:, None].astype(jnp.float32) * freqs[None, :]   # [S, D/2]
     cos = jnp.cos(angles)[:, None, None, :]
     sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin,
+                         x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _rope_grid(x: jnp.ndarray, pos: jnp.ndarray,
+               base: float = 10_000.0) -> jnp.ndarray:
+    """Rotary embeddings over a per-(row, step) position grid. x:
+    [S, K, H, D], pos: [S, K] — the K-wide verify's batched form of
+    ``_rope_rows`` (same f32 angle math, same stack/reshape order), so
+    every (row, step) element is bit-identical to the per-row form."""
+    d = x.shape[-1]
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos[..., None].astype(jnp.float32) * freqs          # [S, K, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., ::2], x[..., 1::2]
     rotated = jnp.stack([x1 * cos - x2 * sin,
                          x1 * sin + x2 * cos], axis=-1)
@@ -334,15 +387,35 @@ class SlotPoolEngine:
                  slots: int = 16, segment: int = 8,
                  page: int | None = None, pages: int | None = None,
                  kv_dtype: str = "bf16", spill_pages: int = 0,
+                 spec_k: int = 0, draft_layers: int = 0,
                  mesh: Any = None, mesh_spec: MeshSpec | None = None,
                  devices: Sequence[Any] | None = None,
                  compile_cache: Any = None):
-        if cfg.moe_experts != 0 or not cfg.scan_layers:
+        if not cfg.scan_layers:
             raise ValueError(
-                "SlotPoolEngine requires scan_layers=True and no MoE "
-                "(same preconditions as generate's explicit-buffer path)")
+                "SlotPoolEngine requires scan_layers=True (the explicit-"
+                "buffer layout indexes nn.scan-stacked layer params)")
         if slots < 1 or segment < 1:
             raise ValueError("slots and segment must be >= 1")
+        self.spec_k = int(spec_k)
+        self.draft_layers = int(draft_layers)
+        if self.spec_k:
+            if not 1 <= self.draft_layers < cfg.n_layers:
+                raise ValueError(
+                    f"draft_layers ({draft_layers}) must satisfy 1 <= "
+                    f"draft_layers < n_layers ({cfg.n_layers}) when "
+                    f"spec_k > 0: the draft is the target's own first "
+                    f"layers, so it must be a strict, non-empty prefix")
+            if cfg.moe_experts:
+                raise ValueError(
+                    "speculative decoding over MoE models is not "
+                    "supported: the truncated draft stack has no router "
+                    "state to propose with (serve MoE with spec_k=0)")
+        elif self.draft_layers:
+            raise ValueError(
+                f"draft_layers ({draft_layers}) requires spec_k > 0 "
+                f"(speculation is disabled at spec_k=0)")
+        self._moe = cfg.moe_experts > 0
         self.cfg = cfg
         self.slots = int(slots)
         self.segment = int(segment)
@@ -358,7 +431,8 @@ class SlotPoolEngine:
                                   and mesh_spec.n_devices > 1) else None
         if self.spec is not None:
             validate_serve_mesh(self.spec, slots=self.slots,
-                                n_heads=cfg.n_heads)
+                                n_heads=cfg.n_heads,
+                                moe_experts=cfg.moe_experts)
             self.mesh = build_mesh(self.spec, devices)
             dp_ax = "dp" if "dp" in self.mesh.axis_names else None
             tp_ax = "tp" if "tp" in self.mesh.axis_names else None
@@ -459,6 +533,21 @@ class SlotPoolEngine:
             self._bt_np[i * self._shard_slots:(i + 1) * self._shard_slots] = \
                 self._shards[i].trash
         self._bt = self._pin(jnp.asarray(self._bt_np), self._bt_sh)
+        # draft block tables: the draft model's KV pages live in the SAME
+        # per-dp-shard pools (one allocator, one backpressure signal) but
+        # route through their own [S, blocks] table, mirrored trash-init
+        self._dbt_np = None
+        self._dbt = None
+        if self.spec_k:
+            self._dbt_np = self._bt_np.copy()
+            self._dbt = self._pin(jnp.asarray(self._dbt_np), self._bt_sh)
+        # speculative-decode accounting (poll_spec drains the device
+        # stats into these host counters) and the MoE expert-load
+        # accumulator (device-resident until expert_load() fetches it)
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self._spec_stats = None
+        self._expert_load = None
         # buf/pos/pools are dead after each segment — donate them so XLA
         # updates in place (CPU's donation support is partial and warns;
         # skip there). last/plen/temp/seeds stay live host-side (admit
@@ -480,25 +569,54 @@ class SlotPoolEngine:
                         else (self._pool_sh, self._pool_sh))
             out_sh = (self._buf_sh, self._vec_sh,
                       [entry_sh for _ in range(cfg.n_layers)])
+            if self._moe:
+                # + the replicated per-expert load vector
+                out_sh = (*out_sh, NamedSharding(self.mesh, P()))
         self._seg_fn = jax.jit(
             self._segment_body, donate_argnums=self._donate,
             **({"out_shardings": out_sh} if out_sh is not None else {}))
+        self._spec_fn = None
+        if self.spec_k:
+            spec_out = None
+            if out_sh is not None:
+                # same pool/buf layouts + the replicated [2] stats vector
+                spec_out = (*out_sh, NamedSharding(self.mesh, P()))
+            self._spec_fn = jax.jit(
+                self._spec_segment_body, donate_argnums=self._donate,
+                **({"out_shardings": spec_out}
+                   if spec_out is not None else {}))
         # AOT compile-artifact cache: on a hit the segment dispatch is a
         # deserialized executable and bring-up performs zero compiles; on
         # a miss the cache live-compiles here (reported to any active
         # compile-count guard) and persists the artifact for the next
-        # worker. The example args are exactly run_segment's tuple.
+        # worker. The example args are exactly run_segment's tuple. Only
+        # the dispatch the engine will actually run is cached, under its
+        # own name, and the closure carries spec_k/draft_layers (and the
+        # MoE fields through repr(cfg)) so a spec_k=4 engine can never
+        # deserialize a spec_k=0 executable.
         self.aot = None
         if compile_cache is not None:
-            res = compile_cache.load_or_compile(
-                "_segment_body", self._seg_fn,
-                (self._buf, self._pos, self._last, self._plen, self._temp,
-                 self._seeds, self._pools, self._bt),
-                mesh_spec=self.spec, donate=self._donate,
-                closure=(self.segment, self.page, self.kv_dtype,
-                         repr(cfg)))
-            if res.fn is not None:
-                self._seg_fn = res.fn
+            closure = (self.segment, self.page, self.kv_dtype,
+                       self.spec_k, self.draft_layers, repr(cfg))
+            if self.spec_k:
+                res = compile_cache.load_or_compile(
+                    "_spec_segment_body", self._spec_fn,
+                    (self._buf, self._pos, self._last, self._plen,
+                     self._temp, self._seeds, self._pools, self._bt,
+                     self._dbt),
+                    mesh_spec=self.spec, donate=self._donate,
+                    closure=closure)
+                if res.fn is not None:
+                    self._spec_fn = res.fn
+            else:
+                res = compile_cache.load_or_compile(
+                    "_segment_body", self._seg_fn,
+                    (self._buf, self._pos, self._last, self._plen,
+                     self._temp, self._seeds, self._pools, self._bt),
+                    mesh_spec=self.spec, donate=self._donate,
+                    closure=closure)
+                if res.fn is not None:
+                    self._seg_fn = res.fn
             self.aot = res
 
     def _pin(self, x: jnp.ndarray, sh: NamedSharding | None) -> jnp.ndarray:
@@ -605,6 +723,78 @@ class SlotPoolEngine:
         return buf[idx]
 
     # -- device math --------------------------------------------------------
+    def _pin_pools(self, kp, vp, ks, vs):
+        """Keep the pool layout pinned through a scan/chunk body: pages
+        over dp, heads over tp — GSPMD then partitions the scatter and
+        the attention einsums in place instead of re-laying-out.
+        Identity on the solo path."""
+        if self._pool_sh is None:
+            return kp, vp, ks, vs
+        kp = jax.lax.with_sharding_constraint(kp, self._pool_sh)
+        vp = jax.lax.with_sharding_constraint(vp, self._pool_sh)
+        if ks is not None:
+            ks = jax.lax.with_sharding_constraint(ks, self._scale_sh)
+            vs = jax.lax.with_sharding_constraint(vs, self._scale_sh)
+        return kp, vp, ks, vs
+
+    def _moe_tail(self, mo, h2):
+        """One MoE FFN computed exactly as ``moe.MoEMlp`` computes it at
+        this query width: f32 router → top-k gates → GShard capacity
+        dispatch/combine → expert einsums, with the same einsum strings
+        and cast points — MoE slot tokens therefore match the solo flax
+        decode bit for bit at equal chunk widths (GShard capacity is a
+        function of the width, so admission buckets pin it). Returns
+        ``(y, load)`` where load is the per-expert assigned-token count
+        ([E] float32) this pass dispatched — the telemetry signal."""
+        cfg = self._decode_cfg
+        E, Ktop = cfg.moe_experts, cfg.moe_top_k
+        b, t, _d = h2.shape
+        capacity = max(1, int(cfg.moe_capacity_factor * Ktop * t / E))
+        router_logits = jnp.einsum("btd,de->bte", h2.astype(jnp.float32),
+                                   mo["router"]["kernel"])
+        router_probs = jax.nn.softmax(router_logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(router_probs, Ktop)
+        gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+        combine = jnp.zeros((b, t, E, capacity), jnp.float32)
+        counts = jnp.zeros((b, E), jnp.float32)
+        for k_slot in range(Ktop):
+            onehot_e = jax.nn.one_hot(gate_idx[..., k_slot], E)
+            in_slot = jnp.cumsum(onehot_e, axis=1) - onehot_e
+            qidx = (in_slot + counts[:, None, :]).astype(jnp.int32)
+            within = (qidx < capacity).astype(jnp.float32)
+            combine = combine + (gate_vals[..., k_slot, None, None]
+                                 * (onehot_e * within)[..., None]
+                                 * jax.nn.one_hot(qidx, capacity))
+            counts = counts + onehot_e.sum(axis=1)
+        dt = cfg.dtype
+        dispatch = (combine > 0).astype(dt)                   # [b, t, E, C]
+        expert_in = jnp.einsum("btec,btd->ebcd", dispatch, h2.astype(dt))
+        w_gate = mo["w_gate"].astype(dt)
+        w_up = mo["w_up"].astype(dt)
+        w_down = mo["w_down"].astype(dt)
+        h = (nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate))
+             * jnp.einsum("ebcd,edf->ebcf", expert_in, w_up))
+        out_e = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+        y = jnp.einsum("btec,ebcd->btd", combine.astype(dt), out_e)
+        load = jnp.sum(dispatch, axis=(0, 1, 3)).astype(jnp.float32)  # [E]
+        return y.astype(dt), load
+
+    def _layer_tail(self, pl, x, probs, cv, dt):
+        """Post-softmax tail of one layer, dispatching on the layer's FFN
+        kind: dense SwiGLU layers reuse ``generate``'s fused
+        ``attn_out_mlp`` verbatim; MoE layers inline the attention-out
+        projection + residual and route the FFN through ``_moe_tail``.
+        Returns ``(x, load)`` — load is ``None`` for dense layers."""
+        if "moe" not in pl:
+            return attn_out_mlp(pl, x, probs, cv, dt), None
+        a = pl["attn"]
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), cv)
+        x = x + jnp.einsum("bqhd,hde->bqe", out, a["o"]["kernel"].astype(dt))
+        # flax Block hands the MoE FFN the UNCAST RMSNorm output (the f32
+        # scale promotes it), so no .astype(dt) between norm and router
+        y, load = self._moe_tail(pl["moe"], rms_norm(x, pl["ln2"]["scale"]))
+        return x + y, load
+
     def _micro_step(self, buf, pos, last, plen, temp, seeds, pools, bt):
         """Advance every active slot one token — ``_decode_scan.step`` with
         the scalar position replaced by the per-slot ``pos`` vector and the
@@ -625,6 +815,8 @@ class SlotPoolEngine:
         off = pos - blk * self.page
         pg = bt[rows, blk]                                      # [S]
         new_pools = []
+        load = (jnp.zeros((cfg.moe_experts,), jnp.float32)
+                if self._moe else None)
         for pl, entry in zip(self._layers, pools):
             kp, vp, ks, vs = self._split(entry)
             hdn = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
@@ -632,15 +824,7 @@ class SlotPoolEngine:
             q, k = _rope_rows(q, pos), _rope_rows(k, pos)
             kp, ks = self._page_write(kp, pg, off, k[:, 0].astype(dt), ks)
             vp, vs = self._page_write(vp, pg, off, v[:, 0].astype(dt), vs)
-            if self._pool_sh is not None:
-                # keep the pool layout pinned through the scan: pages over
-                # dp, heads over tp — GSPMD then partitions the scatter and
-                # the attention einsums in place instead of re-laying-out
-                kp = jax.lax.with_sharding_constraint(kp, self._pool_sh)
-                vp = jax.lax.with_sharding_constraint(vp, self._pool_sh)
-                if ks is not None:
-                    ks = jax.lax.with_sharding_constraint(ks, self._scale_sh)
-                    vs = jax.lax.with_sharding_constraint(vs, self._scale_sh)
+            kp, vp, ks, vs = self._pin_pools(kp, vp, ks, vs)
             new_pools.append(self._join(kp, vp, ks, vs))
             # gather the dense [S, T, H, D] view back out of the pool — a
             # permutation copy for bf16 (the einsum sees bit-identical
@@ -655,7 +839,9 @@ class SlotPoolEngine:
             mask = (jnp.arange(self.max_total)[None, None, None, :]
                     <= pos[:, None, None, None])                # [S,1,1,T]
             probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
-            x = attn_out_mlp(pl, x, probs, cv, dt)
+            x, ld = self._layer_tail(pl, x, probs, cv, dt)
+            if ld is not None:
+                load = load + ld
         logits = final_logits(cfg, self._params, x, self._emb)[:, 0, :]
 
         # per-row choose: the given prompt token while pos+1 is inside the
@@ -676,25 +862,219 @@ class SlotPoolEngine:
         value = jnp.where(active, chosen, buf[rows, pos])
         buf = buf.at[rows, target].set(value)
         pos = jnp.where(active, pos + 1, pos)
-        return buf, pos, new_pools, logits
+        return buf, pos, new_pools, logits, load
 
     def _segment_body(self, buf, pos, last, plen, temp, seeds, pools, bt):
         def step(carry, _):
-            buf, pos, pools = carry
-            buf, pos, pools, _ = self._micro_step(
+            buf, pos, pools, load = carry
+            buf, pos, pools, _, ld = self._micro_step(
                 buf, pos, last, plen, temp, seeds, pools, bt)
-            return (buf, pos, pools), None
+            if ld is not None:
+                load = load + ld
+            return (buf, pos, pools, load), None
 
-        (buf, pos, pools), _ = jax.lax.scan(
-            step, (buf, pos, pools), None, length=self.segment)
+        load0 = jnp.zeros((max(self.cfg.moe_experts, 1),), jnp.float32)
+        (buf, pos, pools, load), _ = jax.lax.scan(
+            step, (buf, pos, pools, load0), None, length=self.segment)
+        if self._moe:
+            return buf, pos, pools, load
         return buf, pos, pools
 
+    def _rewind(self, pos0, adv, last, live):
+        """THE cache-position rollback path (lint rule KO123): after a
+        speculative verify, every live row's ``pos`` moves to its
+        accepted frontier — dispatch position plus per-row advance,
+        clamped at ``last`` — and rows inactive at dispatch keep their
+        frozen position. Pages above the frontier are NOT touched here:
+        block tables are host-authoritative, and the over-speculated
+        tail is reclaimed by block-table truncation at retirement
+        (``release`` points the whole table back at the trash page — no
+        data movement). Any other rollback write to ``pos`` or a block
+        table is a KO123 violation, because a bypass can strand a row's
+        position above KV its pages no longer hold."""
+        return jnp.where(live, jnp.minimum(pos0 + adv, last), pos0)
+
+    def _spec_segment_body(self, buf, pos, last, plen, temp, seeds, pools,
+                           bt, dbt):
+        """One speculative dispatch: K draft micro-steps (the target's
+        own first ``draft_layers`` layers, KV routed through the draft
+        block tables ``dbt``) propose tokens for positions pos+1..pos+K,
+        then ONE K-wide target pass verifies all K proposals at once —
+        the target streams its weights once for K query positions, and
+        decode is weight-streaming-bound, so that is the entire speedup.
+        Acceptance is per row: the leading run of proposals that match
+        the target's own (seed, position)-keyed choices commits, the
+        first mismatch commits the target's correction token instead
+        (accepted-prefix+1), and ``_rewind`` rolls every row's position
+        to its accepted frontier. A rejected draft never reaches the
+        committed region, so greedy rows emit exactly the solo token
+        stream and sampled rows exactly the keyed stream. Draft/verify
+        steps past a row's ``last`` land their K/V in the request's
+        reserved K-token lookahead pages (``pages_for``) and their
+        proposals are masked out of the commit. Returns
+        ``(buf, pos, pools, stats)`` with stats = [drafted, accepted]."""
+        cfg, dt = self._decode_cfg, self._decode_cfg.dtype
+        s, K = self.slots, self.spec_k
+        nh, hd = cfg.n_heads, cfg.head_dim
+        rows = jnp.arange(s)
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        edge = self.max_total - 1
+        pos0 = pos
+        live = pos0 < last                                      # [S]
+        safe_t = jnp.where(temp > 0, temp, 1.0)
+
+        def keyed_choice(logits, q):
+            """_micro_step's model choice at query positions ``q`` (any
+            shape broadcastable against [S]-leading logits): argmax at
+            temp 0, else the (seed, position)-keyed categorical — the
+            identical fold_in stream, which is what makes a draft
+            proposal verifiable against the target's own choice."""
+            flat_q = q.reshape(-1)
+            reps = flat_q.shape[0] // s
+            flat_seeds = jnp.repeat(seeds, reps)
+            keys = jax.vmap(lambda sd, p: jax.random.fold_in(
+                jax.random.key(sd), p))(flat_seeds, flat_q)
+            flat_logits = logits.reshape(flat_q.shape[0], -1)
+            flat_t = jnp.repeat(safe_t, reps)
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, flat_logits / flat_t[:, None]).astype(jnp.int32)
+            greedy = jnp.argmax(flat_logits, axis=-1).astype(jnp.int32)
+            out = jnp.where(jnp.repeat(temp, reps) > 0, sampled, greedy)
+            return out.reshape(q.shape)
+
+        # -- draft phase: K cheap sequential micro-steps ------------------
+        def draft_step(carry, i):
+            cbuf, cpools = carry
+            dq = jnp.where(live, jnp.minimum(pos0 + i, edge), pos0)
+            token = cbuf[rows, dq]
+            x = self._emb[token][:, None, :].astype(dt)
+            blk = dq // self.page
+            off = dq - blk * self.page
+            pg = dbt[rows, blk]
+            out_pools = []
+            for li, (pl, entry) in enumerate(zip(self._layers, cpools)):
+                if li >= self.draft_layers:
+                    out_pools.append(entry)
+                    continue
+                kp, vp, ks, vs = self._split(entry)
+                hdn = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
+                q, k, v = token_qkv(pl["attn"], hdn, dt)
+                q, k = _rope_rows(q, dq), _rope_rows(k, dq)
+                kp, ks = self._page_write(kp, pg, off, k[:, 0].astype(dt),
+                                          ks)
+                vp, vs = self._page_write(vp, pg, off, v[:, 0].astype(dt),
+                                          vs)
+                kp, vp, ks, vs = self._pin_pools(kp, vp, ks, vs)
+                out_pools.append(self._join(kp, vp, ks, vs))
+                ck = self._gather_kv(kp, ks, dbt).reshape(
+                    s, self.max_total, nh, hd)
+                cv = self._gather_kv(vp, vs, dbt).reshape(
+                    s, self.max_total, nh, hd)
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, ck,
+                    preferred_element_type=jnp.float32) * scale
+                mask = (jnp.arange(self.max_total)[None, None, None, :]
+                        <= dq[:, None, None, None])
+                probs = jax.nn.softmax(jnp.where(mask, scores, -1e30),
+                                       axis=-1)
+                x = attn_out_mlp(pl, x, probs, cv, dt)
+            logits = final_logits(cfg, self._params, x, self._emb)[:, 0, :]
+            widx = jnp.minimum(dq + 1, edge)
+            chosen = jnp.where((dq + 1) < plen, cbuf[rows, widx],
+                               keyed_choice(logits, dq))
+            # only proposals landing at or below `last` enter the buffer;
+            # overshoot steps keep drafting (their KV goes to the
+            # reserved lookahead pages) but rewrite widx with itself
+            keep = live & (pos0 + i < last)
+            val = jnp.where(keep, chosen, cbuf[rows, widx])
+            cbuf = cbuf.at[rows, widx].set(val)
+            return (cbuf, out_pools), None
+
+        (buf, pools), _ = jax.lax.scan(draft_step, (buf, pools),
+                                       jnp.arange(K))
+
+        # -- verify phase: ONE K-wide all-layer target pass ---------------
+        vq = jnp.where(live[:, None],
+                       jnp.minimum(pos0[:, None] + jnp.arange(K)[None, :],
+                                   edge),
+                       pos0[:, None])                           # [S, K]
+        tok = buf[rows[:, None], vq]                            # [S, K]
+        x = self._emb[tok].astype(dt)                           # [S, K, d]
+        blk = vq // self.page
+        off = vq - blk * self.page
+        pg = bt[rows[:, None], blk]                             # [S, K]
+        new_pools = []
+        for pl, entry in zip(self._layers, pools):
+            kp, vp, ks, vs = self._split(entry)
+            hdn = rms_norm(x, pl["ln1"]["scale"]).astype(dt)
+            q, k, v = token_qkv(pl["attn"], hdn, dt)            # [S,K,H,D]
+            q, k = _rope_grid(q, vq), _rope_grid(k, vq)
+            kp, ks = self._page_write(kp, pg, off, k.astype(dt), ks)
+            vp, vs = self._page_write(vp, pg, off, v.astype(dt), vs)
+            kp, vp, ks, vs = self._pin_pools(kp, vp, ks, vs)
+            new_pools.append(self._join(kp, vp, ks, vs))
+            ck = self._gather_kv(kp, ks, bt).reshape(s, self.max_total,
+                                                     nh, hd)
+            cv = self._gather_kv(vp, vs, bt).reshape(s, self.max_total,
+                                                     nh, hd)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                                preferred_element_type=jnp.float32) * scale
+            # per-step causal mask: verify step i sees positions <= vq_i,
+            # which exposes earlier verify steps' K/V written this pass
+            mask = (jnp.arange(self.max_total)[None, None, None, :]
+                    <= vq[:, None, :, None])                    # [S,1,K,T]
+            probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+            x, _ = self._layer_tail(pl, x, probs, cv, dt)
+        logits = final_logits(cfg, self._params, x, self._emb)  # [S, K, V]
+
+        # -- acceptance + commit ------------------------------------------
+        widx = jnp.minimum(vq + 1, edge)                        # [S, K]
+        target_choice = jnp.where((vq + 1) < plen[:, None],
+                                  buf[rows[:, None], widx],
+                                  keyed_choice(logits, vq))     # [S, K]
+        proposal = buf[rows[:, None], widx]
+        step_live = ((pos0[:, None] + jnp.arange(K)[None, :])
+                     < last[:, None]) & live[:, None]
+        match = (proposal == target_choice) & step_live
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        a = acc.sum(axis=1)                                     # [S] in [0,K]
+        adv = jnp.where(a == K, K, a + 1)
+        # accepted-prefix+1 correction: the target's own choice at the
+        # first mismatch, written through the ordinary masked buffer
+        # write (rows that accepted everything, or whose mismatch falls
+        # beyond `last`, rewrite the index with itself)
+        corr_tok = target_choice[rows, jnp.minimum(a, K - 1)]
+        corr = live & (a < K) & ((pos0 + a + 1) <= last)
+        wc = jnp.minimum(pos0 + a + 1, edge)
+        buf = buf.at[rows, wc].set(
+            jnp.where(corr, corr_tok, buf[rows, wc]))
+        pos = self._rewind(pos0, adv, last, live)
+        room = last - pos0
+        drafted = jnp.sum(jnp.where(live, jnp.minimum(K, room), 0))
+        accepted = jnp.sum(jnp.where(live, a, 0))
+        stats = jnp.stack([drafted, accepted]).astype(jnp.int32)
+        return buf, pos, new_pools, stats
+
     # -- host-side page accounting ------------------------------------------
+    def _target_blocks(self, prompt_len: int, max_tokens: int) -> int:
+        """Target-model blocks one request needs. Under speculation the
+        decode extent grows by the K-token lookahead (clamped at the
+        context bound): a verify dispatched with pos near ``last`` still
+        writes K KV rows, and without the lookahead a row at a page
+        boundary would scatter into pages it never reserved."""
+        extent = prompt_len + max_tokens
+        if self.spec_k:
+            extent = min(extent + self.spec_k, self.max_total)
+        return -(-extent // self.page)
+
     def pages_for(self, prompt_len: int, max_tokens: int) -> int:
         """Pages one request reserves: its full decode extent, rounded up
-        to whole pages. Prefix hits only ever need fewer, so admitting
-        against this number is safe (never over-commits)."""
-        return -(-(int(prompt_len) + int(max_tokens)) // self.page)
+        to whole pages — doubled under speculation, because the draft
+        model's KV pages live in the same pool behind a mirrored block
+        run. Prefix hits only ever need fewer, so admitting against this
+        number is safe (never over-commits)."""
+        n = self._target_blocks(int(prompt_len), int(max_tokens))
+        return 2 * n if self.spec_k else n
 
     def free_pages(self, shard: int = 0) -> int:
         return len(self._shards[shard].free)
@@ -898,7 +1278,13 @@ class SlotPoolEngine:
         freed = [int(s) for s in slots if int(s) in self._slot_pages]
         for s in freed:
             self._release_slot(s)
-            self._bt_np[s, :] = self._shards[s // self._shard_slots].trash
+            trash = self._shards[s // self._shard_slots].trash
+            self._bt_np[s, :] = trash
+            if self.spec_k:
+                # block-table truncation IS the speculative-tail release:
+                # the draft run and any over-speculated lookahead KV are
+                # reclaimed by pointing the tables at trash — no data moves
+                self._dbt_np[s, :] = trash
         self._push_block_tables(freed)
 
     # -- admission ----------------------------------------------------------
@@ -943,9 +1329,39 @@ class SlotPoolEngine:
             out.update(self._admit_group(c, h, group))
         if nopass:
             out.update(self._admit_nopass(nopass))
+        if self.spec_k:
+            self._seed_draft(plans)
         self._push_block_tables([pl["slot"] for pl in plans])
         self._register_prefixes(plans)
         return out
+
+    def _seed_draft(self, plans: list[dict]) -> None:
+        """Seed each newly admitted slot's draft pages. The draft IS the
+        target's first ``draft_layers`` layers (identical params, and a
+        layer's input depends only on the layers below it), so the
+        draft's layer-l KV over a token prefix is bit-identical to the
+        target's — one whole-page copy of the target blocks below the
+        write frontier replaces re-running the draft over the prompt.
+        Raw ``_page_copy`` keeps quantized pools bit-exact too."""
+        dst, src = [], []
+        for pl in plans:
+            n = -(-pl["pos0"] // self.page)
+            dst.extend(pl["dpages"][:n])
+            src.extend(pl["pages"][:n])
+        if not dst:
+            return
+        dj = jnp.asarray(dst, jnp.int32)
+        sj = jnp.asarray(src, jnp.int32)
+        new_pools = []
+        for li, entry in enumerate(self._pools):
+            if li >= self.draft_layers:
+                new_pools.append(entry)
+                continue
+            kp, vp, ks, vs = self._split(entry)
+            kp, ks = self._page_copy(kp, dj, sj, scale=ks)
+            vp, vs = self._page_copy(vp, dj, sj, scale=vs)
+            new_pools.append(self._pin_entry(kp, vp, ks, vs))
+        self._pools = new_pools
 
     def _plan_entries(self, entries) -> tuple[list[dict],
                                               list[tuple[int, int]]]:
@@ -970,7 +1386,7 @@ class SlotPoolEngine:
             # a re-admitted slot implicitly releases its previous pages
             # (its block table is rewritten below, before any segment runs)
             self._release_slot(slot)
-            blocks_needed = self.pages_for(plen, mt)
+            blocks_needed = self._target_blocks(plen, mt)
             n_hit, hit_pages = self._lookup_prefix(shard_i, prompt)
             if sh.spill and n_hit * self.page < plen:
                 # a demoted prefix may cover more of the prompt than the
@@ -997,7 +1413,12 @@ class SlotPoolEngine:
             shared = [hit_pages[b] for b in range(n_hit) if b != cow_blk]
             for pg in shared:
                 sh.ref[pg] += 1
+            # the draft's mirrored block run is always freshly allocated:
+            # draft pages are never prefix-cached or shared (their KV is
+            # re-seeded per admission), so they add blocks_needed on top
             need = blocks_needed - len(shared)
+            if self.spec_k:
+                need += blocks_needed
             self._ensure_free(sh, need)
             if n_hit:
                 self.prefix_hits += 1
@@ -1013,13 +1434,21 @@ class SlotPoolEngine:
                         cow_pairs.append((pg, hit_pages[b]))
                         self.cow_copies += 1
                     pages.append(pg)
-            self._slot_pages[slot] = list(pages)
+            dpages: list[int] = []
+            if self.spec_k:
+                for _ in range(blocks_needed):
+                    dpg = sh.free.pop()
+                    sh.ref[dpg] = 1
+                    dpages.append(dpg)
+                self._dbt_np[slot, :] = sh.trash
+                self._dbt_np[slot, :len(dpages)] = dpages
+            self._slot_pages[slot] = list(pages) + dpages
             self._bt_np[slot, :] = sh.trash
             self._bt_np[slot, :blocks_needed] = pages
             plans.append(dict(slot=slot, prompt=prompt, plen=plen, mt=mt,
                               temp=float(temperature), seed=int(seed),
                               c=c, h=h, pos0=pos0, pages=pages,
-                              shard=shard_i))
+                              dpages=dpages, shard=shard_i))
         return plans, cow_pairs
 
     def _apply_cow(self, cow_pairs: list[tuple[int, int]]) -> None:
@@ -1170,6 +1599,10 @@ class SlotPoolEngine:
         self._bt = self._pin(
             self._bt.at[jnp.asarray(idx_np)].set(
                 jnp.asarray(self._bt_np[idx_np])), self._bt_sh)
+        if self.spec_k:
+            self._dbt = self._pin(
+                self._dbt.at[jnp.asarray(idx_np)].set(
+                    jnp.asarray(self._dbt_np[idx_np])), self._bt_sh)
 
     def _register_prefixes(self, plans: list[dict]) -> None:
         """Publish every page-aligned prefix strictly below each plan's
@@ -1262,11 +1695,27 @@ class SlotPoolEngine:
         return n
 
     def run_segment(self) -> None:
-        """One device dispatch: every active slot advances ``segment``
-        tokens (finished/empty slots no-op in place)."""
-        self._buf, self._pos, self._pools = self._seg_fn(
+        """One device dispatch. Plain engines advance every active slot
+        ``segment`` tokens (finished/empty slots no-op in place). A
+        speculative engine runs ONE draft-K + K-wide-verify round per
+        dispatch instead — the per-row advance is data-dependent (1 to
+        K tokens), so ``segment`` no longer governs it; the batcher
+        reads the true positions back through ``poll_spec``."""
+        if self.spec_k:
+            (self._buf, self._pos, self._pools,
+             self._spec_stats) = self._spec_fn(
+                self._buf, self._pos, self._last, self._plen, self._temp,
+                self._seeds, self._pools, self._bt, self._dbt)
+            return
+        out = self._seg_fn(
             self._buf, self._pos, self._last, self._plen, self._temp,
             self._seeds, self._pools, self._bt)
+        if self._moe:
+            self._buf, self._pos, self._pools, load = out
+            self._expert_load = (load if self._expert_load is None
+                                 else self._expert_load + load)
+        else:
+            self._buf, self._pos, self._pools = out
 
     def poll(self) -> tuple[np.ndarray, np.ndarray]:
         """ONE batched device->host fetch: (token buffers [S, max_total],
@@ -1274,6 +1723,30 @@ class SlotPoolEngine:
         per-scalar fetches (each scalar fetch is a transport round trip)."""
         buf, pos = jax.device_get((self._buf, self._pos))
         return np.asarray(buf), np.asarray(pos)
+
+    def poll_spec(self) -> tuple[np.ndarray, int, int]:
+        """Speculative retirement fetch: (positions [S], drafted,
+        accepted) for the LAST dispatch, one batched device->host
+        transfer. The batcher mirrors the true per-row advance out of
+        the positions (a spec dispatch moves each row 1..K tokens) and
+        feeds the counters to BatcherStats; the engine accumulates them
+        into ``spec_draft_tokens``/``spec_accepted_tokens`` too."""
+        if self._spec_stats is None:
+            return np.asarray(jax.device_get(self._pos)), 0, 0
+        pos, stats = jax.device_get((self._pos, self._spec_stats))
+        self._spec_stats = None
+        drafted, accepted = int(stats[0]), int(stats[1])
+        self.spec_draft_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        return np.asarray(pos), drafted, accepted
+
+    def expert_load(self) -> np.ndarray:
+        """Cumulative per-expert assigned-token counts ([moe_experts]
+        float32) since engine start — accumulated on device inside the
+        segment jit, fetched only when telemetry asks."""
+        if self._expert_load is None:
+            return np.zeros((self.cfg.moe_experts,), np.float32)
+        return np.asarray(jax.device_get(self._expert_load))
 
     def debug_logits(self) -> np.ndarray:
         """Test-only hook behind the two-tier bit-exactness policy: one
@@ -1284,7 +1757,7 @@ class SlotPoolEngine:
         declared ``logit_tolerance`` is asserted against exactly what
         decode sees — the engine never exposes logits otherwise. Eager
         (unjitted) on purpose: no donation, so the live buffers survive."""
-        _, _, _, logits = self._micro_step(
+        _, _, _, logits, _ = self._micro_step(
             self._buf, self._pos, self._last, self._plen, self._temp,
             self._seeds, self._pools, self._bt)
         return np.asarray(jax.device_get(logits))

@@ -216,6 +216,25 @@ class BatcherStats:
         """Admissions served by promoting a demoted prefix host->device."""
         self._m["kv_promoted_hits"].inc(n)
 
+    def spec_tokens(self, drafted: int, accepted: int) -> None:
+        """One speculative dispatch's draft/accept counts; the gauge is
+        the CUMULATIVE acceptance ratio, so it converges instead of
+        flapping with each dispatch's luck."""
+        if drafted:
+            self._m["spec_draft"].inc(drafted)
+        if accepted:
+            self._m["spec_accepted"].inc(accepted)
+        total = self._m["spec_draft"].value()
+        if total:
+            self._m["spec_acceptance"].set(
+                self._m["spec_accepted"].value() / total)
+
+    def moe_expert_load(self, loads: Sequence[float]) -> None:
+        """Cumulative per-expert assigned-token counts from the serving
+        engine (``expert_load()``), one gauge sample per expert index."""
+        for e, v in enumerate(loads):
+            self._m["moe_expert_load"].set(float(v), expert=str(e))
+
     def requeued(self, reason: str, n: int = 1) -> None:
         """In-flight requests snapshotted off drained slots and pushed
         back to the queue head instead of dropped (reason: drain |
@@ -300,6 +319,12 @@ class BatcherStats:
             "requests_requeued_total": int(sum(
                 self._m["requeued"].samples().values())),
             "batch_size_hist": batch_hist,
+            "ttft_count": int(self._m["ttft"].count()),
+            "spec_draft_tokens_total": int(self._m["spec_draft"].value()),
+            "spec_accepted_tokens_total": int(
+                self._m["spec_accepted"].value()),
+            "spec_acceptance_ratio": round(
+                self._m["spec_acceptance"].value(), 4),
             "latency_p50_s": round(self._m["latency"].quantile(0.50), 4),
             "latency_p95_s": round(self._m["latency"].quantile(0.95), 4),
         }
@@ -525,6 +550,12 @@ class ContinuousBatcher:
         self._dp = max(1, int(getattr(engine, "dp", 1)))
         self._shard_slots = engine.slots // self._dp
         self._paged = hasattr(engine, "pages_for")
+        # speculative engines advance 1..K tokens per dispatch (poll_spec
+        # mirrors the true positions); MoE engines expose expert loads
+        self._spec = int(getattr(engine, "spec_k", 0) or 0)
+        self._moe_serve = (
+            hasattr(engine, "expert_load")
+            and getattr(getattr(engine, "cfg", None), "moe_experts", 0) > 0)
         self._prefix_hits_seen = 0
         self._demotions_seen = 0
         self._promoted_hits_seen = 0
@@ -978,11 +1009,21 @@ class ContinuousBatcher:
             if self._tracer is not None or self._traced_seen:
                 self._note_compiles()
             k = self.engine.segment
+            pos_vec = None
+            if self._spec:
+                # speculative advance is data-dependent (1..K tokens per
+                # row): mirror the TRUE positions back via poll_spec and
+                # drain the dispatch's draft/accept counters, instead of
+                # assuming the segment stride
+                pos_vec, drafted, accepted = self.engine.poll_spec()
+                self.stats.spec_tokens(drafted, accepted)
             for s in active:
                 t = self._track[s]
                 r = t["req"]
                 prev = t["pos"]
-                t["pos"] = min(prev + k, t["last"])
+                t["pos"] = (min(int(pos_vec[s]), t["last"])
+                            if pos_vec is not None
+                            else min(prev + k, t["last"]))
                 if not t["ttft"] and t["pos"] >= t["plen"]:
                     ttft_s = now() - r.submitted_at
                     r.ttft_s = ttft_s
@@ -1026,6 +1067,10 @@ class ContinuousBatcher:
                 # hand the retired slots' pages back BEFORE the slots are
                 # offered for re-admission (prefix-cache pages stay warm)
                 self.engine.release(done)
+            if self._moe_serve:
+                # per-expert loads accumulate on device; one fetch per
+                # retirement wave keeps telemetry off the dispatch path
+                self.stats.moe_expert_load(self.engine.expert_load())
             with self._cond:
                 self._free.extend(done)
             self._report_occupancy()

@@ -242,22 +242,34 @@ def shard_params_decode_tp(params: Any, mesh: Mesh) -> Any:
     layout-independent. Returns a ``NamedSharding`` pytree for
     ``jax.device_put``; with no ``tp`` axis in the mesh it degrades to
     full replication (same code at any scale, like ``logical_axis_rules``).
+
+    MoE serving (round 20): stacked expert weights ``moe/w_gate``/``w_up``
+    [L,E,D,F] and ``w_down`` [L,E,F,D] split their expert axis over ``ep``
+    (the benched expert-parallel placement) and their d_ff axis over
+    ``tp`` like the dense MLP; the tiny f32 router replicates so routing
+    — and with it the GShard capacity math — is layout-independent.
     """
-    if "tp" not in mesh.axis_names:
+    tp_ax = "tp" if "tp" in mesh.axis_names else None
+    ep_ax = "ep" if "ep" in mesh.axis_names else None
+    if tp_ax is None and ep_ax is None:
         return jax.tree.map(lambda _: replicated(mesh), params)
 
     # (path suffix) -> partition spec; paths are the decode param layout,
     # shapes stacked over layers: qkv [L,d,3,H,K], split q/k/v [L,d,H,K],
-    # o [L,H,K,d], gate/up [L,d,f], down [L,f,d]
+    # o [L,H,K,d], gate/up [L,d,f], down [L,f,d], MoE experts
+    # w_gate/w_up [L,E,d,f], w_down [L,E,f,d]
     rules: tuple[tuple[tuple[str, ...], P], ...] = (
-        (("attn", "qkv", "kernel"), P(None, None, None, "tp", None)),
-        (("attn", "q", "kernel"), P(None, None, "tp", None)),
-        (("attn", "k", "kernel"), P(None, None, "tp", None)),
-        (("attn", "v", "kernel"), P(None, None, "tp", None)),
-        (("attn", "o", "kernel"), P(None, "tp", None, None)),
-        (("mlp", "gate", "kernel"), P(None, None, "tp")),
-        (("mlp", "up", "kernel"), P(None, None, "tp")),
-        (("mlp", "down", "kernel"), P(None, "tp", None)),
+        (("attn", "qkv", "kernel"), P(None, None, None, tp_ax, None)),
+        (("attn", "q", "kernel"), P(None, None, tp_ax, None)),
+        (("attn", "k", "kernel"), P(None, None, tp_ax, None)),
+        (("attn", "v", "kernel"), P(None, None, tp_ax, None)),
+        (("attn", "o", "kernel"), P(None, tp_ax, None, None)),
+        (("mlp", "gate", "kernel"), P(None, None, tp_ax)),
+        (("mlp", "up", "kernel"), P(None, None, tp_ax)),
+        (("mlp", "down", "kernel"), P(None, tp_ax, None)),
+        (("moe", "w_gate"), P(None, ep_ax, None, tp_ax)),
+        (("moe", "w_up"), P(None, ep_ax, None, tp_ax)),
+        (("moe", "w_down"), P(None, ep_ax, tp_ax, None)),
     )
 
     def place(path, x) -> NamedSharding:

@@ -242,6 +242,139 @@ def bench_quantized(requests: int = 48, dense_slots: int = 4,
     }
 
 
+def bench_spec(requests: int = 32, slots: int = 8, segment: int = 8,
+               page: int = 16, step_s: float = 0.004,
+               dispatch_s: float = 0.001, prefill_s: float = 0.01,
+               stagger_s: float = 0.002, max_total: int = 256,
+               prefix_len: int = 32, spec_ks=(0, 2, 4, 8),
+               draft_friendly: float = 0.85,
+               draft_adversarial: float = 0.45,
+               draft_cost: float = 0.08,
+               verify_cost: float = 1.0) -> dict:
+    """Round 20: speculative decoding A/B on the slot-pool cost model.
+
+    SAME shared-prefix trace, SAME paged engine, swept over spec-K ∈
+    ``spec_ks`` × two draft-alignment arms. K=0 is the sequential
+    baseline (``segment`` one-token steps per dispatch). K>0 pays one
+    dispatch + K draft micro-steps (each ``draft_cost`` of a step — the
+    truncated draft stack) + ONE ``verify_cost`` K-wide verify pass, and
+    advances each row by its accepted prefix + 1:
+
+    * **friendly** — the draft tracks the target (``draft_friendly``
+      accept rate): most drafts land, so a dispatch commits ~K tokens
+      for ~1 step of verify work. The tier-1 guard pins the best
+      friendly K at >= 1.4x baseline tok/s.
+    * **adversarial** — a misaligned draft (``draft_adversarial``): most
+      rounds commit the verify pass's one corrected token, so spec decay
+      toward a sequential engine that drafts for nothing. The guard pins
+      EVERY adversarial K at >= 1.0 - SPEC_TOL of baseline — rejection
+      is a masked position rewind, not recompute, so the loss is bounded
+      by the (cheap) draft work, never a stall.
+
+    Per-arm acceptance ratios come from the engines' own
+    drafted/accepted counters — the same counters the serve metrics
+    export as ``ko_serve_spec_*``."""
+    trace = make_prefix_trace(requests, prefix_len)
+
+    def arm(spec_k: int, draft: float) -> dict:
+        stats = BatcherStats()
+        eng = FakePagedEngine(
+            slots=slots, segment=segment, max_total=max_total, page=page,
+            spec_k=spec_k, draft=draft, draft_cost=draft_cost,
+            verify_cost=verify_cost, step_s=step_s, dispatch_s=dispatch_s,
+            prefill_s=prefill_s)
+        r = run_load(ContinuousBatcher(eng, stats=stats), trace, stagger_s)
+        snap = stats.snapshot()
+        return {
+            "spec_k": spec_k,
+            "wall_s": round(r["wall_s"], 3),
+            "tok_s": round(r["tok_s"], 1),
+            "drafted": snap["spec_draft_tokens_total"],
+            "accepted": snap["spec_accepted_tokens_total"],
+            "acceptance": snap["spec_acceptance_ratio"],
+        }
+
+    out: dict = {
+        "requests": requests,
+        "page": page,
+        "spec_ks": list(spec_ks),
+        "draft_cost": draft_cost,
+        "verify_cost": verify_cost,
+        "arms": {},
+    }
+    for name, draft in (("friendly", draft_friendly),
+                        ("adversarial", draft_adversarial)):
+        points = [arm(k, draft) for k in spec_ks]
+        base = points[0]["tok_s"]      # spec_ks[0] == 0: the baseline
+        for p in points:
+            p["vs_base"] = round(p["tok_s"] / max(base, 1e-9), 2)
+        out["arms"][name] = {"draft": draft, "points": points}
+    friendly = out["arms"]["friendly"]["points"]
+    adversarial = out["arms"]["adversarial"]["points"]
+    out["best_speedup"] = max(p["vs_base"] for p in friendly[1:])
+    out["best_spec_k"] = max(friendly[1:],
+                             key=lambda p: p["vs_base"])["spec_k"]
+    out["adversarial_floor"] = min(p["vs_base"] for p in adversarial)
+    return out
+
+
+def bench_spec_real(spec_k: int = 4, draft_layers: int = 1,
+                    max_tokens: int = 8) -> dict:
+    """Gated real-engine arm: the speculative ``SlotPoolEngine`` against
+    its own sequential twin on the tiny config, greedy. The numbers that
+    matter here are not wall times (host + compiler noise on CPU) but
+    the contract: token-for-token identical outputs at any accept rate,
+    with the acceptance counters showing drafts actually landed."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.workloads.decode_loop import SlotPoolEngine
+    from kubeoperator_tpu.workloads.transformer import (
+        Transformer, TransformerConfig,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=24,
+                            dtype=jnp.float32, remat=False,
+                            attention="dense")
+    params = nn.unbox(Transformer(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10], [3, 1, 4, 1, 5, 9, 2, 6]]
+
+    def drain(eng) -> list[list[int]]:
+        eng.admit([(s, p, max_tokens, 0.0, 0)
+                   for s, p in enumerate(prompts)])
+        for _ in range(16 * max_tokens):
+            buf, pos = eng.poll()
+            if all(pos[s] >= len(p) + max_tokens - 1
+                   for s, p in enumerate(prompts)):
+                break
+            eng.run_segment()
+            if getattr(eng, "spec_k", 0):
+                eng.poll_spec()        # drain the per-dispatch counters
+        buf, _ = eng.poll()
+        return [buf[s, :len(p) + max_tokens].tolist()
+                for s, p in enumerate(prompts)]
+
+    base = drain(SlotPoolEngine(cfg, params, slots=4, segment=4))
+    # double the pool: each speculative slot mirrors its pages for the
+    # draft model's KV alongside the target's
+    spec_eng = SlotPoolEngine(cfg, params, slots=4, segment=4, pages=25,
+                              spec_k=spec_k, draft_layers=draft_layers)
+    spec = drain(spec_eng)
+    return {
+        "device_kind": jax.devices()[0].platform,
+        "spec_k": spec_k,
+        "draft_layers": draft_layers,
+        "bit_identical": spec == base,
+        "drafted": int(spec_eng.spec_draft_tokens),
+        "accepted": int(spec_eng.spec_accepted_tokens),
+        "acceptance": round(spec_eng.spec_accepted_tokens
+                            / max(spec_eng.spec_draft_tokens, 1), 3),
+    }
+
+
 def bench_cluster(requests: int = 60, replicas: int = 4, slots: int = 8,
                   segment: int = 8, page: int = 16, groups: int = 15,
                   prefix_len: int = 64, prefix_capacity: int = 24,
@@ -781,6 +914,13 @@ def main() -> None:
     ap.add_argument("--prefix-capacity", type=int, default=24,
                     help="cluster mode: per-replica prefix-cache entries "
                          "(LRU) — one replica's tenant share, not all")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding A/B: spec-K sweep x "
+                         "friendly/adversarial draft alignment on the "
+                         "paged cost model (round 20)")
+    ap.add_argument("--spec-real", action="store_true",
+                    help="spec mode: also run the real speculative "
+                         "engine and pin bit-identical greedy output")
     ap.add_argument("--qos", action="store_true",
                     help="noisy-neighbor A/B: QoS gateway (admission + "
                          "fair dequeue + preemption) vs FIFO at equal HBM "
@@ -856,6 +996,41 @@ def main() -> None:
                     f"recompute {sp['recompute_ttft_s']}s "
                     f"({sp['promoted_hits']} promotions, "
                     f"{sp['demotions']} demotions)"),
+            }
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+                f.write("\n")
+        return
+    if args.spec:
+        result = bench_spec(requests=args.requests,
+                            segment=args.segment, page=args.page,
+                            prefix_len=args.prefix_len,
+                            stagger_s=args.stagger)
+        if args.spec_real:
+            result["real"] = bench_spec_real()
+        print(json.dumps(result))
+        if args.out:
+            # stated tolerance: adversarial spec may cost up to 20% vs
+            # spec-off — the draft work is bounded, rejection is a
+            # rewind — while the best friendly K must pay >= 1.4x
+            tol = 0.2
+            real = result.get("real")
+            artifact = {
+                "rc": 0,
+                "ok": (result["best_speedup"] >= 1.4
+                       and result["adversarial_floor"] >= 1.0 - tol
+                       and (real is None or (real["bit_identical"]
+                                             and real["accepted"] > 0))),
+                "skipped": False,
+                "spec_tolerance": tol,
+                **result,
+                "tail": (
+                    f"friendly best K={result['best_spec_k']} "
+                    f"{result['best_speedup']}x | adversarial floor "
+                    f"{result['adversarial_floor']}x (tol {tol}) | "
+                    + (f"real: bit_identical={real['bit_identical']} "
+                       f"acceptance={real['acceptance']}"
+                       if real else "real: (not run)")),
             }
             with open(args.out, "w") as f:
                 json.dump(artifact, f, indent=1)

@@ -1,0 +1,31 @@
+"""Corpus: speculative-decode rollback bypassing ``_rewind`` (KO123)."""
+import jax.numpy as jnp
+
+
+class SpecSlotPool:
+    def __init__(self, bt, dbt, pos):
+        self._bt_np = bt
+        self._dbt = dbt
+        self._pos = pos
+
+    def _rewind(self, pos0, adv, last, live):
+        return jnp.where(live, jnp.minimum(pos0 + adv, last), pos0)
+
+    def commit(self, pos0, adv, last, live):
+        # KO123: inline clamp into the position vector — dead rows march
+        # forward and the clamp never matches the page accounting
+        pos = jnp.minimum(pos0 + adv, last)
+        return pos
+
+    def steal_tail(self, slot, trash):
+        # KO123: host block-table write outside release/_plan_entries —
+        # the allocator still thinks the tail pages belong to this row
+        self._bt_np[slot, 1:] = trash
+
+    def remap(self, slot, pages):
+        # KO123: device table updated outside _push_block_tables — it no
+        # longer mirrors the host-authoritative copy
+        self._dbt = self._dbt.at[slot].set(pages)
+
+    def routed(self, pos0, adv, last, live):
+        return self._rewind(pos0, adv, last, live)

@@ -117,6 +117,24 @@ def test_cache_key_rolls_on_closure_constants(tmp_path):
     again = cache.key_for("_segment_body", args, closure=(2, 8, "bf16"))
     assert again.fingerprint() == seg2.fingerprint()
 
+    # round 20: the engine folds spec_k/draft_layers and the model config
+    # into the closure — a speculative executable rewinds positions and
+    # writes a draft mirror, so serving it to a spec_k=0 engine (or one
+    # with a different draft depth, or a MoE config) would corrupt pools
+    dense, moe = repr(CFG), repr(CFG).replace("moe_experts=0",
+                                              "moe_experts=4")
+    assert dense != moe
+    plain = cache.key_for("_segment_body", args, closure=(2, 8, "bf16",
+                                                          0, 0, dense))
+    spec4 = cache.key_for("_spec_segment_body", args,
+                          closure=(2, 8, "bf16", 4, 1, dense))
+    spec2 = cache.key_for("_spec_segment_body", args,
+                          closure=(2, 8, "bf16", 4, 2, dense))
+    moekey = cache.key_for("_segment_body", args, closure=(2, 8, "bf16",
+                                                           0, 0, moe))
+    assert len({plain.fingerprint(), spec4.fingerprint(),
+                spec2.fingerprint(), moekey.fingerprint()}) == 4
+
 
 def test_cache_key_folds_ko140_baseline(tmp_path):
     """The source half of the key: a baselined function's fingerprint

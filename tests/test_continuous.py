@@ -6,6 +6,7 @@ per-dispatch latency (mirroring test_scheduler's stance)."""
 
 import dataclasses
 import importlib.util
+import json
 import os
 import threading
 import time
@@ -1287,3 +1288,272 @@ def test_fake_engine_shares_spill_protocol(params):
         assert eng.kv_dtype == "int8" and eng.spill_pages == 4
         assert eng.spill_pages_used(0) == 0
         assert eng.demotions == 0 and eng.promoted_hits == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding + MoE serving (round 20)
+# ---------------------------------------------------------------------------
+
+MOE_CFG = dataclasses.replace(CFG, moe_experts=4, moe_top_k=2)
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    model = Transformer(MOE_CFG)
+    return nn.unbox(model.init(jax.random.key(11),
+                               jnp.zeros((2, 8), jnp.int32))["params"])
+
+
+def moe_solo(moe_params, prompt, max_tokens):
+    out = generate(MOE_CFG, moe_params, jnp.asarray([prompt], jnp.int32),
+                   max_tokens)
+    return np.asarray(out)[0].tolist()
+
+
+def _spec_drain(eng, lasts):
+    """Drain a speculative engine: advances are data-dependent (per-row
+    accept counts), so positions come from ``poll_spec``, never from a
+    fixed ``segment`` stride."""
+    for _ in range(300):
+        eng.run_segment()
+        pos, _d, _a = eng.poll_spec()
+        if all(pos[s] >= last for s, last in lasts.items()):
+            break
+    buf, _ = eng.poll()
+    return buf
+
+
+def test_spec_pages_reserve_speculative_lookahead(params):
+    """Satellite bugfix, written first: with speculation on, ``pages_for``
+    must reserve the K-token speculative lookahead AND the draft model's
+    mirrored pages — otherwise a row whose decode extent ends exactly on
+    a page boundary over-speculates its verify K/V into pages it never
+    reserved (the shard's shared trash page), and the batcher's page
+    accounting under-counts what admission actually allocates. Pinned at
+    spec_k > page remainder: plen+mt = 16 is exactly 2 pages of 8."""
+    base = SlotPoolEngine(CFG, params, slots=2, segment=2, page=8)
+    # the lookahead is spec-gated: default engines keep the old contract
+    # (pages_for(5, 4) == 2 is pinned by test_page_pool_defaults)
+    assert base.pages_for(12, 4) == 2
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2, page=8,
+                         spec_k=4, draft_layers=1)
+    # target extent 16 + K=4 lookahead -> 3 pages, mirrored for the draft
+    assert eng.pages_for(12, 4) == 6
+    free0 = eng.free_pages(0)
+    eng.admit([(0, PRE[:12], 4, 0.0, 0)])
+    # admission consumes exactly what pages_for promised the batcher
+    assert free0 - eng.free_pages(0) == eng.pages_for(12, 4)
+    # and the boundary-crossing speculation stays bit-identical to solo
+    buf = _spec_drain(eng, {0: 15})
+    assert buf[0][:16].tolist() == solo(params, PRE[:12], 4)
+    eng.release([0])
+    # free + cache-retained (target prompt prefix only — draft pages are
+    # never prefix-cached) restores the starting pool
+    assert eng.free_pages(0) + eng.evictable_pages(0) == free0
+
+
+def test_spec_validation(params, moe_params):
+    with pytest.raises(ValueError, match="draft_layers"):
+        SlotPoolEngine(CFG, params, spec_k=2)            # no draft
+    with pytest.raises(ValueError, match="draft_layers"):
+        SlotPoolEngine(CFG, params, spec_k=2, draft_layers=2)  # == n_layers
+    with pytest.raises(ValueError, match="spec_k"):
+        SlotPoolEngine(CFG, params, draft_layers=1)      # draft without K
+    with pytest.raises(ValueError, match="MoE"):
+        SlotPoolEngine(MOE_CFG, moe_params, spec_k=2, draft_layers=1)
+
+
+def test_spec_greedy_matches_solo_mixed_shapes(params):
+    """The spec-decode acceptance pin: greedy tokens with speculation on
+    are bit-identical to solo generate() — speculation changes how fast
+    tokens arrive, never which tokens. Mixed prompt shapes co-batch, so
+    rows sit at different accept frontiers every dispatch and rewind
+    independently."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2, pages=28,
+                         spec_k=3, draft_layers=1)
+    reqs = {0: ([1, 2, 3, 4, 5], 6),
+            1: ([7, 8, 9, 10, 11, 12, 13, 14], 5),
+            2: ([42], 9),
+            3: ([3, 1, 4, 1, 5, 9, 2], 12)}
+    eng.admit([(s, p, mt, 0.0, 0) for s, (p, mt) in reqs.items()])
+    buf = _spec_drain(eng, {s: len(p) + mt - 1
+                            for s, (p, mt) in reqs.items()})
+    for s, (prompt, mt) in reqs.items():
+        got = buf[s][:len(prompt) + mt].tolist()
+        assert got == solo(params, prompt, mt), f"slot {s} diverged"
+    assert eng.spec_draft_tokens > 0
+    assert 0 < eng.spec_accepted_tokens <= eng.spec_draft_tokens
+
+
+def test_spec_mid_flight_admission_and_sampling(params):
+    """Mid-flight admission under speculation plus a sampled row: the
+    newcomer and the row in flight both stay bit-identical to their
+    undisturbed runs, and the sampled row matches the NON-speculative
+    engine's stream exactly — rejection commits the target's own
+    (seed, position)-keyed sample, so speculation is invisible to the
+    sampling stream too."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2, pages=28,
+                         spec_k=3, draft_layers=1)
+    eng.admit([(0, [5, 6, 7, 8, 9, 10], 10, 0.0, 0),
+               (1, [2, 4, 6, 8], 8, 0.7, 123)])
+    eng.run_segment()                    # slots 0/1 are now mid-decode
+    eng.poll_spec()
+    eng.admit([(2, [11, 12, 13], 8, 0.0, 0)])
+    buf = _spec_drain(eng, {0: 15, 1: 11, 2: 10})
+    assert buf[0][:16].tolist() == solo(params, [5, 6, 7, 8, 9, 10], 10)
+    assert buf[2][:11].tolist() == solo(params, [11, 12, 13], 8)
+    # reference sampled stream: the plain slot-pool engine, same request
+    ref = SlotPoolEngine(CFG, params, slots=4, segment=2)
+    ref.admit([(1, [2, 4, 6, 8], 8, 0.7, 123)])
+    rbuf = drain(ref, {1: (4, 11)})
+    assert buf[1][:12].tolist() == rbuf[1][:12].tolist()
+
+
+@needs_8dev
+def test_spec_greedy_matches_solo_sharded(params):
+    """Speculation on the 2×4 dp×tp mesh, including mid-flight admission:
+    draft pages live in each dp shard's own pool range, rewinds are
+    per-row, and greedy tokens stay bit-identical to solo generate()."""
+    eng = SlotPoolEngine(CFG, params, slots=4, segment=2, pages=28,
+                         mesh_spec=MESH_2x4, spec_k=3, draft_layers=1)
+    eng.admit([(0, [1, 2, 3, 4, 5], 6, 0.0, 0),      # dp shard 0
+               (2, [7, 8, 9, 10, 11, 12, 13, 14], 5, 0.0, 0)])  # shard 1
+    eng.run_segment()
+    eng.poll_spec()
+    eng.admit([(3, [42], 9, 0.0, 0)])                # mid-flight, shard 1
+    buf = _spec_drain(eng, {0: 10, 2: 12, 3: 9})
+    assert buf[0][:11].tolist() == solo(params, [1, 2, 3, 4, 5], 6)
+    assert buf[2][:13].tolist() == solo(
+        params, [7, 8, 9, 10, 11, 12, 13, 14], 5)
+    assert buf[3][:10].tolist() == solo(params, [42], 9)
+
+
+def test_continuous_batcher_speculative_end_to_end(params):
+    """ContinuousBatcher over a speculative engine: retirement handles
+    multi-token-per-dispatch advances (positions fetched, not inferred
+    from the segment stride), TTFT still stamps, and the spec counters
+    flow into BatcherStats/prometheus."""
+    eng = SlotPoolEngine(CFG, params, slots=2, segment=2,
+                         spec_k=3, draft_layers=1)
+    cb = ContinuousBatcher(eng)
+    # 6-token prompt: positions 4..5 are prompt consumption, where draft
+    # and target both emit the given token — acceptance is guaranteed > 0
+    out = cb.submit([1, 2, 3, 4, 5, 6], 6)
+    assert out == solo(params, [1, 2, 3, 4, 5, 6], 6)
+    s = cb.stats.snapshot()
+    assert s["spec_draft_tokens_total"] > 0
+    assert 0 < s["spec_accepted_tokens_total"] <= s["spec_draft_tokens_total"]
+    assert s["requests_total"] == 1 and s["errors_total"] == 0
+    assert s["ttft_count"] == 1
+    prom = cb.stats.prometheus()
+    assert "ko_serve_spec_draft_tokens_total" in prom
+    assert "ko_serve_spec_acceptance_ratio" in prom
+
+
+def test_moe_greedy_matches_solo(moe_params):
+    """Tentpole (b): MoE models serve through the slot pool — router
+    state rides inside the segment jit — and greedy tokens stay
+    bit-identical to solo generate() (the flax token loop). Prompts are
+    pow2-length so the admission chunk width equals solo's prefill width:
+    GShard capacity dropping is chunk-width dependent, and equal widths
+    pin equal routing."""
+    eng = SlotPoolEngine(MOE_CFG, moe_params, slots=2, segment=2)
+    track = {}
+    admit_tracked(eng, track, [(0, [1, 2, 3, 4, 5, 6, 7, 8], 6, 0.0, 0),
+                               (1, [9, 10, 11, 12], 8, 0.0, 1)])
+    buf = drain(eng, track)
+    assert buf[0][:14].tolist() == moe_solo(
+        moe_params, [1, 2, 3, 4, 5, 6, 7, 8], 6)
+    assert buf[1][:12].tolist() == moe_solo(moe_params, [9, 10, 11, 12], 8)
+    # expert-load telemetry: accumulated on device, fetched on demand
+    load = eng.expert_load()
+    assert load.shape == (4,) and float(load.sum()) > 0
+
+
+def test_moe_mid_flight_admission_matches_solo(moe_params):
+    """Mid-flight MoE admission: the chunked prefill routes through the
+    flax MoE layers while neighbors decode — neither side perturbs the
+    other's tokens."""
+    eng = SlotPoolEngine(MOE_CFG, moe_params, slots=2, segment=2)
+    track = {}
+    admit_tracked(eng, track, [(0, [5, 6, 7, 8], 8, 0.0, 0)])
+    eng.run_segment()
+    track[0] = (min(track[0][0] + 2, track[0][1]), track[0][1])
+    admit_tracked(eng, track, [(1, [11, 12, 13, 14, 15, 16, 17, 18],
+                                6, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[0][:12].tolist() == moe_solo(moe_params, [5, 6, 7, 8], 8)
+    assert buf[1][:14].tolist() == moe_solo(
+        moe_params, [11, 12, 13, 14, 15, 16, 17, 18], 6)
+
+
+def test_moe_mesh_validation():
+    """ep joins the serve mesh only for MoE configs, and only when it
+    divides the expert count; non-MoE serving keeps rejecting every axis
+    but dp/tp."""
+    validate_serve_mesh(MeshSpec(dp=2, ep=2, tp=2), slots=8, n_heads=4,
+                        moe_experts=4)
+    with pytest.raises(ValueError, match="dp and heads over tp only"):
+        validate_serve_mesh(MeshSpec(dp=2, ep=2, tp=2), slots=8, n_heads=4)
+    with pytest.raises(ValueError, match="moe_experts"):
+        validate_serve_mesh(MeshSpec(dp=2, ep=4), slots=8, n_heads=4,
+                            moe_experts=6)
+
+
+@needs_8dev
+def test_moe_serves_on_ep_mesh(moe_params):
+    """MoE behind the endpoint on a dp×ep×tp mesh: expert weights shard
+    over ep (the benched placement), attention heads over tp, pages over
+    dp — and greedy tokens stay bit-identical to the solo flax decode."""
+    spec = MeshSpec(dp=2, ep=2, tp=2)
+    eng = SlotPoolEngine(MOE_CFG, moe_params, slots=4, segment=2,
+                         mesh_spec=spec)
+    assert eng.dp == 2
+    track = {}
+    admit_tracked(eng, track, [(0, [1, 2, 3, 4, 5, 6, 7, 8], 6, 0.0, 0),
+                               (2, [9, 10, 11, 12], 8, 0.0, 0)])
+    buf = drain(eng, track)
+    assert buf[0][:14].tolist() == moe_solo(
+        moe_params, [1, 2, 3, 4, 5, 6, 7, 8], 6)
+    assert buf[2][:12].tolist() == moe_solo(moe_params, [9, 10, 11, 12], 8)
+
+
+def test_spec_cost_model_guard():
+    """Round-20 acceptance guard on the injected-latency cost model:
+    sweeping spec-K x draft alignment on the SAME trace, the best
+    friendly K must pay >= 1.4x baseline tok/s (drafts land, one verify
+    pass commits ~K tokens), while EVERY adversarial K must hold
+    >= 1.0 - 0.2 of baseline (stated tolerance: rejection is a masked
+    rewind, so the worst case costs bounded draft work, never a stall)."""
+    bs = _bench_mod()
+    out = bs.bench_spec(requests=32)
+    assert out["best_speedup"] >= 1.4, out
+    assert out["adversarial_floor"] >= 1.0 - 0.2, out
+    for arm in out["arms"].values():
+        for p in arm["points"][1:]:
+            assert p["drafted"] > 0 and 0 < p["acceptance"] < 1, p
+    # misaligned drafts must actually accept less than aligned ones, or
+    # the accept-rate knob isn't steering the A/B
+    fr = {p["spec_k"]: p["acceptance"]
+          for p in out["arms"]["friendly"]["points"][1:]}
+    ad = {p["spec_k"]: p["acceptance"]
+          for p in out["arms"]["adversarial"]["points"][1:]}
+    assert all(ad[k] < fr[k] for k in fr), (fr, ad)
+
+
+def test_spec_artifact_schema_and_guards():
+    """MULTICHIP_serving_r08.json is the speculative-decoding A/B's
+    number of record: the sweep's guards held when it was cut, and the
+    real-engine arm pinned bit-identical greedy output with a nonzero
+    accept count."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MULTICHIP_serving_r08.json")
+    art = json.load(open(path))
+    assert art["rc"] == 0 and art["ok"] is True and not art["skipped"]
+    assert art["best_speedup"] >= 1.4
+    assert art["adversarial_floor"] >= 1.0 - art["spec_tolerance"]
+    assert set(art["arms"]) == {"friendly", "adversarial"}
+    for arm in art["arms"].values():
+        assert [p["spec_k"] for p in arm["points"]] == art["spec_ks"]
+    assert art["real"]["bit_identical"] is True
+    assert art["real"]["accepted"] > 0

@@ -43,6 +43,7 @@ GOLDEN = {
     "bad_unpinned.py": {"KO120"},
     "bad_page_write.py": {"KO121"},
     "bad_pool_read.py": {"KO122"},
+    "bad_rewind.py": {"KO123"},
     "bad_collective_loop.py": {"KO130"},
     "bad_locking.py": {"KO201"},
     "bad_metric.py": {"KO210"},

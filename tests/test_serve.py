@@ -155,3 +155,15 @@ def test_serve_parser_kv_dtype_and_spill_flags():
 
     with pytest.raises(SystemExit):
         jobs.build_parser().parse_args(["serve", "--kv-dtype", "fp64"])
+
+
+def test_serve_parser_spec_and_moe_flags():
+    """--spec-k/--draft-layers/--moe parse on `ko-train serve`; the values
+    are what cmd_serve forwards into the engine and the model config."""
+    args = jobs.build_parser().parse_args(
+        ["serve", "--engine", "continuous", "--spec-k", "4",
+         "--draft-layers", "1", "--moe", "4"])
+    assert args.spec_k == 4 and args.draft_layers == 1 and args.moe == 4
+    # defaults: speculation off, dense FFN
+    dflt = jobs.build_parser().parse_args(["serve"])
+    assert dflt.spec_k == 0 and dflt.draft_layers == 0 and dflt.moe == 0
